@@ -1,0 +1,37 @@
+"""apex_tpu — a TPU-native mixed-precision & distributed training framework.
+
+A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
+(reference: /root/reference, see SURVEY.md):
+
+- ``apex_tpu.amp``       — precision policy engine with O0–O3 presets and a
+                           functional dynamic loss scaler (no host syncs).
+- ``apex_tpu.arena``     — flat parameter arena (the multi-tensor-apply substrate).
+- ``apex_tpu.ops``       — fused Pallas kernels: multi-tensor scale/axpby/l2norm,
+                           LayerNorm, MLP, softmax-CE, NHWC BatchNorm, attention.
+- ``apex_tpu.optim``     — fused optimizers (SGD/Adam/LAMB/NovoGrad/Adagrad) and
+                           ZeRO-style sharded distributed optimizers.
+- ``apex_tpu.parallel``  — data parallelism, SyncBatchNorm, LARC, mesh helpers,
+                           ring-attention sequence parallelism.
+- ``apex_tpu.models``    — ResNet, DCGAN, BERT-style transformer, RNN stacks.
+- ``apex_tpu.sparsity``  — 2:4 structured sparsity (ASP).
+- ``apex_tpu.prof``      — profiler/trace tooling over jax.profiler + HLO cost
+                           analysis.
+
+Unlike the reference (an interception-based library over an eager framework),
+apex_tpu expresses the same capabilities as *policies, functional transforms and
+kernels* compiled by XLA: precision is a policy object applied at the library
+boundary, loss scaling is explicit state threaded through the train step,
+gradient synchronisation is ``psum`` over a named mesh axis, and the fused
+CUDA kernels of the reference are Pallas kernels over a flat parameter arena.
+"""
+
+__version__ = "0.1.0"
+
+from apex_tpu import amp
+from apex_tpu import arena
+from apex_tpu import ops
+from apex_tpu import optim
+from apex_tpu import parallel
+from apex_tpu import utils
+
+__all__ = ["amp", "arena", "ops", "optim", "parallel", "utils", "__version__"]

@@ -1,0 +1,46 @@
+"""apex_tpu.amp — the precision engine.
+
+TPU-native rebuild of ``apex.amp`` (see SURVEY.md §2.1): opt-level presets
+become immutable :class:`Policy` values, the dynamic loss scaler becomes
+functional state advanced on-device, and O1's namespace patching becomes a
+policy applied at the library boundary (ambient :func:`policy_scope` for
+``apex_tpu.ops``, :func:`auto_cast` interceptor for stock flax models).
+"""
+
+from apex_tpu.amp.policy import Policy, current_policy, policy_scope
+from apex_tpu.amp.scaler import (
+    LossScaleConfig,
+    LossScaleState,
+    loss_scale_init,
+    loss_scale_update,
+    scale_loss,
+    select_if_finite,
+    unscale_grads,
+    unscale_grads_with_stashed,
+    value_and_scaled_grad,
+)
+from apex_tpu.amp.api import (
+    Amp,
+    AmpState,
+    initialize,
+    half_function,
+    float_function,
+    promote_function,
+)
+from apex_tpu.amp.interceptor import auto_cast, make_interceptor
+from apex_tpu.amp.lists import (
+    register_half_op,
+    register_float_op,
+    register_promote_op,
+)
+
+__all__ = [
+    "Policy", "current_policy", "policy_scope",
+    "LossScaleConfig", "LossScaleState", "loss_scale_init",
+    "loss_scale_update", "scale_loss", "select_if_finite", "unscale_grads",
+    "unscale_grads_with_stashed", "value_and_scaled_grad",
+    "Amp", "AmpState", "initialize",
+    "half_function", "float_function", "promote_function",
+    "auto_cast", "make_interceptor",
+    "register_half_op", "register_float_op", "register_promote_op",
+]

@@ -1,0 +1,242 @@
+"""High-level amp API: the TPU-native ``amp.initialize`` equivalent.
+
+The reference wires model + optimizer + scaler together imperatively
+(`apex/amp/frontend.py:195-358`, `apex/amp/_initialize.py:145-263`,
+`apex/amp/_process_optimizer.py:321-489`). Functionally, the same bundle is a
+value: :class:`AmpState` holds fp32 master params, optimizer state and one
+loss-scaler state per loss; :class:`Amp` builds and advances it inside your
+jitted train step.
+
+Typical single-loss use::
+
+    policy = amp.Policy.from_opt_level("O2")
+    amp_opt = amp.Amp(policy, optimizer)           # optimizer: optax-style tx
+    state = amp_opt.init(params)
+
+    @jax.jit
+    def train_step(state, batch):
+        def loss_fn(model_params):
+            logits = model.apply(model_params, batch["x"])
+            return cross_entropy(logits, batch["y"])
+        state, loss, finite = amp_opt.step(state, loss_fn)
+        return state, loss
+
+    # amp_opt.step handles: cast masters -> model dtype, scale loss, grad,
+    # unscale fp32, finite check, scaler schedule, skip-on-overflow commit.
+
+Multi-loss (DCGAN pattern — ``num_losses``/``loss_id``,
+`examples/dcgan/main_amp.py:215-253`)::
+
+    amp_opt = amp.Amp(policy, tx, num_losses=3)
+    grads, state, finite = amp_opt.backward(state, loss_fn, loss_id=1)
+    state = amp_opt.apply_gradients(state, grads, finite)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.policy import Policy, policy_scope, _promote
+from apex_tpu.amp import scaler as _scaler
+from apex_tpu.amp.scaler import (
+    LossScaleConfig, LossScaleState, loss_scale_init, loss_scale_update,
+    scale_loss, unscale_grads,
+)
+from apex_tpu.utils import tree_all_finite, tree_cast, tree_select
+
+
+class AmpState(NamedTuple):
+    """The complete mixed-precision training state (a pytree).
+
+    ``params`` are the optimizer-facing params: fp32 masters when the policy
+    uses master weights (O1/O2), model-dtype otherwise (O3). Checkpointing
+    this tuple round-trips everything the reference saves across
+    ``amp.state_dict`` + optimizer/model state dicts — and because masters
+    are fp32, checkpoints are fp32 exactly like the O2 state-dict hook
+    guarantees (`apex/amp/_initialize.py:133-142`).
+    """
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    scalers: Tuple[Optional[LossScaleState], ...]
+
+
+class Amp:
+    """Bundles a precision policy, an optimizer, and loss scaling."""
+
+    def __init__(self, policy: Policy, tx, *, num_losses: int = 1):
+        self.policy = policy
+        self.tx = tx
+        self.num_losses = num_losses
+        self.scale_cfg = LossScaleConfig.from_policy_field(policy.loss_scale)
+
+    # -- state construction --------------------------------------------------
+
+    def init(self, params) -> AmpState:
+        """Build AmpState from fp32 params.
+
+        Master-weights policies keep params fp32 (the masters); pure-half
+        policies (O3) store them in the model dtype. Mirrors
+        ``lazy_init_with_master_weights`` (`_process_optimizer.py:28-90`)
+        minus the laziness — state is explicit from step zero.
+        """
+        if self.policy.master_weights or self.policy.cast_model_type is None:
+            master = tree_cast(params, jnp.float32)
+        else:
+            master = self.policy.cast_params(params)
+        return AmpState(
+            step=jnp.int32(0),
+            params=master,
+            opt_state=self.tx.init(master),
+            scalers=tuple(loss_scale_init(self.scale_cfg)
+                          for _ in range(self.num_losses)),
+        )
+
+    def model_params(self, state: AmpState):
+        """Model-dtype view of the params for the forward pass
+        (master→model cast; `_process_optimizer.py:93-139` in reverse)."""
+        return self.policy.cast_params(state.params)
+
+    # -- gradient production -------------------------------------------------
+
+    def backward(self, state: AmpState, loss_fn: Callable, *args,
+                 loss_id: int = 0, has_aux: bool = False, **kwargs):
+        """Scaled backward for one loss: returns (out, grads_fp32, state', finite).
+
+        ``loss_fn(model_params, *args, **kwargs)`` is differentiated at the
+        *master* params with the model-dtype cast inside the graph, so grads
+        come back w.r.t. masters in fp32 — the grad-copy elision of
+        ``_prepare/_post_amp_backward`` (`_process_optimizer.py:142-202`)
+        falls out of autodiff for free.
+        """
+        sstate = state.scalers[loss_id]
+
+        def scaled(p):
+            mp = self.policy.cast_params(p)
+            # Bind the ambient policy so apex_tpu.ops / half_function-style
+            # consumers inside loss_fn see it — the trace-time analogue of
+            # O1's namespace patching being active during forward+backward.
+            with policy_scope(self.policy):
+                out = loss_fn(mp, *args, **kwargs)
+            loss = out[0] if has_aux else out
+            return scale_loss(loss, sstate), out
+
+        grads, out = jax.grad(scaled, has_aux=True)(state.params)
+        grads, finite = unscale_grads(grads, sstate)
+        new_sstate = loss_scale_update(sstate, finite, self.scale_cfg)
+        scalers = tuple(new_sstate if i == loss_id else s
+                        for i, s in enumerate(state.scalers))
+        return out, grads, state._replace(scalers=scalers), finite
+
+    # -- update --------------------------------------------------------------
+
+    def apply_gradients(self, state: AmpState, grads, grads_finite) -> AmpState:
+        """Optimizer update committed only where grads were finite.
+
+        The skipped step neither moves params nor advances optimizer
+        state/step count — the bitwise property the reference tests demand
+        (`tests/L0/run_amp/test_fused_sgd.py`).
+        """
+        updates, new_opt_state = self.tx.update(
+            grads, state.opt_state, state.params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+        committed_params = tree_select(grads_finite, new_params, state.params)
+        committed_opt = tree_select(grads_finite, new_opt_state,
+                                    state.opt_state)
+        new_step = state.step + jnp.where(grads_finite, 1, 0).astype(jnp.int32)
+        return state._replace(step=new_step, params=committed_params,
+                              opt_state=committed_opt)
+
+    def step(self, state: AmpState, loss_fn: Callable, *args,
+             loss_id: int = 0, has_aux: bool = False, **kwargs):
+        """backward + apply in one call. Returns (state', out, finite)."""
+        out, grads, state, finite = self.backward(
+            state, loss_fn, *args, loss_id=loss_id, has_aux=has_aux, **kwargs)
+        state = self.apply_gradients(state, grads, finite)
+        return state, out, finite
+
+    # -- checkpoint parity ---------------------------------------------------
+
+    def state_dict(self, state: AmpState):
+        """Scaler state as a plain dict (``amp.state_dict``,
+        `apex/amp/frontend.py:361-370`)."""
+        return {
+            f"loss_scaler{i}": None if s is None else
+            {"loss_scale": s.loss_scale, "unskipped": s.growth_tracker}
+            for i, s in enumerate(state.scalers)
+        }
+
+    def load_state_dict(self, state: AmpState, sd) -> AmpState:
+        """Restore scaler state (`apex/amp/frontend.py:373-400`)."""
+        scalers = []
+        for i, s in enumerate(state.scalers):
+            entry = sd.get(f"loss_scaler{i}")
+            if s is None or entry is None:
+                scalers.append(s)
+            else:
+                scalers.append(LossScaleState(
+                    loss_scale=jnp.float32(entry["loss_scale"]),
+                    growth_tracker=jnp.int32(entry["unskipped"])))
+        return state._replace(scalers=tuple(scalers))
+
+
+def initialize(params, tx, opt_level: str = "O1", *,
+               half_dtype=jnp.bfloat16, num_losses: int = 1,
+               **policy_overrides) -> Tuple[Amp, AmpState]:
+    """One-call setup: ``amp_opt, state = amp.initialize(params, tx, "O2")``.
+
+    The ergonomic mirror of ``amp.initialize(model, optimizer, opt_level)``
+    (`apex/amp/frontend.py:195-358`) for the functional world: builds the
+    policy preset (kwarg overrides win), the Amp bundle, and the initial
+    state in one step.
+    """
+    policy = Policy.from_opt_level(opt_level, half_dtype=half_dtype,
+                                   **policy_overrides)
+    amp_opt = Amp(policy, tx, num_losses=num_losses)
+    return amp_opt, amp_opt.init(params)
+
+
+# -- Decorator parity (`apex/amp/amp.py:30-64`) ------------------------------
+
+def half_function(fn):
+    """Run ``fn`` with floating args cast to the ambient policy's half dtype."""
+    from apex_tpu.amp.policy import current_policy
+
+    def wrapped(*args, **kwargs):
+        p = current_policy()
+        if p.enabled and (p.patch_ops or p.cast_model_type is not None):
+            args = tree_cast(args, jnp.dtype(p.half_dtype))
+            kwargs = tree_cast(kwargs, jnp.dtype(p.half_dtype))
+        return fn(*args, **kwargs)
+    return wrapped
+
+
+def float_function(fn):
+    """Run ``fn`` with floating args cast to fp32."""
+    from apex_tpu.amp.policy import current_policy
+
+    def wrapped(*args, **kwargs):
+        p = current_policy()
+        if p.enabled:
+            args = tree_cast(args, jnp.float32)
+            kwargs = tree_cast(kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+    return wrapped
+
+
+def promote_function(fn):
+    """Run ``fn`` with floating args promoted to their widest dtype."""
+    def wrapped(*args, **kwargs):
+        dts = [jnp.asarray(x).dtype
+               for x in jax.tree_util.tree_leaves((args, kwargs))
+               if hasattr(x, "dtype") or isinstance(x, (int, float))]
+        target = _promote(dts)
+        if dts:
+            args = tree_cast(args, target)
+            kwargs = tree_cast(kwargs, target)
+        return fn(*args, **kwargs)
+    return wrapped

@@ -1,0 +1,87 @@
+"""Automatic mixed precision for *unmodified* flax models (O1 ergonomics).
+
+The reference achieves "user model unchanged" by monkey-patching the torch
+namespaces (`apex/amp/amp.py:68-177`) — interpreter-global mutation that has
+no TPU-idiomatic analogue. In JAX the equivalent interception point is flax's
+method interceptor stack: :func:`auto_cast` installs an interceptor that, for
+the duration of a trace, (a) casts floating inputs of MXU-bound modules
+(Dense/Conv/Attention/...) to the policy's half dtype and precision-sensitive
+modules (norms) to fp32, and (b) retargets each intercepted module's
+``dtype`` attribute so flax's internal ``promote_dtype`` computes in the
+policy dtype rather than re-promoting to fp32 against fp32 params.
+
+Because interception happens at trace time under ``jax.jit``, the per-call
+wrapper cost the reference pays in eager mode (cast cache, dict lookups —
+`apex/amp/utils.py:77-123`) is compiled away entirely.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+from apex_tpu.amp.policy import Policy, policy_scope
+from apex_tpu.utils import tree_cast
+
+
+def make_interceptor(policy: Policy):
+    """Build a flax interceptor applying ``policy``'s op cast tables."""
+    import flax.linen as nn
+
+    half_mods, float_mods = lists._flax_module_tables()
+    half = jnp.dtype(policy.half_dtype)
+
+    def interceptor(next_fun, args, kwargs, context):
+        if not policy.enabled:
+            return next_fun(*args, **kwargs)
+        mod = context.module
+        if context.method_name != "__call__":
+            return next_fun(*args, **kwargs)
+        if isinstance(mod, float_mods):
+            # blacklist: norms/statistics in fp32
+            args = tree_cast(args, jnp.float32)
+            kwargs = tree_cast(kwargs, jnp.float32)
+            _retarget_dtype(mod, jnp.float32)
+        elif isinstance(mod, half_mods):
+            # whitelist: MXU ops in half
+            args = tree_cast(args, half)
+            kwargs = tree_cast(kwargs, half)
+            _retarget_dtype(mod, half)
+        return next_fun(*args, **kwargs)
+
+    return interceptor
+
+
+def _retarget_dtype(mod, dtype) -> None:
+    """Point ``mod.dtype`` at the policy dtype for this call.
+
+    flax modules are frozen dataclasses, but ``dtype`` is a plain field read
+    at call time by ``promote_dtype`` — retargeting it on the live instance
+    (the same escape hatch flax itself uses for internal state) makes the
+    module compute in ``dtype`` while its params stay in ``param_dtype``.
+    Only touched when the user left ``dtype=None`` (the flax default), so an
+    explicit user choice always wins — mirroring the reference rule that user
+    registrations out-prioritise the built-in lists (`apex/amp/amp.py:94-114`).
+    """
+    if hasattr(mod, "dtype") and getattr(mod, "dtype") is None:
+        object.__setattr__(mod, "dtype", dtype)
+
+
+@contextlib.contextmanager
+def auto_cast(policy: Policy):
+    """Context manager enabling automatic per-module casting for flax models.
+
+    Usage::
+
+        with amp.auto_cast(policy):
+            logits = model.apply(variables, x)
+
+    Also binds ``policy`` as the ambient policy for ``apex_tpu.ops``.
+    """
+    import flax.linen as nn
+
+    with policy_scope(policy):
+        with nn.intercept_methods(make_interceptor(policy)):
+            yield

@@ -1,0 +1,292 @@
+"""Precision policy: the TPU-native replacement for amp opt-levels.
+
+The reference configures precision with an ``amp.initialize(...,
+opt_level="O1")`` call that validates a ``Properties`` struct and then rewires
+the interpreter (`apex/amp/frontend.py:7-358`). Here the same knobs live in an
+immutable :class:`Policy` value:
+
+- O0: pure fp32 (`frontend.py:166-191`)
+- O1: fp32 params, per-op cast policy (MXU ops in half, reductions/losses in
+  fp32), dynamic loss scaling (`frontend.py:144-163`)
+- O2: half model + fp32 batchnorm + fp32 master weights, dynamic loss scaling
+  (`frontend.py:122-141`)
+- O3: pure half, no master weights (`frontend.py:102-119`)
+
+On TPU the half dtype defaults to **bfloat16**, which shares fp32's exponent
+range — loss scaling then becomes optional (``loss_scale=None``) and O1/O2
+degenerate to cheap dtype policies. ``half_dtype=jnp.float16`` restores exact
+reference semantics (with the scaler) for parity testing.
+
+A policy is *applied*, never patched in: ``policy_scope(policy)`` sets the
+ambient policy consulted by ``apex_tpu.ops``/``apex_tpu.layers`` at trace
+time, and :func:`cast_params` / :func:`cast_to_compute` do the explicit
+casts. Because tracing happens once under ``jax.jit``, the "patching" cost the
+reference pays per call (`apex/amp/wrap.py:10-29`) is paid once at compile
+time here.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp import lists
+from apex_tpu.utils import tree_cast
+
+_DtypeLike = Any
+
+
+def _canon(dt):
+    return jnp.dtype(dt) if dt is not None else None
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Immutable precision policy (the reference's validated ``Properties``).
+
+    Attributes:
+      opt_level: "O0" | "O1" | "O2" | "O3" (or None for a custom policy).
+      enabled: master switch; disabled == O0 semantics.
+      half_dtype: the low precision dtype (bfloat16 on TPU; float16 for
+        reference-parity runs).
+      cast_model_type: dtype model params are cast to for the forward pass
+        (None = leave fp32; O2/O3 set this to half_dtype).
+      patch_ops: per-op cast policy active (O1) — ops listed HALF compute in
+        half_dtype, FLOAT ops in fp32, PROMOTE ops widen.
+      keep_batchnorm_fp32: exempt batch/layer-norm scale/offset (and their
+        statistics) from model casts (O2).
+      master_weights: optimizer holds fp32 masters and the update runs in
+        fp32 regardless of model dtype (O1/O2).
+      loss_scale: "dynamic", a float (static), or None (no scaling).
+      output_dtype: dtype model outputs are cast back to (fp32 by default,
+        mirroring the patched-forward output cast, `_initialize.py:194-201`).
+    """
+
+    opt_level: Optional[str] = None
+    enabled: bool = True
+    half_dtype: _DtypeLike = jnp.bfloat16
+    cast_model_type: Optional[_DtypeLike] = None
+    patch_ops: bool = False
+    keep_batchnorm_fp32: bool = False
+    master_weights: bool = True
+    loss_scale: Union[str, float, None] = None
+    output_dtype: Optional[_DtypeLike] = jnp.float32
+
+    # ---- construction ------------------------------------------------------
+
+    @classmethod
+    def from_opt_level(cls, opt_level: str, *, half_dtype=jnp.bfloat16,
+                       **overrides) -> "Policy":
+        """Build a preset policy then apply per-field overrides.
+
+        Mirrors ``amp.initialize``'s "apply opt_level, then explicit kwargs
+        win" contract (`frontend.py:308-356`).
+        """
+        half_dtype = _canon(half_dtype)
+        # fp16 needs loss scaling; bf16 has fp32's range so scaling is
+        # unnecessary unless explicitly requested.
+        default_scale = "dynamic" if half_dtype == jnp.float16 else None
+        presets = {
+            "O0": dict(enabled=True, cast_model_type=None, patch_ops=False,
+                       keep_batchnorm_fp32=False, master_weights=False,
+                       loss_scale=None, half_dtype=half_dtype),
+            "O1": dict(enabled=True, cast_model_type=None, patch_ops=True,
+                       keep_batchnorm_fp32=False, master_weights=False,
+                       loss_scale=default_scale, half_dtype=half_dtype),
+            "O2": dict(enabled=True, cast_model_type=half_dtype,
+                       patch_ops=False, keep_batchnorm_fp32=True,
+                       master_weights=True, loss_scale=default_scale,
+                       half_dtype=half_dtype),
+            "O3": dict(enabled=True, cast_model_type=half_dtype,
+                       patch_ops=False, keep_batchnorm_fp32=False,
+                       master_weights=False, loss_scale=1.0,
+                       half_dtype=half_dtype),
+        }
+        if opt_level not in presets:
+            raise ValueError(
+                f"Unexpected optimization level {opt_level!r}; options are "
+                "'O0', 'O1', 'O2', 'O3'.")
+        kwargs = presets[opt_level]
+        kwargs.update(overrides)
+        policy = cls(opt_level=opt_level, **kwargs)
+        policy.validate()
+        return policy
+
+    def replace(self, **overrides) -> "Policy":
+        p = dataclasses.replace(self, **overrides)
+        p.validate()
+        return p
+
+    def validate(self) -> None:
+        """Cross-field consistency checks (`Properties.__setattr__` checks,
+        `frontend.py:51-97`)."""
+        half = _canon(self.half_dtype)
+        if half not in (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16)):
+            raise ValueError(f"half_dtype must be bfloat16 or float16, got {half}")
+        cm = _canon(self.cast_model_type)
+        if cm is not None and jnp.issubdtype(cm, jnp.floating) is False:
+            raise ValueError(f"cast_model_type must be a float dtype, got {cm}")
+        if self.patch_ops and cm is not None and cm != jnp.dtype(jnp.float32):
+            raise ValueError(
+                "patch_ops (O1-style op policy) expects fp32 params; "
+                "combining it with a cast model is not supported "
+                "(matches the reference's O1/O2 exclusivity).")
+        if isinstance(self.loss_scale, str) and self.loss_scale != "dynamic":
+            raise ValueError("loss_scale must be a float, 'dynamic', or None")
+        fp16_compute = (cm == jnp.dtype(jnp.float16)
+                        or (self.patch_ops and half == jnp.dtype(jnp.float16)))
+        if fp16_compute and self.loss_scale is None and self.enabled:
+            raise ValueError(
+                "float16 compute without loss scaling will underflow; pass "
+                "loss_scale='dynamic' (or a static scale).")
+
+    # ---- dtype queries -----------------------------------------------------
+
+    @property
+    def compute_dtype(self):
+        """Dtype MXU-class ops run in under this policy."""
+        if not self.enabled:
+            return jnp.dtype(jnp.float32)
+        if self.cast_model_type is not None:
+            return _canon(self.cast_model_type)
+        if self.patch_ops:
+            return _canon(self.half_dtype)
+        return jnp.dtype(jnp.float32)
+
+    @property
+    def param_dtype(self):
+        """Storage dtype of the params the *model* consumes."""
+        if self.enabled and self.cast_model_type is not None:
+            return _canon(self.cast_model_type)
+        return jnp.dtype(jnp.float32)
+
+    @property
+    def uses_loss_scaling(self) -> bool:
+        return self.enabled and self.loss_scale is not None
+
+    def op_dtype(self, op_name: str, *input_dtypes):
+        """Resolve the compute dtype for a named op under this policy.
+
+        This is the runtime of the reference's wrapped-namespace dispatch
+        (`apex/amp/wrap.py`): HALF ops get ``half_dtype``, FLOAT ops fp32,
+        PROMOTE ops the widest input dtype, neutral ops the first input
+        dtype. Raises for banned ops in half precision.
+        """
+        if not self.enabled:
+            return jnp.dtype(jnp.float32)
+        kind = lists.classify(op_name)
+        if kind == "banned":
+            if self.patch_ops or self.cast_model_type is not None:
+                raise TypeError(lists.BANNED_MESSAGE.format(
+                    name=op_name, dtype=self.half_dtype))
+            return jnp.dtype(jnp.float32)
+        if not self.patch_ops and self.cast_model_type is None:
+            # O0-style: no op policy, respect inputs
+            return _promote(input_dtypes) if input_dtypes else jnp.float32
+        if kind == "half":
+            return _canon(self.half_dtype)
+        if kind == "float":
+            return jnp.dtype(jnp.float32)
+        if kind == "promote":
+            return _promote(input_dtypes)
+        # neutral: match first floating input, else fp32
+        return _promote(input_dtypes[:1]) if input_dtypes else jnp.float32
+
+    # ---- casting helpers ---------------------------------------------------
+
+    def _bn_exempt(self, path, _leaf) -> bool:
+        """True if a param at ``path`` should stay fp32 (batchnorm-style).
+
+        Matched per path *component* against normalization-layer naming
+        conventions (flax's ``BatchNorm_0``/``LayerNorm_0``, our
+        ``batch_norm``/``sync_batch_norm``, and the ``batch_stats``
+        collection) — not by substring over the whole path, so e.g. a module
+        named ``subnet`` is not exempted.
+        """
+        names = [str(getattr(k, "key", getattr(k, "name", k))).lower()
+                 for k in path]
+        return any(_NORM_COMPONENT_RE.match(n) for n in names)
+
+    def cast_params(self, params):
+        """Cast a param tree to the model dtype (``convert_network``,
+        `apex/fp16_utils/fp16util.py:75-100`). Norm params stay fp32 when
+        ``keep_batchnorm_fp32``."""
+        if not self.enabled or self.cast_model_type is None:
+            return params
+        pred = ((lambda p, x: not self._bn_exempt(p, x))
+                if self.keep_batchnorm_fp32 else None)
+        return tree_cast(params, _canon(self.cast_model_type), predicate=pred)
+
+    def cast_inputs(self, tree):
+        """Cast floating inputs to the model compute dtype (the patched
+        ``model.forward`` input cast, `_initialize.py:194-198`)."""
+        if not self.enabled or self.cast_model_type is None:
+            return tree
+        return tree_cast(tree, _canon(self.cast_model_type))
+
+    def cast_outputs(self, tree):
+        """Cast floating outputs to ``output_dtype`` (fp32 by default)."""
+        if not self.enabled or self.output_dtype is None:
+            return tree
+        return tree_cast(tree, _canon(self.output_dtype))
+
+    def cast_to_compute(self, tree):
+        """Cast floating leaves to :attr:`compute_dtype`."""
+        return tree_cast(tree, self.compute_dtype)
+
+
+import re
+
+_NORM_COMPONENT_RE = re.compile(
+    r"^(bn\d*"                                    # bn, bn1 ...
+    r"|batch_?norm.*|sync_?batch_?norm.*"          # batch_norm*, syncbn modules
+    r"|(layer|group|rms|instance)_?norm.*"         # other norm layers
+    r"|norm(_\d+)?"                                # bare norm / norm_0
+    r"|batch_stats)$"                              # flax BN statistics
+)
+
+
+def _promote(dtypes):
+    dts = [jnp.dtype(d) for d in dtypes if d is not None]
+    dts = [d for d in dts if jnp.issubdtype(d, jnp.floating)]
+    if not dts:
+        return jnp.dtype(jnp.float32)
+    out = dts[0]
+    for d in dts[1:]:
+        out = jnp.promote_types(out, d)
+    return out
+
+
+# --- Ambient policy ---------------------------------------------------------
+#
+# The policy consulted by apex_tpu.ops / apex_tpu.layers when none is passed
+# explicitly. Thread-local because tracing is thread-confined; inside a jitted
+# function the scope binds at trace time, so there is zero runtime cost.
+
+class _PolicyState(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_state = _PolicyState()
+
+_DEFAULT_POLICY = Policy(opt_level="O0", enabled=False)
+
+
+def current_policy() -> Policy:
+    return _state.stack[-1] if _state.stack else _DEFAULT_POLICY
+
+
+@contextlib.contextmanager
+def policy_scope(policy: Policy):
+    """Bind ``policy`` as the ambient policy for ops built while tracing."""
+    _state.stack.append(policy)
+    try:
+        yield policy
+    finally:
+        _state.stack.pop()

@@ -1,0 +1,209 @@
+"""Functional dynamic loss scaling — no host syncs, no step patching.
+
+The reference's ``LossScaler`` (`apex/amp/scaler.py:33-215`) keeps a CUDA
+overflow flag filled by the multi-tensor kernels, reads it back with
+``.item()`` once per iteration (`scaler.py:197-200` — a forced
+device-to-host sync), and on overflow monkey-patches ``optimizer.step`` to
+skip once (`apex/amp/handle.py:128-154`).
+
+Here the scaler is explicit state threaded through the jitted train step:
+
+    cfg   = LossScaleConfig()                  # dynamic, 2^16, x2/2000, /2
+    state = loss_scale_init(cfg)
+    ...
+    grads, finite = unscale_grads(grads, state)
+    state = loss_scale_update(state, finite, cfg)
+    params = tree_select(finite, new_params, params)   # skip == don't select
+
+Everything stays on device; the "skipped step" is a `jnp.where` select, and
+momentum/step counters simply aren't advanced for the skipped branch (the
+property `tests/L0/run_amp/test_fused_sgd.py` asserts bitwise).
+
+Scale schedule parity (`apex/amp/scaler.py:12-31,197-215`):
+  * init 2**16, growth x2 every 2000 consecutive finite steps,
+  * backoff x0.5 on overflow, clamped to [min_loss_scale, max_loss_scale],
+  * max defaults to 2**24.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils import tree_all_finite, tree_select
+
+
+class LossScaleConfig(NamedTuple):
+    """Static scaler configuration (hashable; safe to close over in jit)."""
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    min_loss_scale: Optional[float] = None
+    max_loss_scale: float = 2.0 ** 24
+    dynamic: bool = True
+
+    @classmethod
+    def from_policy_field(cls, loss_scale):
+        """Build from a Policy.loss_scale field ('dynamic' | float | None)."""
+        if loss_scale is None:
+            return None
+        if loss_scale == "dynamic":
+            return cls(dynamic=True)
+        return cls(init_scale=float(loss_scale), dynamic=False)
+
+
+class LossScaleState(NamedTuple):
+    """Dynamic scaler state: a pytree, checkpointable like any other state.
+
+    The reference round-trips this through ``amp.state_dict()``
+    (`apex/amp/frontend.py:361-400`); here it is just part of the train state.
+    """
+    loss_scale: jax.Array      # f32 scalar
+    growth_tracker: jax.Array  # i32 scalar: consecutive finite steps
+
+
+def loss_scale_init(cfg: Optional[LossScaleConfig]) -> Optional[LossScaleState]:
+    if cfg is None:
+        return None
+    return LossScaleState(
+        loss_scale=jnp.float32(cfg.init_scale),
+        growth_tracker=jnp.int32(0),
+    )
+
+
+def scale_loss(loss, state: Optional[LossScaleState]):
+    """``loss.float() * loss_scale`` (`apex/amp/handle.py:113`)."""
+    loss = jnp.asarray(loss, jnp.float32)
+    if state is None:
+        return loss
+    return loss * state.loss_scale
+
+
+def unscale_grads(grads, state: Optional[LossScaleState], *,
+                  upcast_to=jnp.float32):
+    """Multiply grads by 1/scale (in fp32) and report global finiteness.
+
+    The fused analogue of ``LossScaler.unscale`` (`apex/amp/scaler.py:94-125`):
+    one traversal producing fp32 grads + a single on-device finite flag.
+    On the overflow branch grads are garbage but never consumed — the caller
+    selects the old state via :func:`apex_tpu.utils.tree_select`.
+    """
+    if state is None:
+        finite = tree_all_finite(grads)
+        if upcast_to is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(upcast_to)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+        return grads, finite
+    inv = (1.0 / state.loss_scale).astype(jnp.float32)
+
+    def _unscale(g):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        out = g.astype(jnp.float32) * inv
+        target = g.dtype if upcast_to is None else upcast_to
+        return out if target == jnp.float32 else out.astype(target)
+
+    grads = jax.tree_util.tree_map(_unscale, grads)
+    finite = tree_all_finite(grads)
+    return grads, finite
+
+
+def unscale_grads_with_stashed(grads, stashed, state: Optional[LossScaleState],
+                               *, stashed_scale=1.0):
+    """Gradient accumulation across backward passes at (possibly) different
+    scales: ``out = stashed * (stashed_scale/new_scale? ...) + grads / scale``.
+
+    Parity with ``unscale_with_stashed`` / ``multi_tensor_axpby``
+    (`apex/amp/scaler.py:152-190`): the stashed fp32 grads were already
+    unscaled (or carry ``stashed_scale``), the incoming grads carry the
+    current scale; both are combined in fp32 in one pass.
+    """
+    inv = 1.0 if state is None else (1.0 / state.loss_scale)
+
+    def _axpby(g, s):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        g32 = g.astype(jnp.float32) * inv
+        s32 = s.astype(jnp.float32) * stashed_scale
+        return g32 + s32
+
+    out = jax.tree_util.tree_map(_axpby, grads, stashed)
+    finite = tree_all_finite(out)
+    return out, finite
+
+
+def loss_scale_update(state: Optional[LossScaleState], grads_finite,
+                      cfg: Optional[LossScaleConfig]):
+    """Advance the scale schedule — entirely on device.
+
+    Parity with ``LossScaler.update_scale`` (`apex/amp/scaler.py:197-215`):
+    overflow → scale *= backoff (clamped below by ``min_loss_scale``),
+    tracker reset; else tracker += 1, and at ``growth_interval`` scale *=
+    growth (clamped above by ``max_loss_scale``), tracker reset.
+    """
+    if state is None or cfg is None:
+        return state
+    if not cfg.dynamic:
+        return state
+
+    scale = state.loss_scale
+    tracker = state.growth_tracker
+
+    backoff = scale * cfg.backoff_factor
+    if cfg.min_loss_scale is not None:
+        backoff = jnp.maximum(backoff, cfg.min_loss_scale)
+
+    grown_tracker = tracker + 1
+    should_grow = grown_tracker >= cfg.growth_interval
+    grown = jnp.minimum(scale * cfg.growth_factor, cfg.max_loss_scale)
+
+    new_scale = jnp.where(
+        grads_finite,
+        jnp.where(should_grow, grown, scale),
+        backoff).astype(jnp.float32)
+    new_tracker = jnp.where(
+        grads_finite,
+        jnp.where(should_grow, 0, grown_tracker),
+        0).astype(jnp.int32)
+    return LossScaleState(loss_scale=new_scale, growth_tracker=new_tracker)
+
+
+def select_if_finite(grads_finite, new_tree, old_tree):
+    """Commit ``new_tree`` where grads were finite, else keep ``old_tree``.
+
+    The functional skipped-step: replaces the reference's one-shot
+    ``optimizer.step`` patch + master-grad zeroing (`handle.py:128-154`).
+    """
+    return tree_select(grads_finite, new_tree, old_tree)
+
+
+# --- Convenience: scaled value-and-grad -------------------------------------
+
+def value_and_scaled_grad(loss_fn, cfg: Optional[LossScaleConfig], *,
+                          has_aux: bool = False, upcast_to=jnp.float32):
+    """Wrap ``loss_fn(params, *args) -> loss`` into
+    ``f(params, scaler_state, *args) -> ((loss, aux?), grads, new_state, finite)``.
+
+    The functional equivalent of the ``with amp.scale_loss(...) as scaled:
+    scaled.backward()`` block (`apex/amp/handle.py:16-158`): scales the loss
+    before differentiation, unscales the grads in fp32, folds the finiteness
+    check in, and advances the scale schedule. The returned loss/aux are the
+    *unscaled* values.
+    """
+
+    def wrapped(params, scaler_state, *args, **kwargs):
+        def scaled_loss(p):
+            out = loss_fn(p, *args, **kwargs)
+            loss = out[0] if has_aux else out
+            return scale_loss(loss, scaler_state), out
+
+        grads, out = jax.grad(scaled_loss, has_aux=True)(params)
+        grads, finite = unscale_grads(grads, scaler_state, upcast_to=upcast_to)
+        new_state = loss_scale_update(scaler_state, finite, cfg)
+        return out, grads, new_state, finite
+
+    return wrapped

@@ -1,0 +1,17 @@
+from apex_tpu.utils.tree import (
+    tree_cast,
+    tree_all_finite,
+    tree_select,
+    tree_zeros_like,
+    tree_size,
+    global_norm,
+)
+
+__all__ = [
+    "tree_cast",
+    "tree_all_finite",
+    "tree_select",
+    "tree_zeros_like",
+    "tree_size",
+    "global_norm",
+]

@@ -1,0 +1,93 @@
+"""Pytree utilities shared across the framework.
+
+These are the functional equivalents of the reference's tensor-list plumbing
+(`apex/multi_tensor_apply`, `apex/fp16_utils/fp16util.py`): where Apex walks
+Python lists of tensors, apex_tpu maps over pytrees and lets XLA fuse.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def tree_cast(tree, dtype, predicate=None):
+    """Cast every floating-point array leaf to ``dtype``.
+
+    ``predicate(path, leaf)`` may veto the cast per-leaf (used for
+    ``keep_batchnorm_fp32``-style exemptions). Non-float leaves and
+    non-array leaves (None, strings, Python scalars — weak-typed in JAX)
+    pass through. numpy arrays are cast like jax arrays so eager/host-side
+    batches behave the same as traced ones.
+    """
+    if dtype is None:
+        return tree
+
+    def _cast(path, x):
+        if not isinstance(x, (jax.Array, np.ndarray)):
+            return x
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            return x
+        if predicate is not None and not predicate(path, x):
+            return x
+        return jnp.asarray(x).astype(dtype) if isinstance(x, np.ndarray) \
+            else x.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(_cast, tree)
+
+
+def tree_all_finite(tree):
+    """Single boolean scalar: True iff every element of every leaf is finite.
+
+    The on-device analogue of the reference's ``_overflow_buf`` (a GPU flag
+    written by the multi-tensor kernels and read back with ``.item()``,
+    `apex/amp/scaler.py:197-200`). Here the flag stays on device; step-skipping
+    is data-dependent `jnp.where`, never a host sync.
+    """
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    if not leaves:
+        return jnp.bool_(True)
+    finites = [jnp.all(jnp.isfinite(x)) for x in leaves]
+    return jnp.stack(finites).all()
+
+
+def tree_select(pred, on_true, on_false):
+    """Elementwise ``jnp.where(pred, a, b)`` over two matching pytrees.
+
+    Used to commit-or-skip an optimizer update on overflow: functional state
+    makes the reference's reversible-update machinery
+    (`distributed_fused_adam.py:509-533`) unnecessary — we simply do not
+    select the new state.
+    """
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(pred, a, b), on_true, on_false)
+
+
+def tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_size(tree):
+    """Total element count over all leaves."""
+    return sum(x.size for x in jax.tree_util.tree_leaves(tree))
+
+
+def global_norm(tree, ord=2):
+    """Global L2 (or Linf) norm over all leaves, computed in fp32.
+
+    Functional counterpart of ``amp_C.multi_tensor_l2norm``
+    (`csrc/multi_tensor_l2norm_kernel.cu`); the per-arena Pallas version lives
+    in ``apex_tpu.ops.multi_tensor``.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.float32(0.0)
+    if ord == 2:
+        sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+        return jnp.sqrt(sq)
+    elif ord == jnp.inf or ord == "inf":
+        return jnp.stack(
+            [jnp.max(jnp.abs(x.astype(jnp.float32))) for x in leaves]).max()
+    raise ValueError(f"unsupported ord={ord}")

@@ -1,0 +1,40 @@
+"""Test configuration: run everything on a simulated 8-device CPU mesh.
+
+The reference's test tiers all require real GPUs (SURVEY.md §4). We do better:
+JAX can expose N virtual CPU devices, so every distributed-semantics test in
+this suite runs hostside in CI with no accelerator. Pallas kernels detect the
+CPU backend and fall back to interpreter mode (see apex_tpu.ops._dispatch).
+
+This must run before any other module initialises a JAX backend. The image's
+sitecustomize force-registers the 'axon' TPU platform, so selecting CPU via
+environment variables is not enough — we override the config directly.
+"""
+
+import jax
+import pytest
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+# Tests compare against fp32 references; keep matmuls at full fp32 precision.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices), ("data",))
+
+
+@pytest.fixture(scope="session")
+def mesh4x2(devices):
+    import numpy as np
+    from jax.sharding import Mesh
+    return Mesh(np.array(devices).reshape(4, 2), ("data", "model"))

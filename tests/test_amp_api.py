@@ -1,0 +1,206 @@
+"""End-to-end Amp bundle tests: init → train steps → overflow → checkpoint.
+
+Functional mirror of `tests/L0/run_amp/test_checkpointing.py` and the
+multi-loss DCGAN pattern (`examples/dcgan/main_amp.py:215-253`).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+
+
+def _toy_params(key=0):
+    k = jax.random.PRNGKey(key)
+    return {"dense": {"kernel": jax.random.normal(k, (4, 4)),
+                      "bias": jnp.zeros((4,))}}
+
+
+def _loss_fn(model_params, x):
+    y = x @ model_params["dense"]["kernel"] + model_params["dense"]["bias"]
+    return jnp.mean(jnp.square(y))
+
+
+class TestAmpStep:
+    @pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+    def test_loss_decreases(self, opt_level):
+        amp_opt, state = amp.initialize(
+            _toy_params(), optax.sgd(0.1), opt_level)
+        x = jnp.ones((8, 4))
+
+        @jax.jit
+        def step(state):
+            return amp_opt.step(state, _loss_fn, x)
+
+        losses = []
+        for _ in range(10):
+            state, loss, finite = step(state)
+            assert bool(finite)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    def test_o2_masters_stay_fp32(self):
+        amp_opt, state = amp.initialize(_toy_params(), optax.sgd(0.1), "O2")
+        assert state.params["dense"]["kernel"].dtype == jnp.float32
+        model_p = amp_opt.model_params(state)
+        assert model_p["dense"]["kernel"].dtype == jnp.bfloat16
+
+    def test_o3_params_half(self):
+        amp_opt, state = amp.initialize(_toy_params(), optax.sgd(0.1), "O3")
+        assert state.params["dense"]["kernel"].dtype == jnp.bfloat16
+
+    def test_fp16_overflow_skips_step(self):
+        """Poisoned grads: step must not move params, scale must halve
+        (`test_fused_sgd.py` overflow-injection pattern)."""
+        amp_opt, state = amp.initialize(
+            _toy_params(), optax.sgd(0.1), "O2", half_dtype=jnp.float16)
+
+        def bad_loss(model_params, x):
+            return jnp.sum(model_params["dense"]["kernel"]) * jnp.inf
+
+        before = np.asarray(state.params["dense"]["kernel"])
+        scale_before = float(state.scalers[0].loss_scale)
+        state, _, finite = jax.jit(
+            lambda s: amp_opt.step(s, bad_loss, jnp.ones((2, 4))))(state)
+        assert not bool(finite)
+        np.testing.assert_array_equal(
+            np.asarray(state.params["dense"]["kernel"]), before)
+        assert float(state.scalers[0].loss_scale) == scale_before / 2
+        assert int(state.step) == 0  # skipped steps don't count
+
+    def test_multi_loss_independent_scalers(self):
+        amp_opt, state = amp.initialize(
+            _toy_params(), optax.sgd(0.1), "O2", half_dtype=jnp.float16,
+            num_losses=2)
+
+        def bad_loss(mp, x):
+            return jnp.sum(mp["dense"]["kernel"]) * jnp.inf
+
+        _, _, state, finite = amp_opt.backward(
+            state, bad_loss, jnp.ones((2, 4)), loss_id=1)
+        assert not bool(finite)
+        # scaler 1 backed off; scaler 0 untouched
+        assert float(state.scalers[1].loss_scale) == 2.0 ** 15
+        assert float(state.scalers[0].loss_scale) == 2.0 ** 16
+
+    def test_state_dict_roundtrip(self):
+        amp_opt, state = amp.initialize(
+            _toy_params(), optax.sgd(0.1), "O2", half_dtype=jnp.float16)
+        # advance the scaler, then round-trip through state_dict
+        _, _, state, _ = amp_opt.backward(
+            state, _loss_fn, jnp.ones((2, 4)))
+        sd = amp_opt.state_dict(state)
+        fresh = amp_opt.init(_toy_params())
+        restored = amp_opt.load_state_dict(fresh, sd)
+        assert (float(restored.scalers[0].loss_scale)
+                == float(state.scalers[0].loss_scale))
+        assert (int(restored.scalers[0].growth_tracker)
+                == int(state.scalers[0].growth_tracker))
+
+    def test_checkpoint_resume_continues_identically(self):
+        """Train 3 steps, checkpoint (pytree), restore, continue — identical
+        to an uninterrupted run (`test_checkpointing.py:1-267` semantics)."""
+        tx = optax.adam(1e-2)
+        amp_opt, state = amp.initialize(_toy_params(), tx, "O2")
+        x = jnp.ones((8, 4))
+        step = jax.jit(lambda s: amp_opt.step(s, _loss_fn, x))
+
+        for _ in range(3):
+            state, _, _ = step(state)
+        # "checkpoint": the whole AmpState is a pytree; serialize via numpy
+        ckpt = jax.tree_util.tree_map(np.asarray, state)
+        restored = jax.tree_util.tree_map(jnp.asarray, ckpt)
+
+        out_a, out_b = state, restored
+        for _ in range(3):
+            out_a, la, _ = step(out_a)
+            out_b, lb, _ = step(out_b)
+            assert float(la) == float(lb)
+        np.testing.assert_array_equal(
+            np.asarray(out_a.params["dense"]["kernel"]),
+            np.asarray(out_b.params["dense"]["kernel"]))
+
+
+class TestFlaxAutoCast:
+    """O1 ergonomics on an unmodified flax model."""
+
+    def _model(self):
+        import flax.linen as nn
+
+        class Net(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(8)(x)
+                x = nn.LayerNorm()(x)
+                x = nn.Dense(4)(x)
+                return x
+        return Net()
+
+    def test_auto_cast_runs_dense_in_half(self):
+        import flax.linen as nn
+        model = self._model()
+        x = jnp.ones((2, 8))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        policy = amp.Policy.from_opt_level("O1")
+
+        seen = {}
+        half_mods, float_mods = (nn.Dense,), (nn.LayerNorm,)
+
+        def spy(next_fun, args, kwargs, context):
+            if isinstance(context.module, half_mods + float_mods) \
+                    and context.method_name == "__call__":
+                seen.setdefault(type(context.module).__name__,
+                                jnp.asarray(args[0]).dtype)
+            return next_fun(*args, **kwargs)
+
+        with amp.auto_cast(policy):
+            with nn.intercept_methods(spy):
+                out = model.apply(variables, x)
+        assert seen["Dense"] == jnp.bfloat16      # whitelist cast
+        assert seen["LayerNorm"] == jnp.float32   # blacklist cast
+        # params stayed fp32 (O1 keeps fp32 weights)
+        assert variables["params"]["Dense_0"]["kernel"].dtype == jnp.float32
+
+    def test_auto_cast_grads_flow(self):
+        model = self._model()
+        x = jnp.ones((2, 8))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        policy = amp.Policy.from_opt_level("O1")
+
+        def loss(params):
+            with amp.auto_cast(policy):
+                return jnp.mean(model.apply({"params": params}, x) ** 2)
+
+        grads = jax.grad(loss)(variables["params"])
+        # grads are w.r.t. fp32 params
+        assert grads["Dense_0"]["kernel"].dtype == jnp.float32
+        assert float(jnp.abs(grads["Dense_0"]["kernel"]).sum()) > 0
+
+
+class TestDecorators:
+    def test_half_float_promote(self):
+        policy = amp.Policy.from_opt_level("O1")
+
+        @amp.half_function
+        def h(x):
+            return x.dtype
+
+        @amp.float_function
+        def f(x):
+            return x.dtype
+
+        @amp.promote_function
+        def p(x, y):
+            return x.dtype, y.dtype
+
+        x32 = jnp.ones((2,), jnp.float32)
+        x16 = jnp.ones((2,), jnp.bfloat16)
+        with amp.policy_scope(policy):
+            assert h(x32) == jnp.bfloat16
+            assert f(x16) == jnp.float32
+            assert p(x16, x32) == (jnp.float32, jnp.float32)
+        # outside the scope: no casting
+        assert h(x32) == jnp.float32

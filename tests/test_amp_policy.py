@@ -1,0 +1,190 @@
+"""Policy preset & cast-table semantics.
+
+Mirrors the reference's opt-level/property checks (`apex/amp/frontend.py`)
+and cast-list classification tests (`tests/L0/run_amp/test_basic_casts.py`,
+`test_promotion.py`) at the policy level.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import amp
+
+
+class TestPresets:
+    def test_o0_is_pure_fp32(self):
+        p = amp.Policy.from_opt_level("O0")
+        assert p.compute_dtype == jnp.float32
+        assert p.param_dtype == jnp.float32
+        assert p.cast_model_type is None
+        assert not p.uses_loss_scaling
+
+    def test_o1_patches_ops_keeps_fp32_params(self):
+        p = amp.Policy.from_opt_level("O1")
+        assert p.patch_ops
+        assert p.param_dtype == jnp.float32
+        assert p.compute_dtype == jnp.bfloat16
+
+    def test_o2_half_model_fp32_bn_masters(self):
+        p = amp.Policy.from_opt_level("O2")
+        assert p.cast_model_type == jnp.bfloat16
+        assert p.keep_batchnorm_fp32
+        assert p.master_weights
+
+    def test_o3_pure_half(self):
+        p = amp.Policy.from_opt_level("O3")
+        assert p.cast_model_type == jnp.bfloat16
+        assert not p.keep_batchnorm_fp32
+        assert not p.master_weights
+
+    def test_fp16_presets_get_dynamic_scaling(self):
+        # fp16 needs a scaler; bf16 defaults to none (full exponent range)
+        p16 = amp.Policy.from_opt_level("O2", half_dtype=jnp.float16)
+        assert p16.loss_scale == "dynamic"
+        pbf = amp.Policy.from_opt_level("O2")
+        assert pbf.loss_scale is None
+
+    def test_overrides_win(self):
+        # explicit kwargs beat the preset, as in amp.initialize
+        p = amp.Policy.from_opt_level("O2", keep_batchnorm_fp32=False,
+                                      loss_scale=128.0)
+        assert not p.keep_batchnorm_fp32
+        assert p.loss_scale == 128.0
+
+    def test_bad_opt_level_raises(self):
+        with pytest.raises(ValueError):
+            amp.Policy.from_opt_level("O4")
+
+    def test_fp16_without_scaler_rejected(self):
+        with pytest.raises(ValueError):
+            amp.Policy.from_opt_level("O2", half_dtype=jnp.float16,
+                                      loss_scale=None)
+
+
+class TestOpPolicy:
+    """Dtype propagation tables (`test_basic_casts.py` semantics)."""
+
+    def test_o1_half_ops(self):
+        p = amp.Policy.from_opt_level("O1")
+        for op in ("conv2d", "dense", "matmul", "attention"):
+            assert p.op_dtype(op, jnp.float32) == jnp.bfloat16
+
+    def test_o1_float_ops(self):
+        p = amp.Policy.from_opt_level("O1")
+        for op in ("softmax", "layer_norm", "cross_entropy", "exp", "sum"):
+            assert p.op_dtype(op, jnp.bfloat16) == jnp.float32
+
+    def test_o1_promote_ops_widen(self):
+        p = amp.Policy.from_opt_level("O1")
+        assert p.op_dtype("add", jnp.bfloat16, jnp.float32) == jnp.float32
+        assert p.op_dtype("add", jnp.bfloat16, jnp.bfloat16) == jnp.bfloat16
+
+    def test_o0_respects_inputs(self):
+        p = amp.Policy.from_opt_level("O0")
+        assert p.op_dtype("dense", jnp.float32) == jnp.float32
+
+    def test_banned_op_raises_under_half(self):
+        p = amp.Policy.from_opt_level("O1")
+        with pytest.raises(TypeError):
+            p.op_dtype("binary_cross_entropy", jnp.bfloat16)
+
+    def test_registration(self):
+        amp.register_half_op("my_custom_gemm")
+        p = amp.Policy.from_opt_level("O1")
+        assert p.op_dtype("my_custom_gemm") == jnp.bfloat16
+        amp.register_float_op("my_custom_gemm")
+        assert p.op_dtype("my_custom_gemm") == jnp.float32
+
+
+class TestCasting:
+    def _params(self):
+        return {
+            "conv": {"kernel": jnp.ones((3, 3, 4, 8), jnp.float32)},
+            "batch_norm": {"scale": jnp.ones((8,), jnp.float32),
+                           "bias": jnp.zeros((8,), jnp.float32)},
+            "step": jnp.int32(3),
+        }
+
+    def test_o2_cast_keeps_bn_fp32(self):
+        p = amp.Policy.from_opt_level("O2")
+        cast = p.cast_params(self._params())
+        assert cast["conv"]["kernel"].dtype == jnp.bfloat16
+        assert cast["batch_norm"]["scale"].dtype == jnp.float32
+        assert cast["step"].dtype == jnp.int32  # non-float untouched
+
+    def test_o3_casts_everything_floating(self):
+        p = amp.Policy.from_opt_level("O3")
+        cast = p.cast_params(self._params())
+        assert cast["batch_norm"]["scale"].dtype == jnp.bfloat16
+
+    def test_output_cast_is_fp32(self):
+        p = amp.Policy.from_opt_level("O2")
+        out = p.cast_outputs({"logits": jnp.ones((2,), jnp.bfloat16)})
+        assert out["logits"].dtype == jnp.float32
+
+    def test_policy_scope_ambient(self):
+        p = amp.Policy.from_opt_level("O1")
+        assert not amp.current_policy().enabled
+        with amp.policy_scope(p):
+            assert amp.current_policy() is p
+        assert not amp.current_policy().enabled
+
+
+class TestReviewRegressions:
+    """Regressions from code review: substring exemption, numpy leaves,
+    O1-fp16 validation."""
+
+    def test_subnet_is_not_bn_exempt(self):
+        p = amp.Policy.from_opt_level("O2")
+        params = {"subnet": {"kernel": jnp.ones((2, 2), jnp.float32)},
+                  "enormous": {"kernel": jnp.ones((2,), jnp.float32)},
+                  "BatchNorm_0": {"scale": jnp.ones((2,), jnp.float32)},
+                  "LayerNorm_3": {"scale": jnp.ones((2,), jnp.float32)},
+                  "bn1": {"scale": jnp.ones((2,), jnp.float32)}}
+        cast = p.cast_params(params)
+        assert cast["subnet"]["kernel"].dtype == jnp.bfloat16
+        assert cast["enormous"]["kernel"].dtype == jnp.bfloat16
+        assert cast["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert cast["LayerNorm_3"]["scale"].dtype == jnp.float32
+        assert cast["bn1"]["scale"].dtype == jnp.float32
+
+    def test_numpy_leaves_are_cast(self):
+        import numpy as np
+        from apex_tpu.utils import tree_cast
+        out = tree_cast({"x": np.ones((3,), np.float32)}, jnp.bfloat16)
+        assert out["x"].dtype == jnp.bfloat16
+
+    def test_o1_fp16_without_scaler_rejected(self):
+        with pytest.raises(ValueError):
+            amp.Policy.from_opt_level("O1", half_dtype=jnp.float16,
+                                      loss_scale=None)
+
+    def test_amp_step_binds_ambient_policy(self):
+        import optax
+        from apex_tpu.amp.api import Amp
+        policy = amp.Policy.from_opt_level("O1")
+        amp_opt = Amp(policy, optax.sgd(0.1))
+        state = amp_opt.init({"w": jnp.ones((2,))})
+        seen = {}
+
+        def loss_fn(mp, x):
+            seen["policy"] = amp.current_policy()
+            return jnp.sum(mp["w"] * x)
+
+        amp_opt.backward(state, loss_fn, jnp.ones((2,)))
+        assert seen["policy"] is policy
+
+    def test_unscale_preserves_dtype_when_upcast_none(self):
+        cfg = amp.LossScaleConfig(init_scale=4.0)
+        st = amp.loss_scale_init(cfg)
+        g = {"w": jnp.ones((2,), jnp.bfloat16) * 4}
+        out, _ = amp.unscale_grads(g, st, upcast_to=None)
+        assert out["w"].dtype == jnp.bfloat16
+
+    def test_stashed_unscale_skips_int_leaves(self):
+        cfg = amp.LossScaleConfig(init_scale=2.0)
+        st = amp.loss_scale_init(cfg)
+        g = {"w": jnp.ones((2,)) * 2, "count": jnp.int32(5)}
+        s = {"w": jnp.ones((2,)), "count": jnp.int32(7)}
+        out, _ = amp.unscale_grads_with_stashed(g, s, st)
+        assert out["count"].dtype == jnp.int32

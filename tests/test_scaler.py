@@ -1,0 +1,129 @@
+"""Dynamic loss scaler semantics.
+
+Mirrors the schedule the reference implements in `apex/amp/scaler.py:197-215`
+and the overflow-skip property asserted by `tests/L0/run_amp/test_fused_sgd.py`
+(skipped steps advance nothing), all on-device under jit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import amp
+from apex_tpu.utils import tree_select
+
+
+def test_init_scale():
+    cfg = amp.LossScaleConfig()
+    st = amp.loss_scale_init(cfg)
+    assert float(st.loss_scale) == 2.0 ** 16
+    assert int(st.growth_tracker) == 0
+
+
+def test_scale_and_unscale_roundtrip():
+    cfg = amp.LossScaleConfig(init_scale=512.0)
+    st = amp.loss_scale_init(cfg)
+    loss = jnp.float32(2.0)
+    assert float(amp.scale_loss(loss, st)) == 1024.0
+    grads = {"w": jnp.full((4,), 512.0 * 3.0, jnp.bfloat16)}
+    un, finite = amp.unscale_grads(grads, st)
+    assert un["w"].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(un["w"]), 3.0)
+    assert bool(finite)
+
+
+def test_backoff_on_overflow():
+    cfg = amp.LossScaleConfig(init_scale=2.0 ** 16)
+    st = amp.loss_scale_init(cfg)
+    grads = {"w": jnp.array([1.0, jnp.inf], jnp.float32)}
+    _, finite = amp.unscale_grads(grads, st)
+    assert not bool(finite)
+    st2 = amp.loss_scale_update(st, finite, cfg)
+    assert float(st2.loss_scale) == 2.0 ** 15
+    assert int(st2.growth_tracker) == 0
+
+
+def test_growth_after_interval():
+    cfg = amp.LossScaleConfig(init_scale=4.0, growth_interval=3)
+    st = amp.loss_scale_init(cfg)
+    finite = jnp.bool_(True)
+    for _ in range(2):
+        st = amp.loss_scale_update(st, finite, cfg)
+        assert float(st.loss_scale) == 4.0
+    st = amp.loss_scale_update(st, finite, cfg)  # third finite step: grow
+    assert float(st.loss_scale) == 8.0
+    assert int(st.growth_tracker) == 0
+
+
+def test_growth_clamped_at_max():
+    cfg = amp.LossScaleConfig(init_scale=2.0 ** 24, growth_interval=1)
+    st = amp.loss_scale_init(cfg)
+    st = amp.loss_scale_update(st, jnp.bool_(True), cfg)
+    assert float(st.loss_scale) == 2.0 ** 24  # clamp, `scaler.py:203-213`
+
+
+def test_backoff_clamped_at_min():
+    cfg = amp.LossScaleConfig(init_scale=2.0, min_loss_scale=1.5)
+    st = amp.loss_scale_init(cfg)
+    st = amp.loss_scale_update(st, jnp.bool_(False), cfg)
+    assert float(st.loss_scale) == 1.5
+
+
+def test_static_scale_never_moves():
+    cfg = amp.LossScaleConfig(init_scale=128.0, dynamic=False)
+    st = amp.loss_scale_init(cfg)
+    st = amp.loss_scale_update(st, jnp.bool_(False), cfg)
+    assert float(st.loss_scale) == 128.0
+
+
+def test_overflow_interleaving_matches_reference_schedule():
+    """Inject overflows at chosen iterations (the `test_fused_sgd` pattern)
+    and check the exact scale trajectory."""
+    cfg = amp.LossScaleConfig(init_scale=2.0 ** 8, growth_interval=2)
+    st = amp.loss_scale_init(cfg)
+    # finite, finite (grow), overflow (halve), finite, finite (grow)
+    expected = [2.0 ** 8, 2.0 ** 9, 2.0 ** 8, 2.0 ** 8, 2.0 ** 9]
+    seq = [True, True, False, True, True]
+    got = []
+    for ok in seq:
+        st = amp.loss_scale_update(st, jnp.bool_(ok), cfg)
+        got.append(float(st.loss_scale))
+    assert got == expected
+
+
+def test_unscale_with_stashed_accumulation():
+    """Cross-backward grad accumulation math (`scaler.py:152-190`)."""
+    cfg = amp.LossScaleConfig(init_scale=256.0)
+    st = amp.loss_scale_init(cfg)
+    stashed = {"w": jnp.full((3,), 5.0, jnp.float32)}      # already unscaled
+    grads = {"w": jnp.full((3,), 256.0 * 2.0, jnp.float32)}  # carries scale
+    out, finite = amp.unscale_grads_with_stashed(grads, stashed, st)
+    np.testing.assert_allclose(np.asarray(out["w"]), 7.0)
+    assert bool(finite)
+
+
+def test_value_and_scaled_grad_under_jit():
+    cfg = amp.LossScaleConfig(init_scale=1024.0)
+    st = amp.loss_scale_init(cfg)
+
+    def loss_fn(params, x):
+        return jnp.sum(params["w"] * x)
+
+    f = jax.jit(amp.value_and_scaled_grad(loss_fn, cfg))
+    params = {"w": jnp.arange(4.0)}
+    x = jnp.ones((4,)) * 2.0
+    loss, grads, new_st, finite = f(params, st, x)
+    np.testing.assert_allclose(float(loss), float(jnp.sum(params["w"] * x)))
+    np.testing.assert_allclose(np.asarray(grads["w"]), 2.0)  # unscaled
+    assert bool(finite)
+    assert int(new_st.growth_tracker) == 1
+
+
+def test_skip_commit_semantics():
+    """Overflow step: params and optimizer state unmoved via tree_select."""
+    params = {"w": jnp.ones((2,))}
+    new_params = {"w": jnp.zeros((2,))}
+    out = tree_select(jnp.bool_(False), new_params, params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+    out = tree_select(jnp.bool_(True), new_params, params)
+    np.testing.assert_allclose(np.asarray(out["w"]), 0.0)
