@@ -139,11 +139,18 @@ class Amp:
         The skipped step neither moves params nor advances optimizer
         state/step count — the bitwise property the reference tests demand
         (`tests/L0/run_amp/test_fused_sgd.py`).
+
+        Fused apex_tpu optimizers expose ``step`` (new params directly, one
+        arena kernel); optax transforms go through ``update`` + tree add.
         """
-        updates, new_opt_state = self.tx.update(
-            grads, state.opt_state, state.params)
-        new_params = jax.tree_util.tree_map(
-            lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
+        if hasattr(self.tx, "step") and callable(getattr(self.tx, "step")):
+            new_params, new_opt_state = self.tx.step(
+                grads, state.opt_state, state.params)
+        else:
+            updates, new_opt_state = self.tx.update(
+                grads, state.opt_state, state.params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
         committed_params = tree_select(grads_finite, new_params, state.params)
         committed_opt = tree_select(grads_finite, new_opt_state,
                                     state.opt_state)

@@ -1,0 +1,27 @@
+"""apex_tpu.arena — flat parameter arena (multi-tensor-apply substrate).
+
+See SURVEY.md §2.3/§2.10: the reference marshals tensor lists into batched
+CUDA launches; apex_tpu lays parameters out flat per dtype so one Pallas
+kernel covers the whole model. Layout math runs in native C++ (csrc/) with a
+Python fallback.
+"""
+
+from apex_tpu.arena.arena import (
+    ArenaSpec,
+    DEFAULT_ALIGNMENT,
+    bucket_ids,
+    flatten,
+    plan,
+    segment_ids,
+    shard_pad,
+    unflatten,
+    valid_mask,
+    zeros,
+)
+from apex_tpu.arena.native import native_available
+
+__all__ = [
+    "ArenaSpec", "DEFAULT_ALIGNMENT", "bucket_ids", "flatten", "plan",
+    "segment_ids", "shard_pad", "unflatten", "valid_mask", "zeros",
+    "native_available",
+]

@@ -1,0 +1,227 @@
+"""Flat parameter arena: the substrate for fused optimizer kernels.
+
+The reference's ``multi_tensor_apply`` machinery exists because CUDA kernels
+cannot efficiently take a Python list of tensors: `multi_tensor_apply.cuh:
+15-103` packs up to 110 tensor pointers into kernel-arg structs per launch.
+On TPU the idiomatic equivalent is to *lay the tensors out flat*: one
+contiguous 1-D buffer per dtype, each tensor in an aligned slot, so a single
+Pallas kernel (or one fused XLA loop) updates every parameter with zero
+per-tensor launch or marshalling overhead — and so ZeRO sharding is a pure
+slice of the arena (`distributed_fused_adam.py:99-148` does the same with
+128-byte aligned offsets).
+
+The layout math (offsets/padding/buckets/shards) is computed by the native
+planner (apex_tpu/csrc/arena_planner.cpp via ctypes, Python fallback); the
+device-side gather/scatter is jitted XLA, fused into the surrounding step.
+
+Usage::
+
+    spec = arena.plan(params)                     # static layout
+    flat = arena.flatten(params, spec)            # {dtype: 1-D buffer}
+    params2 = arena.unflatten(flat, spec)         # exact round-trip
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.arena import native
+
+# Default slot alignment in elements: 1024 = 8 sublanes x 128 lanes, so any
+# slot start maps to a fp32 tile boundary when a buffer is viewed (-1, 128).
+DEFAULT_ALIGNMENT = 1024
+
+# Buffers are padded to a multiple of this so Pallas kernels can tile the
+# (-1, 128) view into exact (512, 128) blocks with no remainder handling.
+BUFFER_MULTIPLE = 512 * 128
+
+
+@dataclasses.dataclass(frozen=True)
+class _Partition:
+    """Layout of one dtype's flat buffer (all entries static Python ints)."""
+    dtype: str
+    sizes: Tuple[int, ...]     # true element counts, leaf order
+    offsets: Tuple[int, ...]   # aligned slot starts
+    padded: Tuple[int, ...]    # aligned slot sizes
+    total: int                 # sum of padded slot sizes
+    buffer_len: int            # total rounded up to BUFFER_MULTIPLE
+
+
+@dataclasses.dataclass(frozen=True)
+class ArenaSpec:
+    """Static arena layout. Hashable → safe to close over under jit."""
+    treedef: Any
+    leaf_shapes: Tuple[Tuple[int, ...], ...]
+    leaf_dtypes: Tuple[str, ...]
+    leaf_partition: Tuple[Tuple[str, int], ...]  # (dtype key, index in part.)
+    partitions: Tuple[_Partition, ...]
+    alignment: int
+
+    def __hash__(self):
+        return hash((self.treedef, self.leaf_shapes, self.leaf_dtypes,
+                     self.alignment))
+
+    @property
+    def dtypes(self):
+        return tuple(p.dtype for p in self.partitions)
+
+    def partition(self, dtype) -> _Partition:
+        key = str(jnp.dtype(dtype))
+        for p in self.partitions:
+            if p.dtype == key:
+                return p
+        raise KeyError(f"no arena partition for dtype {key}")
+
+    @property
+    def total_elements(self) -> int:
+        return sum(p.total for p in self.partitions)
+
+
+def plan(tree, alignment: int = DEFAULT_ALIGNMENT) -> ArenaSpec:
+    """Compute the static arena layout for a pytree of arrays.
+
+    Leaves are partitioned by dtype (the reference partitions its tensor
+    lists the same way before each multi_tensor launch,
+    `apex/optimizers/fused_adam.py:149-174`) and given aligned slots within
+    their partition's flat buffer.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(tuple(x.shape) for x in leaves)
+    dtypes = tuple(str(jnp.asarray(x).dtype) for x in leaves)
+
+    by_dtype: Dict[str, list] = {}
+    for i, dt in enumerate(dtypes):
+        by_dtype.setdefault(dt, []).append(i)
+
+    partitions = []
+    leaf_partition: list = [None] * len(leaves)
+    for dt in sorted(by_dtype):
+        idxs = by_dtype[dt]
+        sizes = np.array([int(np.prod(shapes[i])) if shapes[i] else 1
+                          for i in idxs], np.int64)
+        offsets, padded, total = native.plan_layout(sizes, alignment)
+        buffer_len = -(-int(total) // BUFFER_MULTIPLE) * BUFFER_MULTIPLE
+        partitions.append(_Partition(
+            dtype=dt, sizes=tuple(int(s) for s in sizes),
+            offsets=tuple(int(o) for o in offsets),
+            padded=tuple(int(p) for p in padded), total=int(total),
+            buffer_len=buffer_len))
+        for j, i in enumerate(idxs):
+            leaf_partition[i] = (dt, j)
+
+    return ArenaSpec(treedef=treedef, leaf_shapes=shapes, leaf_dtypes=dtypes,
+                     leaf_partition=tuple(leaf_partition),
+                     partitions=tuple(partitions), alignment=alignment)
+
+
+def flatten(tree, spec: ArenaSpec, cast=None) -> Dict[str, jax.Array]:
+    """Pack a pytree into per-dtype flat buffers (jit-friendly).
+
+    Padding elements are zero, so reductions over the raw buffer (l2 norms,
+    finiteness checks) are safe without masking.
+
+    ``cast`` re-types every buffer (e.g. ``cast=jnp.float32`` to flatten
+    fp32 grads using the *param* tree's layout — buffers stay keyed by the
+    partition's original dtype name so they line up slot-for-slot with the
+    param buffers).
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    assert len(leaves) == len(spec.leaf_shapes), "tree/spec mismatch"
+    parts: Dict[str, list] = {p.dtype: [None] * len(p.sizes)
+                              for p in spec.partitions}
+    for leaf, (dt, j) in zip(leaves, spec.leaf_partition):
+        part = spec.partition(dt)
+        x = jnp.ravel(jnp.asarray(leaf))
+        if cast is not None:
+            x = x.astype(cast)
+        pad = part.padded[j] - part.sizes[j]
+        if pad:
+            x = jnp.pad(x, (0, pad))
+        parts[dt][j] = x
+    out = {}
+    for dt, chunks in parts.items():
+        part = spec.partition(dt)
+        buf_dtype = jnp.dtype(cast) if cast is not None else jnp.dtype(dt)
+        buf = (jnp.concatenate(chunks) if chunks
+               else jnp.zeros((0,), buf_dtype))
+        if part.buffer_len > part.total:
+            buf = jnp.pad(buf, (0, part.buffer_len - part.total))
+        out[dt] = buf
+    return out
+
+
+def unflatten(buffers: Dict[str, jax.Array], spec: ArenaSpec):
+    """Exact inverse of :func:`flatten`."""
+    leaves = []
+    for shape, dt_name, (dt, j) in zip(spec.leaf_shapes, spec.leaf_dtypes,
+                                       spec.leaf_partition):
+        part = spec.partition(dt)
+        buf = buffers[dt]
+        x = jax.lax.dynamic_slice_in_dim(buf, part.offsets[j], part.sizes[j])
+        leaves.append(jnp.reshape(x, shape))
+    return jax.tree_util.tree_unflatten(spec.treedef, leaves)
+
+
+def zeros(spec: ArenaSpec, dtype=None) -> Dict[str, jax.Array]:
+    """Fresh zeroed arena buffers (optimizer-state allocation).
+
+    With ``dtype`` set, every partition's state buffer uses that dtype
+    (e.g. fp32 master/momentum state for a bf16 param arena).
+    """
+    return {p.dtype: jnp.zeros((p.buffer_len,),
+                               jnp.dtype(dtype) if dtype else jnp.dtype(p.dtype))
+            for p in spec.partitions}
+
+
+def segment_ids(spec: ArenaSpec, dtype) -> np.ndarray:
+    """Host-side i32 map arena-position → tensor index (-1 in padding).
+
+    Enables per-tensor reductions over the flat buffer in one pass
+    (``jax.ops.segment_sum``) — how per-layer norms (NovoGrad, LAMB trust
+    ratios) run without per-tensor kernel launches.
+    """
+    part = spec.partition(dtype)
+    ids = np.full((part.buffer_len,), -1, np.int32)
+    for j, (off, size) in enumerate(zip(part.offsets, part.sizes)):
+        ids[off:off + size] = j
+    return ids
+
+
+def valid_mask(spec: ArenaSpec, dtype) -> np.ndarray:
+    """Host-side bool mask of non-padding positions."""
+    return segment_ids(spec, dtype) >= 0
+
+
+def bucket_ids(spec: ArenaSpec, dtype, bucket_elems: int) -> np.ndarray:
+    """Greedy message-size bucketing of a partition's slots (native planner).
+
+    Kept for parity with DDP's ``message_size`` bucket tuning
+    (`apex/parallel/distributed.py:363-394`); under XLA the same knob is the
+    all-reduce combine threshold, but explicit buckets are used by the
+    manual-overlap paths.
+    """
+    part = spec.partition(dtype)
+    ids, _ = native.plan_buckets(np.array(part.padded, np.int64), bucket_elems)
+    out = np.full((part.buffer_len,), -1, np.int32)
+    for j, (off, size) in enumerate(zip(part.offsets, part.padded)):
+        out[off:off + size] = int(ids[j])
+    return out
+
+
+def shard_pad(buffers: Dict[str, jax.Array], world_size: int,
+              alignment: int = DEFAULT_ALIGNMENT):
+    """Pad each buffer so its length divides evenly into ``world_size``
+    aligned shards (ZeRO layout, `distributed_fused_adam.py:99-148`)."""
+    out = {}
+    for dt, buf in buffers.items():
+        _, per = native.plan_shards(buf.shape[0], world_size, alignment)
+        total = per * world_size
+        if total > buf.shape[0]:
+            buf = jnp.pad(buf, (0, total - buf.shape[0]))
+        out[dt] = buf
+    return out

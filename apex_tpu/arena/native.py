@@ -1,0 +1,126 @@
+"""ctypes bridge to the native layout planner (apex_tpu/csrc).
+
+Loads ``apex_tpu/_native/libapex_tpu.so`` if present (built by
+``make -C apex_tpu/csrc``), attempts an on-demand build once if not, and
+falls back to pure-Python implementations with identical semantics — the
+same graceful degradation the reference uses for its extensions
+(`apex/parallel/__init__.py:14-19`, `apex/amp/scaler.py:39-52`).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "..", "_native", "libapex_tpu.so")
+_CSRC = os.path.join(_HERE, "..", "csrc")
+
+_lib = None
+_load_failed = False
+_tried_build = False
+
+
+def _load():
+    """Load (building once if needed) the native planner; None on any
+    failure — a stale or mis-built .so (missing symbol, ABI mismatch) must
+    degrade to the Python fallback, never crash."""
+    global _lib, _load_failed, _tried_build
+    if _lib is not None or _load_failed:
+        return _lib
+    if not os.path.exists(_SO_PATH) and not _tried_build:
+        _tried_build = True
+        try:
+            subprocess.run(["make", "-C", _CSRC], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            pass
+    if os.path.exists(_SO_PATH):
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.apex_plan_layout.restype = ctypes.c_int64
+            lib.apex_plan_buckets.restype = ctypes.c_int64
+            lib.apex_plan_shards.restype = ctypes.c_int64
+            lib.apex_native_abi_version.restype = ctypes.c_int64
+            if lib.apex_native_abi_version() == 1:
+                _lib = lib
+        except (OSError, AttributeError):
+            pass
+    if _lib is None:
+        _load_failed = True  # don't retry CDLL on every planner call
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_i64(arr):
+    return np.ascontiguousarray(arr, dtype=np.int64)
+
+
+def plan_layout(sizes, alignment):
+    """(offsets, padded, total) for aligned slot layout."""
+    lib = _load()
+    sizes = _as_i64(sizes)
+    n = len(sizes)
+    if lib is not None and n:
+        offsets = np.empty(n, np.int64)
+        padded = np.empty(n, np.int64)
+        total = lib.apex_plan_layout(
+            ctypes.c_int64(n),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(alignment),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            padded.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return offsets, padded, int(total)
+    # Python fallback — identical semantics
+    alignment = max(int(alignment), 1)
+    padded = (sizes + alignment - 1) // alignment * alignment
+    offsets = np.concatenate([[0], np.cumsum(padded)[:-1]]).astype(np.int64) \
+        if n else np.zeros(0, np.int64)
+    return offsets, padded, int(padded.sum())
+
+
+def plan_buckets(padded, bucket_elems):
+    """(bucket_ids, num_buckets) — greedy size-capped bucketing."""
+    lib = _load()
+    padded = _as_i64(padded)
+    n = len(padded)
+    if lib is not None and n:
+        ids = np.empty(n, np.int64)
+        nb = lib.apex_plan_buckets(
+            ctypes.c_int64(n),
+            padded.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int64(bucket_elems),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return ids, int(nb)
+    bucket_elems = max(int(bucket_elems), 1)
+    ids = np.zeros(n, np.int64)
+    bucket = fill = 0
+    for i in range(n):
+        if fill > 0 and fill + padded[i] > bucket_elems:
+            bucket += 1
+            fill = 0
+        ids[i] = bucket
+        fill += int(padded[i])
+    return ids, (bucket + 1 if n else 0)
+
+
+def plan_shards(total_elems, world_size, alignment):
+    """(shard_starts, shard_size) — equal aligned ZeRO shards."""
+    lib = _load()
+    if lib is not None and world_size > 0:
+        starts = np.empty(world_size, np.int64)
+        per = lib.apex_plan_shards(
+            ctypes.c_int64(total_elems), ctypes.c_int64(world_size),
+            ctypes.c_int64(alignment),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return starts, int(per)
+    alignment = max(int(alignment), 1)
+    per = -(-total_elems // world_size)
+    per = -(-per // alignment) * alignment
+    return np.arange(world_size, dtype=np.int64) * per, int(per)

@@ -1,0 +1,86 @@
+// Arena layout planner — native host-side bookkeeping for the flat
+// parameter arena (apex_tpu.arena).
+//
+// TPU-native counterpart of the reference's apex_C native module
+// (csrc/flatten_unflatten.cpp:15-17): where apex_C packs CUDA tensor lists
+// into flat buffers for DDP buckets, this planner computes the aligned
+// slot layout (offsets, padded sizes, bucket boundaries) that the JAX-side
+// flatten/unflatten and the Pallas multi-tensor kernels consume. The device
+// copies themselves are XLA's job; the layout math is host-native.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+//
+// Build: make -C apex_tpu/csrc  ->  apex_tpu/_native/libapex_tpu.so
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Compute aligned offsets for n tensors of the given element counts.
+//
+//  sizes[n]     : element count per tensor
+//  alignment    : slot alignment in elements (power of two, e.g. 1024 so a
+//                 flat buffer reshaped to (-1, 128) keeps every tensor
+//                 starting on an (8,128) fp32 tile boundary)
+//  offsets[n]   : out — start offset of each tensor slot
+//  padded[n]    : out — aligned slot size of each tensor
+//  returns      : total arena size in elements (aligned)
+int64_t apex_plan_layout(int64_t n, const int64_t* sizes, int64_t alignment,
+                         int64_t* offsets, int64_t* padded) {
+  if (alignment <= 0) alignment = 1;
+  int64_t cursor = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    offsets[i] = cursor;
+    int64_t p = (sizes[i] + alignment - 1) / alignment * alignment;
+    padded[i] = p;
+    cursor += p;
+  }
+  return cursor;
+}
+
+// Greedy bucket assignment by cumulative slot size — the layout-time
+// analogue of DDP's message_size bucketing (the reference builds buckets
+// from backward arrival order and broadcasts rank 0's structure,
+// apex/parallel/distributed.py:363-394; with XLA the order is static so
+// buckets are a pure function of the layout).
+//
+//  padded[n]       : aligned slot sizes (from apex_plan_layout)
+//  bucket_elems    : target bucket size in elements (message_size)
+//  bucket_ids[n]   : out — bucket index per tensor (monotone)
+//  returns         : number of buckets
+int64_t apex_plan_buckets(int64_t n, const int64_t* padded,
+                          int64_t bucket_elems, int64_t* bucket_ids) {
+  if (bucket_elems <= 0) bucket_elems = 1;
+  int64_t bucket = 0, fill = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (fill > 0 && fill + padded[i] > bucket_elems) {
+      ++bucket;
+      fill = 0;
+    }
+    bucket_ids[i] = bucket;
+    fill += padded[i];
+  }
+  return n > 0 ? bucket + 1 : 0;
+}
+
+// Partition a flat arena of total_elems into world_size equal shards,
+// aligned so every shard boundary falls on `alignment` elements — the
+// ZeRO-1 shard map (reference: 128-byte aligned block/chunk/shard split,
+// apex/contrib/optimizers/distributed_fused_adam.py:99-148).
+//
+//  returns shard size in elements (total padded up as needed);
+//  shard_starts[world_size] receives each shard's start offset.
+int64_t apex_plan_shards(int64_t total_elems, int64_t world_size,
+                         int64_t alignment, int64_t* shard_starts) {
+  if (world_size <= 0) return 0;
+  if (alignment <= 0) alignment = 1;
+  int64_t per = (total_elems + world_size - 1) / world_size;
+  per = (per + alignment - 1) / alignment * alignment;
+  for (int64_t i = 0; i < world_size; ++i) shard_starts[i] = i * per;
+  return per;
+}
+
+int64_t apex_native_abi_version() { return 1; }
+
+}  // extern "C"

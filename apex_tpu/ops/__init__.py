@@ -1,0 +1,21 @@
+"""apex_tpu.ops — fused Pallas kernels (SURVEY.md §2.10).
+
+Every native module of the reference maps here: the `amp_C` multi-tensor
+family (multi_tensor.py), the optimizer functors (optim_kernels.py), fused
+LayerNorm / MLP / softmax-CE / NHWC BatchNorm / attention (their own
+modules). All kernels run compiled on TPU and in interpret mode elsewhere.
+"""
+
+from apex_tpu.ops.multi_tensor import (
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_maxnorm,
+    multi_tensor_scale,
+    per_tensor_l2norm,
+)
+from apex_tpu.ops import optim_kernels
+
+__all__ = [
+    "multi_tensor_axpby", "multi_tensor_l2norm", "multi_tensor_maxnorm",
+    "multi_tensor_scale", "per_tensor_l2norm", "optim_kernels",
+]

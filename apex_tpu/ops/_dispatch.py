@@ -1,0 +1,102 @@
+"""Backend dispatch for Pallas kernels.
+
+Compiled Mosaic kernels require a real TPU; every kernel in apex_tpu runs in
+Pallas interpret mode on other backends (the CI CPU mesh), preserving
+semantics bit-for-bit at jnp precision. This mirrors the reference's
+"Python-only build degrades gracefully" contract
+(`apex/amp/scaler.py:39-52`) — except nothing is unavailable here, only
+uncompiled.
+
+``APEX_TPU_FORCE_INTERPRET=1`` forces interpret mode everywhere (debugging).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def use_interpret() -> bool:
+    if os.environ.get("APEX_TPU_FORCE_INTERPRET") == "1":
+        return True
+    return jax.default_backend() != "tpu"
+
+
+# Rows per grid step for flat-buffer elementwise kernels. A (512, 128) fp32
+# block is 256 KiB — small enough that an 8-operand optimizer kernel stays
+# well under the ~16 MiB VMEM budget with double buffering, large enough to
+# saturate HBM bandwidth.
+BLOCK_ROWS = 512
+LANES = 128
+
+
+def as_rows(buf):
+    """View a flat arena buffer as (rows, 128). Arena buffers are padded to
+    BUFFER_MULTIPLE so rows % BLOCK_ROWS == 0 always holds."""
+    n = buf.shape[0]
+    assert n % (BLOCK_ROWS * LANES) == 0, (
+        f"arena buffer length {n} not a multiple of "
+        f"{BLOCK_ROWS * LANES}; use apex_tpu.arena.flatten")
+    return buf.reshape(n // LANES, LANES)
+
+
+def launch(kernel, inputs, outs, scalars=None):
+    """Shared pallas_call plumbing for flat-buffer elementwise kernels.
+
+    The single launch convention every arena kernel uses (the analogue of
+    the reference's `multi_tensor_apply.cuh` launcher): a 1-D grid over
+    (BLOCK_ROWS, 128) VMEM blocks of each input buffer, an optional f32
+    hyperparameter vector in SMEM prepended to the kernel args, and outputs
+    that are either per-block buffers or (1,1) SMEM scalar accumulators
+    revisited by every grid step (TPU grids are sequential, so
+    read-modify-write accumulation is well-defined; Mosaic requires scalar
+    stores to target SMEM, not VMEM).
+
+    ``outs`` is a list of ("block", dtype) | ("scalar", dtype) entries.
+    Block outputs come back as flat buffers, scalar outputs as (1, 1)
+    arrays, in order.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows_arrs = [as_rows(b) for b in inputs]
+    rows = rows_arrs[0].shape[0]
+    block = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+    scalar = pl.BlockSpec((1, 1), lambda i: (0, 0),
+                          memory_space=pltpu.SMEM)
+
+    in_specs = [block] * len(rows_arrs)
+    args = tuple(rows_arrs)
+    if scalars is not None:
+        in_specs = [pl.BlockSpec(memory_space=pltpu.SMEM)] + in_specs
+        args = (jnp.asarray(scalars, jnp.float32),) + args
+
+    out_specs, out_shapes = [], []
+    for kind, dt in outs:
+        if kind == "block":
+            out_specs.append(block)
+            out_shapes.append(jax.ShapeDtypeStruct((rows, LANES),
+                                                   jnp.dtype(dt)))
+        elif kind == "scalar":
+            out_specs.append(scalar)
+            out_shapes.append(jax.ShapeDtypeStruct((1, 1), jnp.dtype(dt)))
+        else:
+            raise ValueError(f"unknown out kind {kind!r}")
+
+    results = pl.pallas_call(
+        kernel,
+        grid=(rows // BLOCK_ROWS,),
+        in_specs=in_specs,
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shapes),
+        interpret=use_interpret(),
+    )(*args)
+    if not isinstance(results, (list, tuple)):
+        results = (results,)
+    final = tuple(r.reshape(-1) if kind == "block" else r
+                  for r, (kind, _) in zip(results, outs))
+    return final if len(final) > 1 else final[0]

@@ -1,0 +1,140 @@
+"""Arena-wide fused elementwise kernels: the `amp_C` trio.
+
+TPU-native rebuild of the reference's multi-tensor-apply family
+(`csrc/multi_tensor_scale_kernel.cu`, `multi_tensor_axpby_kernel.cu`,
+`multi_tensor_l2norm_kernel.cu`, launcher `csrc/multi_tensor_apply.cuh`):
+instead of packing ≤110 tensor pointers into kernel-arg structs per launch,
+the tensors already live in one flat arena buffer (apex_tpu.arena) and a
+single Pallas kernel walks it in (512, 128) VMEM blocks via the shared
+launcher (apex_tpu.ops._dispatch.launch).
+
+Every op keeps the reference's overflow-flag contract: `scale`/`axpby` also
+produce a scalar "all finite" flag computed in the same pass (the CUDA
+kernels write a `noop_flag` on inf/nan, `multi_tensor_scale_kernel.cu:30-70`),
+except the flag stays on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from apex_tpu.ops._dispatch import launch
+
+
+# --- multi_tensor_scale ------------------------------------------------------
+
+def _scale_kernel(scalars, x_ref, out_ref, flag_ref):
+    i = pl.program_id(0)
+    scale = scalars[0]
+    y = x_ref[:].astype(jnp.float32) * scale
+
+    @pl.when(i == 0)
+    def _():
+        flag_ref[0, 0] = 0.0
+
+    # inf/nan in the *output* sets the noop flag (reference checks the
+    # converted value, multi_tensor_scale_kernel.cu:57-63)
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(y)))
+    flag_ref[0, 0] = flag_ref[0, 0] + jnp.where(bad, 1.0, 0.0)
+    out_ref[:] = y.astype(out_ref.dtype)
+
+
+def multi_tensor_scale(buf, scale, *, out_dtype=None):
+    """``out = buf * scale`` over a flat arena buffer, with overflow flag.
+
+    Returns ``(out, all_finite)``. Used for grad unscale (model→master copy
+    with 1/loss_scale) and master→model copy-back, exactly the two places
+    the reference launches `amp_C.multi_tensor_scale`
+    (`apex/amp/scaler.py:94-125`, `_process_optimizer.py:354-364`).
+    """
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else buf.dtype
+    out, flag = launch(
+        _scale_kernel, [buf],
+        outs=[("block", out_dtype), ("scalar", jnp.float32)],
+        scalars=[scale])
+    return out, flag[0, 0] == 0.0
+
+
+# --- multi_tensor_axpby ------------------------------------------------------
+
+def _axpby_kernel(scalars, x_ref, y_ref, out_ref, flag_ref):
+    i = pl.program_id(0)
+    a, b = scalars[0], scalars[1]
+    r = (a * x_ref[:].astype(jnp.float32)
+         + b * y_ref[:].astype(jnp.float32))
+
+    @pl.when(i == 0)
+    def _():
+        flag_ref[0, 0] = 0.0
+
+    bad = jnp.logical_not(jnp.all(jnp.isfinite(r)))
+    flag_ref[0, 0] = flag_ref[0, 0] + jnp.where(bad, 1.0, 0.0)
+    out_ref[:] = r.astype(out_ref.dtype)
+
+
+def multi_tensor_axpby(a, x, b, y, *, out_dtype=None):
+    """``out = a*x + b*y`` with overflow flag — stashed-gradient
+    accumulation (`apex/amp/scaler.py:152-190`,
+    `csrc/multi_tensor_axpby_kernel.cu:28-90`). Returns (out, all_finite)."""
+    out_dtype = jnp.dtype(out_dtype) if out_dtype else x.dtype
+    out, flag = launch(
+        _axpby_kernel, [x, y],
+        outs=[("block", out_dtype), ("scalar", jnp.float32)],
+        scalars=[a, b])
+    return out, flag[0, 0] == 0.0
+
+
+# --- multi_tensor_l2norm -----------------------------------------------------
+
+def _l2norm_kernel(x_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[0, 0] = 0.0
+
+    x = x_ref[:].astype(jnp.float32)
+    acc_ref[0, 0] = acc_ref[0, 0] + jnp.sum(x * x)
+
+
+def multi_tensor_l2norm(buf):
+    """Global L2 norm of a flat arena buffer (fp32 accumulate).
+
+    One-kernel version of the two-stage partial+cleanup reduction
+    (`csrc/multi_tensor_l2norm_kernel.cu:28-113`): TPU grids run
+    sequentially on-core, so the partial sums accumulate in a revisited
+    (1,1) SMEM scalar. Arena padding is zero, so no masking is needed.
+    """
+    acc = launch(_l2norm_kernel, [buf], outs=[("scalar", jnp.float32)])
+    return jnp.sqrt(acc[0, 0])
+
+
+def _maxnorm_kernel(x_ref, acc_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[0, 0] = 0.0
+
+    acc_ref[0, 0] = jnp.maximum(
+        acc_ref[0, 0], jnp.max(jnp.abs(x_ref[:].astype(jnp.float32))))
+
+
+def multi_tensor_maxnorm(buf):
+    """Global max-abs (Linf) — `MaxNormFunctor`
+    (`multi_tensor_l2norm_kernel.cu:113-160`)."""
+    acc = launch(_maxnorm_kernel, [buf], outs=[("scalar", jnp.float32)])
+    return acc[0, 0]
+
+
+def per_tensor_l2norm(buf, segment_ids, num_tensors):
+    """Per-tensor L2 norms over the arena in one pass (`multi_tensor_l2norm`
+    with ``per_tensor=True``). ``segment_ids`` maps arena position → tensor
+    index (-1 padding); returns (num_tensors,) f32 norms."""
+    sq = jnp.square(buf.astype(jnp.float32))
+    sums = jax.ops.segment_sum(sq, jnp.maximum(segment_ids, 0),
+                               num_segments=num_tensors)
+    # padding contributes zeros (buf padding is 0), so no correction needed
+    return jnp.sqrt(sums)

@@ -1,0 +1,22 @@
+"""apex_tpu.optim — fused optimizers (SURVEY.md §2.4, §2.6).
+
+Single-process fused optimizers run one Pallas kernel per dtype partition
+over the flat arena. ZeRO-style distributed variants (reduce-scatter →
+sharded update → all-gather) land in apex_tpu.optim.distributed in the
+distributed milestone.
+"""
+
+from apex_tpu.optim.fused import (
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedNovoGrad,
+    FusedOptimizer,
+    FusedOptState,
+    FusedSGD,
+)
+
+__all__ = [
+    "FusedAdagrad", "FusedAdam", "FusedLAMB", "FusedNovoGrad",
+    "FusedOptimizer", "FusedOptState", "FusedSGD",
+]

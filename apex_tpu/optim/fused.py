@@ -1,0 +1,319 @@
+"""Fused single-process optimizers over the flat arena.
+
+TPU-native rebuild of ``apex.optimizers`` (SURVEY.md §2.4): the reference
+partitions params by dtype into tensor lists and makes one
+``multi_tensor_applier`` launch per group per step
+(`apex/optimizers/fused_adam.py:119-199`). Here params/grads/state live in
+per-dtype arena buffers and one Pallas kernel per partition updates the
+whole model (apex_tpu.ops.optim_kernels). Python-side per-param list
+building — a hot loop the reference pays every step — does not exist:
+flatten/unflatten trace once under jit and fuse into the step.
+
+Two protocols in one object:
+
+- fused:  ``new_params, new_state = opt.step(grads, state, params)``
+          (the fast path; apex's ``optimizer.step()``)
+- optax:  ``updates, new_state = opt.update(grads, state, params)``
+          (GradientTransformation-compatible, costs one extra subtract)
+
+``apex_tpu.amp.Amp`` auto-detects the fused protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import arena
+from apex_tpu.ops import optim_kernels as K
+from apex_tpu.ops import multi_tensor as MT
+
+Scalar = Union[float, jax.Array, Callable[[jax.Array], jax.Array]]
+
+
+class FusedOptState(NamedTuple):
+    """Optimizer state: step count + named flat slot buffers per partition.
+
+    ``slots["m"]["float32"]`` is the momentum buffer covering every fp32
+    parameter. All slots are fp32 regardless of param dtype.
+    """
+    count: jax.Array
+    slots: Dict[str, Dict[str, jax.Array]]
+
+
+class FusedOptimizer:
+    """Base: arena planning, flatten/unflatten, dual protocol."""
+
+    #: names of fp32 state buffers allocated per partition
+    slot_names = ()
+
+    def __init__(self, lr: Scalar):
+        self.lr = lr
+
+    # -- protocol ------------------------------------------------------------
+
+    def init(self, params) -> FusedOptState:
+        spec = arena.plan(params)
+        return FusedOptState(
+            count=jnp.int32(0),
+            slots={name: arena.zeros(spec, dtype=jnp.float32)
+                   for name in self.slot_names})
+
+    def step(self, grads, state: FusedOptState, params):
+        """Fused update: returns (new_params, new_state)."""
+        spec = arena.plan(params)
+        p_bufs = arena.flatten(params, spec)
+        g_bufs = arena.flatten(grads, spec, cast=jnp.float32)
+        count = state.count + 1
+        lr = self.lr(count) if callable(self.lr) else self.lr
+
+        ctx = self._step_context(spec, g_bufs)
+        new_p, new_slots = {}, {name: {} for name in self.slot_names}
+        for part in spec.partitions:
+            dt = part.dtype
+            slots = {name: state.slots[name][dt] for name in self.slot_names}
+            p_out, s_out = self._partition_step(
+                spec, dt, p_bufs[dt], g_bufs[dt], slots, count, lr, ctx=ctx)
+            new_p[dt] = p_out
+            for name in self.slot_names:
+                new_slots[name][dt] = s_out[name]
+        return (arena.unflatten(new_p, spec),
+                FusedOptState(count=count, slots=new_slots))
+
+    def update(self, grads, state: FusedOptState, params):
+        """optax GradientTransformation protocol (updates = new - old)."""
+        new_params, new_state = self.step(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda n, o: (n.astype(jnp.float32)
+                          - o.astype(jnp.float32)).astype(o.dtype),
+            new_params, params)
+        return updates, new_state
+
+    # -- subclass hooks ------------------------------------------------------
+
+    def _step_context(self, spec, g_bufs):
+        """Once-per-step work over all partitions (e.g. global grad norms)."""
+        return None
+
+    def _partition_step(self, spec, dt, p, g, slots, count, lr, ctx):
+        raise NotImplementedError
+
+
+class FusedAdam(FusedOptimizer):
+    """Adam/AdamW over the arena (`apex/optimizers/fused_adam.py:34-202`).
+
+    ``adam_w_mode=True`` decouples weight decay (AdamW), matching the
+    reference default.
+    """
+
+    slot_names = ("m", "v")
+
+    def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adam_w_mode=True, bias_correction=True):
+        super().__init__(lr)
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+
+    def _partition_step(self, spec, dt, p, g, slots, count, lr, ctx):
+        p2, m2, v2 = K.adam_update(
+            p, g, slots["m"], slots["v"], lr=lr, beta1=self.beta1,
+            beta2=self.beta2, eps=self.eps, weight_decay=self.weight_decay,
+            step=count, adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction)
+        return p2, {"m": m2, "v": v2}
+
+
+class FusedSGD(FusedOptimizer):
+    """SGD with momentum (`apex/optimizers/fused_sgd.py:6-217`)."""
+
+    slot_names = ("m",)
+
+    def __init__(self, lr: Scalar = 1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False, wd_after_momentum=False):
+        super().__init__(lr)
+        if nesterov and (momentum <= 0 or dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires a momentum and zero dampening")
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+
+    def _partition_step(self, spec, dt, p, g, slots, count, lr, ctx):
+        first = (count == 1) if self.momentum > 0 else False
+        p2, m2 = K.sgd_update(
+            p, g, slots["m"], lr=lr, momentum=self.momentum,
+            dampening=self.dampening, weight_decay=self.weight_decay,
+            nesterov=self.nesterov, first_run=first,
+            wd_after_momentum=self.wd_after_momentum)
+        return p2, {"m": m2}
+
+
+class FusedAdagrad(FusedOptimizer):
+    """Adagrad (`apex/optimizers/fused_adagrad.py:5-95`)."""
+
+    slot_names = ("h",)
+
+    def __init__(self, lr: Scalar = 1e-2, eps=1e-10, weight_decay=0.0,
+                 adagrad_w_mode=False):
+        super().__init__(lr)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adagrad_w_mode = adagrad_w_mode
+
+    def _partition_step(self, spec, dt, p, g, slots, count, lr, ctx):
+        p2, h2 = K.adagrad_update(
+            p, g, slots["h"], lr=lr, eps=self.eps,
+            weight_decay=self.weight_decay,
+            adagrad_w_mode=self.adagrad_w_mode)
+        return p2, {"h": h2}
+
+
+class FusedLAMB(FusedOptimizer):
+    """LAMB (`apex/optimizers/fused_lamb.py:4-215`): global grad-norm clip,
+    Adam-style direction, per-tensor trust ratio.
+
+    Two Pallas stages with the per-tensor norms computed between them over
+    the arena via segment reduction — the same split as the reference's
+    `multi_tensor_lamb` stage pair.
+    """
+
+    slot_names = ("m", "v")
+
+    def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-6,
+                 weight_decay=0.01, adam_w_mode=True, bias_correction=True,
+                 max_grad_norm=1.0, use_nvlamb=False):
+        super().__init__(lr)
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def _global_clip_scale(self, g_all):
+        """clip factor from the global grad norm over *all* partitions
+        (`fused_lamb.py:120-136`)."""
+        if not self.max_grad_norm:
+            return jnp.float32(1.0)
+        sq = sum(jnp.square(MT.multi_tensor_l2norm(g)) for g in g_all.values())
+        gnorm = jnp.sqrt(sq)
+        return jnp.where(gnorm > self.max_grad_norm,
+                         self.max_grad_norm / gnorm, 1.0).astype(jnp.float32)
+
+    def _step_context(self, spec, g_bufs):
+        # global grad norm computed ONCE per step over all partitions
+        return self._global_clip_scale(g_bufs)
+
+    def _partition_step(self, spec, dt, p, g, slots, count, lr, ctx):
+        clip = ctx
+        u, m2, v2 = K.lamb_stage1(
+            p, g, slots["m"], slots["v"], beta1=self.beta1, beta2=self.beta2,
+            eps=self.eps, weight_decay=self.weight_decay, step=count,
+            bias_correction=self.bias_correction,
+            adam_w_mode=self.adam_w_mode, clip_scale=clip)
+
+        part = spec.partition(dt)
+        seg = jnp.asarray(arena.segment_ids(spec, dt))
+        n = len(part.sizes)
+        p_norms = MT.per_tensor_l2norm(p, seg, n)
+        u_norms = MT.per_tensor_l2norm(u, seg, n)
+        # trust ratio per tensor; NVLAMB applies it even where wd==0 — with
+        # a single group, plain LAMB and NVLAMB agree unless wd==0 globally
+        ratio = jnp.where((p_norms > 0) & (u_norms > 0),
+                          p_norms / u_norms, 1.0)
+        if not self.use_nvlamb and self.weight_decay == 0.0:
+            ratio = jnp.ones_like(ratio)
+        ratio_pos = jnp.where(seg >= 0, ratio[jnp.maximum(seg, 0)], 0.0)
+        p2 = K.lamb_stage2(p, u, ratio_pos, lr=lr)
+        return p2, {"m": m2, "v": v2}
+
+
+class FusedNovoGrad(FusedOptimizer):
+    """NovoGrad (`apex/optimizers/fused_novograd.py:67-210`).
+
+    Per-layer norm EMAs live in a (num_tensors,) fp32 vector per partition —
+    the reference's ``exp_avg_sq`` buffer, which stores *norms* (not
+    squares, `fused_novograd.py:157-158`) and blends them linearly. Defaults
+    match the reference: decoupled decay (``reg_inside_moment=False`` ↔
+    MOMENT_MODE_1), bias correction on, grad averaging on, L2 norms,
+    first-step norm initialization (``init_zero=False``).
+    """
+
+    slot_names = ("m",)
+
+    def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, bias_correction=True,
+                 reg_inside_moment=False, grad_averaging=True, norm_type=2,
+                 init_zero=False):
+        super().__init__(lr)
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.reg_inside_moment = reg_inside_moment
+        self.grad_averaging = grad_averaging
+        if norm_type not in (0, 2):
+            raise ValueError("FusedNovoGrad only supports l2/inf norm")
+        self.norm_type = norm_type
+        self.init_zero = init_zero
+
+    def init(self, params) -> FusedOptState:
+        spec = arena.plan(params)
+        slots = {"m": arena.zeros(spec, dtype=jnp.float32)}
+        slots["vnorm"] = {
+            p.dtype: jnp.zeros((len(p.sizes),), jnp.float32)
+            for p in spec.partitions}
+        return FusedOptState(count=jnp.int32(0), slots=slots)
+
+    def _per_tensor_norm(self, g, seg, n):
+        if self.norm_type == 2:
+            return MT.per_tensor_l2norm(g, seg, n)
+        absg = jnp.abs(g.astype(jnp.float32))
+        return jax.ops.segment_max(absg, jnp.maximum(seg, 0), num_segments=n)
+
+    # custom step: vnorm slot has non-buffer shape
+    def step(self, grads, state, params):
+        spec = arena.plan(params)
+        p_bufs = arena.flatten(params, spec)
+        g_bufs = arena.flatten(grads, spec, cast=jnp.float32)
+        count = state.count + 1
+        lr = self.lr(count) if callable(self.lr) else self.lr
+
+        new_p = {}
+        new_slots = {"m": {}, "vnorm": {}}
+        for part in spec.partitions:
+            dt = part.dtype
+            p, g = p_bufs[dt], g_bufs[dt]
+            seg = jnp.asarray(arena.segment_ids(spec, dt))
+            n = len(part.sizes)
+            norms = self._per_tensor_norm(g, seg, n)
+            v_prev = state.slots["vnorm"][dt]
+            blended = self.beta2 * v_prev + (1.0 - self.beta2) * norms
+            if self.init_zero:
+                v_new = blended
+            else:
+                # init with first-step norm so the first blend is a no-op
+                # (`fused_novograd.py:163-174`)
+                v_new = jnp.where(count == 1, norms, blended)
+            vpos = jnp.where(seg >= 0, v_new[jnp.maximum(seg, 0)], 1.0)
+            p2, m2 = K.novograd_update(
+                p, g, state.slots["m"][dt], vpos, lr=lr, beta1=self.beta1,
+                beta2=self.beta2, eps=self.eps,
+                weight_decay=self.weight_decay, step=count,
+                grad_averaging=self.grad_averaging,
+                bias_correction=self.bias_correction,
+                reg_inside_moment=self.reg_inside_moment)
+            new_p[dt] = p2
+            new_slots["m"][dt] = m2
+            new_slots["vnorm"][dt] = v_new
+        return (arena.unflatten(new_p, spec),
+                FusedOptState(count=count, slots=new_slots))

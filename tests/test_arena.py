@@ -1,0 +1,132 @@
+"""Arena layout + flatten/unflatten round-trips, native planner vs fallback."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import arena
+from apex_tpu.arena import native
+
+
+def _tree():
+    k = jax.random.PRNGKey(0)
+    ks = jax.random.split(k, 4)
+    return {
+        "conv": {"kernel": jax.random.normal(ks[0], (3, 3, 4, 8)),
+                 "bias": jax.random.normal(ks[1], (8,))},
+        "bn": {"scale": jax.random.normal(ks[2], (8,)).astype(jnp.float32)},
+        "dense": {"kernel": jax.random.normal(ks[3], (8, 2))
+                  .astype(jnp.bfloat16)},
+    }
+
+
+def test_native_planner_loaded():
+    # the image has g++; the on-demand build should succeed
+    assert native.native_available(), "native planner failed to build/load"
+
+
+def test_plan_alignment_and_offsets():
+    spec = arena.plan(_tree(), alignment=1024)
+    for part in spec.partitions:
+        for off, padded, size in zip(part.offsets, part.padded, part.sizes):
+            assert off % 1024 == 0
+            assert padded % 1024 == 0
+            assert padded >= size
+        assert part.total == sum(part.padded)
+
+
+def test_dtype_partitioning():
+    spec = arena.plan(_tree())
+    assert set(spec.dtypes) == {"float32", "bfloat16"}
+
+
+def test_flatten_unflatten_roundtrip():
+    tree = _tree()
+    spec = arena.plan(tree)
+    flat = arena.flatten(tree, spec)
+    out = arena.unflatten(flat, spec)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        tree, out)
+
+
+def test_flatten_under_jit():
+    tree = _tree()
+    spec = arena.plan(tree)
+
+    @jax.jit
+    def roundtrip(t):
+        return arena.unflatten(arena.flatten(t, spec), spec)
+
+    out = roundtrip(tree)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        tree, out)
+
+
+def test_padding_is_zero():
+    tree = {"w": jnp.ones((3,), jnp.float32)}  # 3 elems -> 1024 slot
+    spec = arena.plan(tree)
+    flat = arena.flatten(tree, spec)["float32"]
+    assert flat.shape[0] == 512 * 128
+    np.testing.assert_array_equal(np.asarray(flat[3:]), 0.0)
+
+
+def test_segment_ids_and_mask():
+    tree = {"a": jnp.ones((3,)), "b": jnp.ones((5,))}
+    spec = arena.plan(tree, alignment=8)
+    ids = arena.segment_ids(spec, jnp.float32)
+    assert ids.shape[0] == 512 * 128
+    assert list(ids[:3]) == [0, 0, 0] and list(ids[3:8]) == [-1] * 5
+    assert list(ids[8:13]) == [1] * 5
+    mask = arena.valid_mask(spec, jnp.float32)
+    assert mask.sum() == 8
+
+
+def test_python_fallback_matches_native():
+    sizes = np.array([100, 2048, 1, 999], np.int64)
+    n_off, n_pad, n_tot = native.plan_layout(sizes, 1024)
+    # force fallback (the failure sentinel stops any reload attempt)
+    lib, native._lib = native._lib, None
+    native._load_failed = True
+    try:
+        p_off, p_pad, p_tot = native.plan_layout(sizes, 1024)
+    finally:
+        native._lib, native._load_failed = lib, False
+    np.testing.assert_array_equal(n_off, p_off)
+    np.testing.assert_array_equal(n_pad, p_pad)
+    assert n_tot == p_tot
+
+
+def test_bucket_planning():
+    padded = np.array([1024, 1024, 2048, 1024], np.int64)
+    ids, nb = native.plan_buckets(padded, 2048)
+    assert list(ids) == [0, 0, 1, 2]
+    assert nb == 3
+    lib, native._lib = native._lib, None
+    native._load_failed = True
+    try:
+        ids2, nb2 = native.plan_buckets(padded, 2048)
+    finally:
+        native._lib, native._load_failed = lib, False
+    np.testing.assert_array_equal(ids, ids2)
+    assert nb == nb2
+
+
+def test_shard_planning_and_pad():
+    starts, per = native.plan_shards(10000, 8, 1024)
+    assert per == 2048  # ceil(10000/8)=1250 -> align 2048
+    assert list(starts) == [i * 2048 for i in range(8)]
+    bufs = {"float32": jnp.ones((10000,))}
+    padded = arena.shard_pad(bufs, 8)
+    assert padded["float32"].shape[0] == 2048 * 8
+
+
+def test_zeros_state_allocation():
+    spec = arena.plan({"w": jnp.ones((10,), jnp.bfloat16)})
+    state = arena.zeros(spec, dtype=jnp.float32)
+    assert state["bfloat16"].dtype == jnp.float32  # fp32 state for bf16 arena
+    assert state["bfloat16"].shape[0] == 512 * 128

@@ -1,0 +1,305 @@
+"""Fused optimizer parity vs reference implementations.
+
+Mirrors `tests/L0/run_optimizers/test_adam.py`, `test_lamb.py` (RefLAMB
+comparison), `test_adagrad.py`, and the fused-vs-reference trajectory
+equality of `tests/L0/run_amp/test_fused_sgd.py` — with optax/jnp as the
+reference instead of torch.optim.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.optim import (FusedAdagrad, FusedAdam, FusedLAMB,
+                            FusedNovoGrad, FusedSGD)
+
+
+def _params(key=0, mixed=False):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    p = {"w1": jax.random.normal(ks[0], (33, 17)),
+         "b": jax.random.normal(ks[1], (17,)),
+         "w2": jax.random.normal(ks[2], (129,))}
+    if mixed:
+        p["w2"] = p["w2"].astype(jnp.bfloat16)
+    return p
+
+
+def _grads(params, key=1):
+    ks = jax.random.split(jax.random.PRNGKey(key), len(jax.tree_util.tree_leaves(params)))
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    gs = [jax.random.normal(k, x.shape).astype(x.dtype)
+          for k, x in zip(ks, leaves)]
+    return jax.tree_util.tree_unflatten(treedef, gs)
+
+
+def _run(opt, params, steps=5, seed=1):
+    state = opt.init(params)
+    for i in range(steps):
+        grads = _grads(params, key=seed + i)
+        params, state = jax.jit(opt.step)(grads, state, params)
+    return params
+
+
+def _assert_close(a, b, rtol=2e-5, atol=2e-6):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x, np.float32), np.asarray(y, np.float32),
+            rtol=rtol, atol=atol), a, b)
+
+
+class TestFusedAdam:
+    def test_matches_optax_adamw(self):
+        params = _params()
+        got = _run(FusedAdam(lr=1e-2, weight_decay=0.01), params)
+        tx = optax.adamw(1e-2, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.01)
+        st = tx.init(params)
+        ref = params
+        for i in range(5):
+            g = _grads(ref, key=1 + i)
+            u, st = tx.update(g, st, ref)
+            ref = optax.apply_updates(ref, u)
+        _assert_close(got, ref)
+
+    def test_l2_mode_matches_optax_adam_with_l2(self):
+        params = _params()
+        got = _run(FusedAdam(lr=1e-2, weight_decay=0.1, adam_w_mode=False),
+                   params)
+        tx = optax.chain(optax.add_decayed_weights(0.1),
+                         optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8),
+                         optax.scale(-1e-2))
+        st = tx.init(params)
+        ref = params
+        for i in range(5):
+            g = _grads(ref, key=1 + i)
+            u, st = tx.update(g, st, ref)
+            ref = optax.apply_updates(ref, u)
+        # optax scale_by_adam applies eps after bias-corrected sqrt, same as
+        # ours; add_decayed_weights injects wd before adam like L2 mode
+        _assert_close(got, ref)
+
+    def test_mixed_dtype_partitions(self):
+        params = _params(mixed=True)
+        out = _run(FusedAdam(lr=1e-3), params, steps=3)
+        assert out["w2"].dtype == jnp.bfloat16
+        assert out["w1"].dtype == jnp.float32
+        # moved
+        assert not np.allclose(np.asarray(out["w1"]),
+                               np.asarray(params["w1"]))
+
+    def test_lr_schedule_callable(self):
+        calls = []
+
+        def sched(count):
+            calls.append(1)
+            return 1e-2 / count
+
+        params = _params()
+        _run(FusedAdam(lr=sched), params, steps=2)
+        assert calls  # schedule consulted
+
+
+class TestFusedSGD:
+    def test_matches_optax_sgd_momentum(self):
+        params = _params()
+        got = _run(FusedSGD(lr=0.1, momentum=0.9), params)
+        # pytorch/apex momentum: m1 = g; optax trace with
+        # init zero gives m1 = g as well (t=0: m = g + 0*decay)
+        tx = optax.sgd(0.1, momentum=0.9)
+        st = tx.init(params)
+        ref = params
+        for i in range(5):
+            g = _grads(ref, key=1 + i)
+            u, st = tx.update(g, st, ref)
+            ref = optax.apply_updates(ref, u)
+        _assert_close(got, ref)
+
+    def test_weight_decay_before_momentum(self):
+        params = {"w": jnp.ones((8,))}
+        opt = FusedSGD(lr=0.1, momentum=0.9, weight_decay=0.5)
+        state = opt.init(params)
+        g = {"w": jnp.zeros((8,))}
+        new_p, _ = opt.step(g, state, params)
+        # g_eff = 0 + 0.5*1 = 0.5; m = g_eff; p = 1 - 0.1*0.5
+        np.testing.assert_allclose(np.asarray(new_p["w"]), 0.95, rtol=1e-6)
+
+    def test_nesterov(self):
+        params = {"w": jnp.full((4,), 2.0)}
+        opt = FusedSGD(lr=0.1, momentum=0.5, nesterov=True)
+        state = opt.init(params)
+        g = {"w": jnp.ones((4,))}
+        p1, state = opt.step(g, state, params)
+        # m=g=1; upd = g + 0.5*1 = 1.5; p = 2 - .15
+        np.testing.assert_allclose(np.asarray(p1["w"]), 1.85, rtol=1e-6)
+
+    def test_nesterov_validation(self):
+        with pytest.raises(ValueError):
+            FusedSGD(lr=0.1, nesterov=True)
+
+
+class TestFusedAdagrad:
+    def test_matches_manual(self):
+        params = {"w": jnp.full((16,), 2.0)}
+        opt = FusedAdagrad(lr=0.5, eps=1e-10)
+        state = opt.init(params)
+        g = {"w": jnp.full((16,), 3.0)}
+        p1, state = opt.step(g, state, params)
+        # h = 9; p = 2 - 0.5*3/(3+eps) ~ 1.5
+        np.testing.assert_allclose(np.asarray(p1["w"]), 1.5, rtol=1e-5)
+        p2, state = opt.step(g, state, params=p1)
+        # h = 18; p = 1.5 - 0.5*3/sqrt(18)
+        np.testing.assert_allclose(np.asarray(p2["w"]),
+                                   1.5 - 1.5 / np.sqrt(18), rtol=1e-5)
+
+
+class TestFusedLAMB:
+    def _ref_lamb(self, params, steps, lr=1e-2, b1=0.9, b2=0.999, eps=1e-6,
+                  wd=0.01, max_norm=1.0):
+        """Pure-jnp RefLAMB (the `test_lamb.py:1-259` comparator), applied
+        per-tensor."""
+        m = jax.tree_util.tree_map(jnp.zeros_like, params)
+        v = jax.tree_util.tree_map(jnp.zeros_like, params)
+        p = params
+        for i in range(steps):
+            g = _grads(p, key=1 + i)
+            gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(x))
+                                 for x in jax.tree_util.tree_leaves(g)))
+            clip = jnp.where(gnorm > max_norm, max_norm / gnorm, 1.0)
+            g = jax.tree_util.tree_map(lambda x: x * clip, g)
+            t = i + 1
+            bc1 = 1 - b1 ** t
+            bc2 = 1 - b2 ** t
+
+            def upd(pp, gg, mm, vv):
+                mm = b1 * mm + (1 - b1) * gg
+                vv = b2 * vv + (1 - b2) * gg * gg
+                u = (mm / bc1) / (jnp.sqrt(vv / bc2) + eps) + wd * pp
+                pn = jnp.sqrt(jnp.sum(jnp.square(pp)))
+                un = jnp.sqrt(jnp.sum(jnp.square(u)))
+                ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+                return pp - lr * ratio * u, mm, vv
+
+            out = jax.tree_util.tree_map(upd, p, g, m, v)
+            p = jax.tree_util.tree_map(lambda o: o[0], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+            m = jax.tree_util.tree_map(lambda o: o[1], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+            v = jax.tree_util.tree_map(lambda o: o[2], out,
+                                       is_leaf=lambda x: isinstance(x, tuple))
+        return p
+
+    def test_matches_ref_lamb(self):
+        params = _params()
+        got = _run(FusedLAMB(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0),
+                   params, steps=4)
+        ref = self._ref_lamb(params, steps=4)
+        _assert_close(got, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestFusedNovoGrad:
+    def test_matches_reference_semantics(self):
+        """Reference defaults: per-tensor *norm* EMA (not squared,
+        `fused_novograd.py:157-158`), first-step norm init, bias correction
+        with bc2=sqrt(1-b2^t), decoupled decay (MOMENT_MODE_1,
+        `multi_tensor_novograd.cu:107-112`)."""
+        b1, b2, lr, eps = 0.9, 0.99, 0.1, 1e-8
+        params = {"w": jnp.full((32,), 1.0)}
+        opt = FusedNovoGrad(lr=lr, betas=(b1, b2), weight_decay=0.0, eps=eps)
+        state = opt.init(params)
+        g = {"w": jnp.full((32,), 2.0)}
+
+        gnorm = 2 * np.sqrt(32)
+        # step 1: v1 = ||g|| (init with first-step norm)
+        p1, state = opt.step(g, state, params)
+        m1 = (1 - b1) * 2.0
+        bc1, bc2 = 1 - b1, np.sqrt(1 - b2)
+        upd1 = (m1 / bc1) / (gnorm / bc2 + eps)
+        np.testing.assert_allclose(np.asarray(p1["w"]), 1.0 - lr * upd1,
+                                   rtol=1e-5)
+        # step 2: v2 = b2*v1 + (1-b2)*||g|| = ||g|| (same grad)
+        p2, state = opt.step(g, state, p1)
+        m2 = b1 * m1 + (1 - b1) * 2.0
+        bc1, bc2 = 1 - b1 ** 2, np.sqrt(1 - b2 ** 2)
+        upd2 = (m2 / bc1) / (gnorm / bc2 + eps)
+        np.testing.assert_allclose(np.asarray(p2["w"]),
+                                   np.asarray(p1["w"]) - lr * upd2, rtol=1e-5)
+
+    def test_weight_decay_decoupled_vs_inside(self):
+        params = {"w": jnp.full((16,), 1.0)}
+        g = {"w": jnp.full((16,), 1.0)}
+        out = {}
+        for mode in (False, True):
+            opt = FusedNovoGrad(lr=0.1, weight_decay=0.1,
+                                reg_inside_moment=mode)
+            st = opt.init(params)
+            p = params
+            # modes coincide on step 1 (bc1 cancels); diverge from step 2
+            for _ in range(3):
+                p, st = opt.step(g, st, p)
+            out[mode] = np.asarray(p["w"])
+        assert not np.allclose(out[False], out[True])
+
+    def test_inf_norm_mode(self):
+        params = {"w": jnp.full((8,), 1.0)}
+        g = {"w": jnp.arange(8.0)}
+        opt = FusedNovoGrad(lr=0.1, norm_type=0, weight_decay=0.0)
+        st = opt.init(params)
+        p1, st = opt.step(g, st, params)
+        assert np.isfinite(np.asarray(p1["w"])).all()
+
+
+class TestAmpIntegration:
+    def test_amp_o2_with_fused_adam(self):
+        """Full pipeline: O2 masters + fused optimizer + overflow skip."""
+        params = _params()
+        # static scale small enough that fp16 grads of the scaled loss fit
+        # (2^16 would overflow on the first steps and back off — correct
+        # dynamic behavior, but a static scale keeps this test deterministic)
+        amp_opt, state = amp.initialize(
+            params, FusedAdam(lr=1e-2), "O2", half_dtype=jnp.float16,
+            loss_scale=128.0)
+
+        def loss_fn(mp, x):
+            return jnp.mean(jnp.square(x @ mp["w1"] + mp["b"]))
+
+        x = jax.random.normal(jax.random.PRNGKey(9), (4, 33))
+        step = jax.jit(lambda s: amp_opt.step(s, loss_fn, x))
+        losses = [None] * 3
+        for i in range(3):
+            state, losses[i], finite = step(state)
+            assert bool(finite)
+        assert float(losses[2]) < float(losses[0])
+        assert int(state.opt_state.count) == 3
+
+        # overflow: fused state (count + slots) must not advance
+        bad = jax.jit(lambda s: amp_opt.step(
+            s, lambda mp, x: loss_fn(mp, x) * jnp.inf, x))
+        w_before = np.asarray(state.params["w1"])
+        state, _, finite = bad(state)
+        assert not bool(finite)
+        assert int(state.opt_state.count) == 3
+        np.testing.assert_array_equal(np.asarray(state.params["w1"]),
+                                      w_before)
+
+    def test_dynamic_scale_backs_off_until_trainable(self):
+        """With init scale 2^16, early fp16 steps overflow and the scaler
+        backs off until updates commit — the reference's intended dynamic
+        behavior (`scaler.py:197-215`)."""
+        params = _params()
+        amp_opt, state = amp.initialize(
+            params, FusedAdam(lr=1e-2), "O2", half_dtype=jnp.float16)
+
+        def loss_fn(mp, x):
+            return jnp.mean(jnp.square(x @ mp["w1"] + mp["b"]))
+
+        x = jax.random.normal(jax.random.PRNGKey(9), (4, 33))
+        step = jax.jit(lambda s: amp_opt.step(s, loss_fn, x))
+        committed = 0
+        for _ in range(20):
+            state, _, finite = step(state)
+            committed += int(bool(finite))
+        assert committed > 0
+        assert int(state.opt_state.count) == committed
+        assert float(state.scalers[0].loss_scale) < 2.0 ** 16

@@ -1,0 +1,104 @@
+"""Pallas multi-tensor kernel parity tests.
+
+Mirrors `tests/L0/run_amp/test_multi_tensor_scale.py`, `_l2norm`, `_axpby`:
+overflow-flag propagation, dtype cross-products, numeric parity vs jnp.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import arena
+from apex_tpu.ops import (multi_tensor_axpby, multi_tensor_l2norm,
+                          multi_tensor_maxnorm, multi_tensor_scale,
+                          per_tensor_l2norm)
+
+N = 512 * 128  # one kernel block
+
+
+def _buf(dtype=jnp.float32, fill=None, n=N):
+    k = jax.random.PRNGKey(0)
+    x = jax.random.normal(k, (n,), jnp.float32)
+    if fill is not None:
+        x = x.at[1234].set(fill)
+    return x.astype(dtype)
+
+
+class TestScale:
+    @pytest.mark.parametrize("in_dt,out_dt", [
+        (jnp.float32, jnp.float32), (jnp.float32, jnp.float16),
+        (jnp.float16, jnp.float32), (jnp.bfloat16, jnp.float32),
+    ])
+    def test_parity(self, in_dt, out_dt):
+        x = _buf(in_dt)
+        out, finite = multi_tensor_scale(x, 4.0, out_dtype=out_dt)
+        assert out.dtype == out_dt
+        assert bool(finite)
+        ref = (x.astype(jnp.float32) * 4.0).astype(out_dt)
+        np.testing.assert_allclose(np.asarray(out, jnp.float32),
+                                   np.asarray(ref, jnp.float32), rtol=1e-6)
+
+    @pytest.mark.parametrize("bad", [np.inf, -np.inf, np.nan])
+    def test_overflow_flag(self, bad):
+        x = _buf(fill=bad)
+        _, finite = multi_tensor_scale(x, 1.0)
+        assert not bool(finite)
+
+    def test_overflow_from_downcast(self):
+        # 1e30 * 1e10 overflows fp32 during scaling -> flag set
+        x = _buf().at[7].set(1e30)
+        _, finite = multi_tensor_scale(x, 1e10)
+        assert not bool(finite)
+
+    def test_multiblock(self):
+        x = _buf(n=4 * N)
+        out, finite = multi_tensor_scale(x, 0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 0.5,
+                                   rtol=1e-6)
+        assert bool(finite)
+
+    def test_under_jit(self):
+        f = jax.jit(lambda x, s: multi_tensor_scale(x, s))
+        out, finite = f(_buf(), jnp.float32(2.0))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(_buf()) * 2,
+                                   rtol=1e-6)
+
+
+class TestAxpby:
+    def test_parity(self):
+        x, y = _buf(), _buf() * 2
+        out, finite = multi_tensor_axpby(2.0, x, -3.0, y)
+        np.testing.assert_allclose(
+            np.asarray(out), 2 * np.asarray(x) - 3 * np.asarray(y),
+            rtol=1e-5)
+        assert bool(finite)
+
+    def test_flag_on_nan_either_input(self):
+        x, y = _buf(fill=np.nan), _buf()
+        _, finite = multi_tensor_axpby(1.0, x, 1.0, y)
+        assert not bool(finite)
+        _, finite = multi_tensor_axpby(1.0, y, 1.0, x)
+        assert not bool(finite)
+
+
+class TestNorms:
+    def test_l2_parity(self):
+        x = _buf(n=2 * N)
+        got = multi_tensor_l2norm(x)
+        ref = jnp.sqrt(jnp.sum(jnp.square(x)))
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    def test_maxnorm(self):
+        x = _buf().at[99].set(-123.0)
+        assert abs(float(multi_tensor_maxnorm(x)) - 123.0) < 1e-6
+
+    def test_per_tensor_l2norm(self):
+        tree = {"a": jnp.full((10,), 2.0), "b": jnp.full((7,), 3.0)}
+        spec = arena.plan(tree)
+        flat = arena.flatten(tree, spec)["float32"]
+        seg = jnp.asarray(arena.segment_ids(spec, jnp.float32))
+        norms = per_tensor_l2norm(flat, seg, 2)
+        np.testing.assert_allclose(
+            np.asarray(norms),
+            [np.sqrt(10 * 4.0), np.sqrt(7 * 9.0)], rtol=1e-6)
