@@ -13,6 +13,7 @@ from apex_tpu.arena.arena import (
     flatten,
     plan,
     segment_ids,
+    segment_ids_device,
     shard_pad,
     unflatten,
     valid_mask,
@@ -22,6 +23,6 @@ from apex_tpu.arena.native import native_available
 
 __all__ = [
     "ArenaSpec", "DEFAULT_ALIGNMENT", "bucket_ids", "flatten", "plan",
-    "segment_ids", "shard_pad", "unflatten", "valid_mask", "zeros",
+    "segment_ids", "segment_ids_device", "shard_pad", "unflatten", "valid_mask", "zeros",
     "native_available",
 ]

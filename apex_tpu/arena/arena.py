@@ -24,6 +24,7 @@ Usage::
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Tuple
 
 import jax
@@ -178,18 +179,40 @@ def zeros(spec: ArenaSpec, dtype=None) -> Dict[str, jax.Array]:
             for p in spec.partitions}
 
 
+@functools.lru_cache(maxsize=128)
 def segment_ids(spec: ArenaSpec, dtype) -> np.ndarray:
     """Host-side i32 map arena-position → tensor index (-1 in padding).
 
     Enables per-tensor reductions over the flat buffer in one pass
     (``jax.ops.segment_sum``) — how per-layer norms (NovoGrad, LAMB trust
-    ratios) run without per-tensor kernel launches.
+    ratios) run without per-tensor kernel launches. Cached per (spec, dtype)
+    — the map is a pure function of the static layout. Treat the result as
+    read-only.
     """
+    dtype = str(jnp.dtype(dtype))
     part = spec.partition(dtype)
     ids = np.full((part.buffer_len,), -1, np.int32)
     for j, (off, size) in enumerate(zip(part.offsets, part.sizes)):
         ids[off:off + size] = j
+    ids.setflags(write=False)
     return ids
+
+
+def segment_ids_device(spec: ArenaSpec, dtype) -> jax.Array:
+    """Device-computed equivalent of :func:`segment_ids`.
+
+    Embeds only the (num_tensors,) offset/size vectors in the program and
+    derives the per-element map with a searchsorted over an iota — for big
+    arenas this avoids materializing a buffer-sized host constant in the
+    jitted step.
+    """
+    part = spec.partition(str(jnp.dtype(dtype)))
+    starts = jnp.asarray(part.offsets, jnp.int32)
+    sizes = jnp.asarray(part.sizes, jnp.int32)
+    pos = jnp.arange(part.buffer_len, dtype=jnp.int32)
+    ids = jnp.searchsorted(starts, pos, side="right").astype(jnp.int32) - 1
+    valid = pos < (starts[ids] + sizes[ids])
+    return jnp.where(valid, ids, -1)
 
 
 def valid_mask(spec: ArenaSpec, dtype) -> np.ndarray:

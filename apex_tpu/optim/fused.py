@@ -222,7 +222,7 @@ class FusedLAMB(FusedOptimizer):
             adam_w_mode=self.adam_w_mode, clip_scale=clip)
 
         part = spec.partition(dt)
-        seg = jnp.asarray(arena.segment_ids(spec, dt))
+        seg = arena.segment_ids_device(spec, dt)
         n = len(part.sizes)
         p_norms = MT.per_tensor_l2norm(p, seg, n)
         u_norms = MT.per_tensor_l2norm(u, seg, n)
@@ -293,7 +293,7 @@ class FusedNovoGrad(FusedOptimizer):
         for part in spec.partitions:
             dt = part.dtype
             p, g = p_bufs[dt], g_bufs[dt]
-            seg = jnp.asarray(arena.segment_ids(spec, dt))
+            seg = arena.segment_ids_device(spec, dt)
             n = len(part.sizes)
             norms = self._per_tensor_norm(g, seg, n)
             v_prev = state.slots["vnorm"][dt]

@@ -1,0 +1,34 @@
+"""apex_tpu.parallel — distributed training over device meshes.
+
+TPU-native rebuild of `apex/parallel` (`apex/parallel/__init__.py:10-95`):
+data parallelism, synced batch norm, LARC, plus the mesh construction
+helpers that replace `torch.distributed` process groups. Sequence/context
+parallelism (ring attention) lives in :mod:`apex_tpu.parallel.ring` — a
+capability the reference lacks but a TPU framework owes its users.
+"""
+
+from apex_tpu.parallel.mesh import (
+    DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS,
+    make_mesh, data_parallel_mesh, hierarchical_data_mesh,
+    replicated, batch_sharding, axis_size, local_batch,
+)
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel, Reducer, sync_gradients, flat_all_reduce,
+    replicate,
+)
+from apex_tpu.parallel.larc import LARC, larc_rewrite_grads
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm, sync_batch_norm, sync_moments, syncbn_stats_groups,
+    convert_sync_batchnorm,
+)
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "SEQ_AXIS", "PIPE_AXIS", "EXPERT_AXIS",
+    "make_mesh", "data_parallel_mesh", "hierarchical_data_mesh",
+    "replicated", "batch_sharding", "axis_size", "local_batch",
+    "DistributedDataParallel", "Reducer", "sync_gradients",
+    "flat_all_reduce", "replicate",
+    "LARC", "larc_rewrite_grads",
+    "SyncBatchNorm", "sync_batch_norm", "sync_moments",
+    "syncbn_stats_groups", "convert_sync_batchnorm",
+]

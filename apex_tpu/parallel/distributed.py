@@ -1,0 +1,242 @@
+"""Data-parallel gradient synchronisation — the DDP equivalent.
+
+The reference's ``DistributedDataParallel`` (`apex/parallel/distributed.py:
+129-639`) is ~600 lines of machinery whose entire job is to make NCCL
+allreduce overlap backward: per-param grad hooks, arrival-order bucket
+construction broadcast from rank 0, flatten/unflatten, side CUDA streams,
+epilogue callbacks. On TPU the same capability is a *program property*:
+gradients computed under ``shard_map`` over a ``data`` mesh axis are synced
+with ``psum``, and XLA's latency-hiding scheduler overlaps the collectives
+with remaining backward compute — the bucket/stream machinery is the
+compiler's job. What survives as API are the *semantic* knobs:
+
+- ``gradient_average`` / ``gradient_predivide_factor``
+  (`distributed.py:144-148,442-451`): pre/post division around the reduce.
+- ``allreduce_always_fp32`` (`distributed.py:140-143,455-459`): reduce half
+  grads in fp32.
+- ``delay_allreduce`` (`distributed.py:168`): sync once at the end instead
+  of overlapped — on TPU both compile to the same collectives, kept for API
+  parity (it disables XLA's combining hint).
+- ``no_sync`` / ``_disable_allreduce`` (`distributed.py:566-570`): gradient
+  accumulation without communication.
+- ``message_size`` (`distributed.py:165`): the bucket-combine threshold,
+  forwarded to XLA's allreduce combiner.
+
+``Reducer`` (`distributed.py:89-126`) survives as the manual-trigger
+average; ``flat_dist_call`` (`distributed.py:26-49`) as ``flat_all_reduce``
+over an arena buffer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from apex_tpu.parallel.mesh import DATA_AXIS
+
+_REAL_DTYPES = (jnp.floating,)
+
+
+def _is_float(x):
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def sync_gradients(grads, axis_name: str = DATA_AXIS, *,
+                   gradient_average: bool = True,
+                   gradient_predivide_factor: float = 1.0,
+                   allreduce_always_fp32: bool = False):
+    """All-reduce a gradient pytree across ``axis_name`` (inside shard_map).
+
+    Implements the arithmetic of ``allreduce_bucket``
+    (`apex/parallel/distributed.py:425-475`): optionally cast to fp32,
+    divide by ``predivide_factor`` before the reduce, ``psum``, then divide
+    by ``world/predivide`` after (or not at all when ``gradient_average``
+    is off), casting back to the gradient dtype at the end.
+    """
+    world = jax.lax.axis_size(axis_name)
+
+    def _sync(g):
+        if not _is_float(g):
+            return g
+        orig = g.dtype
+        if allreduce_always_fp32:
+            g = g.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            g = g / gradient_predivide_factor
+        g = jax.lax.psum(g, axis_name)
+        if gradient_average:
+            post = world / gradient_predivide_factor
+            if post != 1.0:
+                g = g / post
+        return g.astype(orig)
+
+    return jax.tree_util.tree_map(_sync, grads)
+
+
+def flat_all_reduce(buf: jax.Array, axis_name: str = DATA_AXIS, *,
+                    average: bool = True) -> jax.Array:
+    """One fused all-reduce of a flat arena buffer — ``flat_dist_call``
+    (`apex/parallel/distributed.py:26-49`) with the flatten already done by
+    the arena. Also the ``delay_allreduce`` fallback path
+    (`distributed.py:491-510`)."""
+    out = jax.lax.psum(buf, axis_name)
+    if average:
+        out = out / jax.lax.axis_size(axis_name)
+    return out
+
+
+class Reducer:
+    """Manual-trigger parameter/gradient averaging
+    (`apex/parallel/distributed.py:89-126`): construction-time broadcast is
+    replaced by ``replicate`` (params placed with a replicated sharding are
+    identical on all devices by construction); ``reduce`` averages a pytree
+    across the data axis whenever the user calls it."""
+
+    def __init__(self, axis_name: str = DATA_AXIS):
+        self.axis_name = axis_name
+
+    def reduce(self, tree):
+        world = jax.lax.axis_size(self.axis_name)
+        return jax.tree_util.tree_map(
+            lambda x: jax.lax.psum(x, self.axis_name) / world
+            if _is_float(x) else x, tree)
+
+
+def replicate(tree, mesh: Mesh):
+    """Place a pytree replicated on every device of ``mesh`` — the
+    construction-time rank-0 broadcast of the reference DDP
+    (`apex/parallel/distributed.py:253`), done by sharding instead of
+    communication."""
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)
+
+
+class DistributedDataParallel:
+    """Data-parallel train-step transform.
+
+    ``ddp = DistributedDataParallel(mesh)`` then ``ddp.wrap(step)`` turns a
+    per-device step ``(state, batch) -> (state, metrics)`` whose gradients
+    are produced locally into a jitted SPMD program: the batch is split over
+    the data axis, the step runs per shard, and every gradient the step
+    syncs through ``ddp.sync_gradients`` (or the wrapper's automatic sync if
+    the step returns raw grads) is all-reduced.
+
+    The constructor flags mirror `apex/parallel/distributed.py:129-191`.
+    """
+
+    def __init__(self, mesh: Mesh, axis_name: str = DATA_AXIS, *,
+                 gradient_average: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 allreduce_always_fp32: bool = False,
+                 delay_allreduce: bool = False,
+                 message_size: int = 10_000_000):
+        if axis_name not in mesh.axis_names:
+            raise ValueError(f"axis {axis_name!r} not in mesh "
+                             f"{mesh.axis_names}")
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.delay_allreduce = delay_allreduce
+        self.message_size = message_size
+        self._sync_enabled = True
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.shape[self.axis_name]
+
+    # -- in-step API ---------------------------------------------------------
+
+    def sync(self, grads):
+        """Sync a gradient pytree (call inside the wrapped step). Honors
+        ``no_sync`` — the `_disable_allreduce` flag
+        (`apex/parallel/distributed.py:566-570`)."""
+        if not self._sync_enabled:
+            return grads
+        return sync_gradients(
+            grads, self.axis_name,
+            gradient_average=self.gradient_average,
+            gradient_predivide_factor=self.gradient_predivide_factor,
+            allreduce_always_fp32=self.allreduce_always_fp32)
+
+    def no_sync(self):
+        """Context manager: steps wrapped while active skip gradient
+        all-reduce (gradient accumulation across microbatches)."""
+        ddp = self
+
+        class _NoSync:
+            def __enter__(self):
+                ddp._sync_enabled = False
+
+            def __exit__(self, *exc):
+                ddp._sync_enabled = True
+
+        return _NoSync()
+
+    # -- step transform ------------------------------------------------------
+
+    def wrap(self, step_fn: Callable, *,
+             state_specs=P(), batch_specs=None, out_specs=None,
+             donate_state: bool = True) -> Callable:
+        """shard_map ``step_fn(state, batch) -> (state, aux)`` over the mesh.
+
+        ``state`` is replicated (every device holds identical params, like
+        DDP's broadcast invariant), ``batch`` is split on its leading dim.
+        ``step_fn`` must call ``self.sync`` on its gradients (or use
+        ``wrap_grad_fn``). Donation keeps the replicated state update
+        in-place.
+        """
+        batch_specs = batch_specs if batch_specs is not None else \
+            P(self.axis_name)
+        out_specs = out_specs if out_specs is not None else \
+            (state_specs, P())
+
+        jit_kwargs = {}
+        if donate_state:
+            jit_kwargs["donate_argnums"] = (0,)
+
+        # ``self._sync_enabled`` is read inside step_fn at *trace* time, so
+        # a single compiled program would bake in whichever value was active
+        # at the first call — breaking no_sync for already-compiled steps.
+        # Build one program per flag value, each from a distinct closure
+        # (distinct trace caches) that pins the flag while tracing.
+        def _build(sync_on: bool):
+            def pinned(*args, **kwargs):
+                prev = self._sync_enabled
+                self._sync_enabled = sync_on
+                try:
+                    return step_fn(*args, **kwargs)
+                finally:
+                    self._sync_enabled = prev
+            mapped = jax.shard_map(
+                pinned, mesh=self.mesh,
+                in_specs=(state_specs, batch_specs),
+                out_specs=out_specs,
+                check_vma=False)
+            return jax.jit(mapped, **jit_kwargs)
+
+        programs = {}
+
+        @functools.wraps(step_fn)
+        def dispatch(*args, **kwargs):
+            key = self._sync_enabled
+            if key not in programs:
+                programs[key] = _build(key)
+            return programs[key](*args, **kwargs)
+
+        return dispatch
+
+    def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
+        """Wrap ``grad_fn(*a, **k) -> (value, grads)`` so grads come back
+        synced — the "model wrapper" usage of the reference where backward
+        itself triggers the reduction."""
+        @functools.wraps(grad_fn)
+        def wrapped(*args, **kwargs):
+            value, grads = grad_fn(*args, **kwargs)
+            return value, self.sync(grads)
+        return wrapped

@@ -1,0 +1,96 @@
+"""LARC — layer-wise adaptive rate control.
+
+Rebuild of `apex/parallel/LARC.py:5-107`: an optimizer *wrapper* that
+rewrites each parameter's gradient with a locally-adaptive trust ratio
+before delegating to the inner optimizer. Functional form: a gradient
+transform applied leaf-wise (each leaf = one "layer" parameter, matching
+the reference's per-param loop at `LARC.py:78-105`).
+
+    larc = LARC(inner_tx, trust_coefficient=0.02, clip=True)
+    state = larc.init(params)
+    params, state = larc.step(grads, state, params, lr=lr)
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def larc_rewrite_grads(grads, params, *, lr, trust_coefficient: float = 0.02,
+                       clip: bool = True, eps: float = 1e-8,
+                       weight_decay: float = 0.0):
+    """Per-leaf LARC gradient rewrite (`apex/parallel/LARC.py:78-105`).
+
+    adaptive_lr = trust * ||p|| / (||g|| + wd * ||p|| + eps); in ``clip``
+    mode the ratio is capped at 1 relative to the global lr
+    (`LARC.py:94-96`), otherwise it scales the gradient directly. Weight
+    decay is folded into the gradient exactly like the reference
+    (`LARC.py:100-103`) so the inner optimizer must not re-apply it.
+    Zero-norm params or grads leave the gradient untouched (`LARC.py:88`).
+    """
+    def _rewrite(g, p):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        pn = jnp.linalg.norm(p.astype(jnp.float32).reshape(-1))
+        gn = jnp.linalg.norm(g.astype(jnp.float32).reshape(-1))
+        adaptive = trust_coefficient * pn / (gn + pn * weight_decay + eps)
+        if clip:
+            adaptive = jnp.minimum(adaptive / lr, 1.0)
+        new_g = (g.astype(jnp.float32) + weight_decay * p.astype(jnp.float32)
+                 ) * adaptive
+        # zero param or grad norm leaves the gradient *completely* untouched
+        # (no wd fold either) — `LARC.py:88` gates the whole rewrite
+        active = (pn != 0.0) & (gn != 0.0)
+        return jnp.where(active, new_g, g.astype(jnp.float32)).astype(g.dtype)
+
+    return jax.tree_util.tree_map(_rewrite, grads, params)
+
+
+class LARC:
+    """Optimizer wrapper with the reference's constructor signature
+    (`LARC.py:55-63`). Works with any inner optimizer exposing
+    ``init`` + (``step`` or ``update``) — fused apex_tpu optimizers or
+    optax transforms."""
+
+    def __init__(self, optimizer, trust_coefficient: float = 0.02,
+                 clip: bool = True, eps: float = 1e-8,
+                 weight_decay: float = 0.0):
+        self.inner = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def _lr(self, lr):
+        if lr is not None:
+            return lr
+        lr = getattr(self.inner, "lr", None)
+        if lr is None:
+            raise ValueError("clip mode needs lr: pass lr= or use an inner "
+                             "optimizer with a .lr attribute")
+        return lr
+
+    def step(self, grads, state, params, *, lr=None):
+        grads = larc_rewrite_grads(
+            grads, params, lr=self._lr(lr),
+            trust_coefficient=self.trust_coefficient, clip=self.clip,
+            eps=self.eps, weight_decay=self.weight_decay)
+        if hasattr(self.inner, "step"):
+            return self.inner.step(grads, state, params)
+        updates, state = self.inner.update(grads, state, params)
+        params = jax.tree_util.tree_map(
+            lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, state
+
+    def update(self, grads, state, params, *, lr=None):
+        grads = larc_rewrite_grads(
+            grads, params, lr=self._lr(lr),
+            trust_coefficient=self.trust_coefficient, clip=self.clip,
+            eps=self.eps, weight_decay=self.weight_decay)
+        return self.inner.update(grads, state, params)

@@ -1,0 +1,101 @@
+"""Device-mesh construction helpers.
+
+The reference's process topology is implicit: `torch.distributed` ranks plus
+hand-built sub-groups (`apex/parallel/__init__.py:21-95` SyncBN groups,
+`apex/parallel/distributed.py:604-624` round-robin allreduce groups,
+`apex/contrib/optimizers/distributed_fused_adam.py:250-290` hierarchical
+intra/inter-node groups). On TPU the topology is explicit and first-class: a
+``jax.sharding.Mesh`` with named axes. Sub-groups become extra mesh axes —
+a (nodes, local) factorization of the data axis gives the same hierarchy the
+reference builds with ``dist.new_group``, except XLA routes each collective
+over the right interconnect (ICI within an axis that lives inside a slice,
+DCN across slices) automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+#: Canonical axis names. data = DP/ZeRO sharding, model = tensor parallel,
+#: seq = sequence/context parallel (ring attention), pipe = pipeline stages,
+#: expert = MoE expert parallel.
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+
+def make_mesh(axis_sizes: Sequence[Tuple[str, int]],
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a mesh from ``[(axis_name, size), ...]``.
+
+    A size of -1 (at most one axis) absorbs the remaining devices, so
+    ``make_mesh([("data", -1)])`` is the pure-DP mesh on any slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = [s for _, s in axis_sizes]
+    names = [n for n, _ in axis_sizes]
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may have size -1")
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        if len(devices) % known:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed axes {known}")
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total != len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} "
+                         f"devices, have {len(devices)}")
+    return Mesh(np.array(devices).reshape(sizes), tuple(names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """All devices on one ``data`` axis — the topology of the reference's
+    DDP (`apex/parallel/distributed.py:129`)."""
+    return make_mesh([(DATA_AXIS, -1)], devices)
+
+
+def hierarchical_data_mesh(local_size: int, devices=None) -> Mesh:
+    """Factorize data parallelism into (inter, intra) axes of sizes
+    (world/local_size, local_size) — the two-level reduce-scatter/all-reduce
+    layout of DistributedFusedAdam (`distributed_fused_adam.py:250-290`,
+    intra-node group + inter-node group). Collectives over ``data_intra``
+    ride the fast interconnect; ``data_inter`` crosses slices/hosts.
+    """
+    return make_mesh([("data_inter", -1), ("data_intra", local_size)],
+                     devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    """Sharding that replicates a pytree leaf across the whole mesh."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dimension over ``axis``."""
+    return NamedSharding(mesh, P(axis))
+
+
+def axis_size(axis: str, mesh: Optional[Mesh] = None) -> int:
+    """Size of a named axis, inside shard_map (via lax) or outside (via
+    mesh)."""
+    if mesh is not None:
+        return mesh.shape[axis]
+    return jax.lax.axis_size(axis)
+
+
+def local_batch(global_batch: int, mesh: Mesh, axis: str = DATA_AXIS) -> int:
+    n = mesh.shape[axis]
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{axis}={n}")
+    return global_batch // n

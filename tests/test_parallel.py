@@ -1,0 +1,235 @@
+"""Data-parallel engine tests on the 8-device CPU mesh.
+
+Mirrors the reference's 2-GPU semantics tests
+(`tests/distributed/DDP/ddp_race_condition_test.py`: exactly-known grads
+checked after sync) and the allreduce-arithmetic flags of
+`apex/parallel/distributed.py:425-475`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+from apex_tpu.parallel import mesh as mesh_lib
+
+
+def _shard_eval(mesh, fn, *args, in_specs=P("data"), out_specs=P()):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*args)
+
+
+class TestMesh:
+    def test_make_mesh_infer(self, devices):
+        m = mesh_lib.make_mesh([("data", -1)])
+        assert m.shape["data"] == 8
+
+    def test_make_mesh_2d(self, devices):
+        m = mesh_lib.make_mesh([("data", 4), ("model", 2)])
+        assert m.shape == {"data": 4, "model": 2}
+
+    def test_make_mesh_bad_size(self, devices):
+        with pytest.raises(ValueError):
+            mesh_lib.make_mesh([("data", 3), ("model", 2)])
+
+    def test_hierarchical(self, devices):
+        m = mesh_lib.hierarchical_data_mesh(local_size=4)
+        assert m.shape == {"data_inter": 2, "data_intra": 4}
+
+    def test_local_batch(self, mesh8):
+        assert mesh_lib.local_batch(64, mesh8) == 8
+        with pytest.raises(ValueError):
+            mesh_lib.local_batch(63, mesh8)
+
+
+class TestSyncGradients:
+    """The ddp_race_condition contract: after backward+sync every device
+    holds the average of per-device grads, for exactly-known values."""
+
+    def test_known_grad_average(self, mesh8):
+        # per-device grad = rank+1  =>  synced = mean = 4.5
+        def step(x):
+            g = {"w": x * jnp.ones((4, 128))}
+            return parallel.sync_gradients(g, "data")["w"]
+
+        x = jnp.arange(1.0, 9.0)
+        out = _shard_eval(mesh8, step, x, in_specs=P("data"),
+                          out_specs=P())
+        np.testing.assert_allclose(out, 4.5 * np.ones((4, 128)), rtol=1e-6)
+
+    def test_predivide_factor(self, mesh8):
+        # predivide: sum(g/f)/(world/f) == mean — same result, different
+        # intermediate scaling (`distributed.py:442-451`)
+        def step(x):
+            g = parallel.sync_gradients(
+                {"w": x}, "data", gradient_predivide_factor=8.0)
+            return g["w"]
+
+        x = jnp.arange(1.0, 9.0)
+        out = _shard_eval(mesh8, step, x)
+        np.testing.assert_allclose(out, 4.5, rtol=1e-6)
+
+    def test_no_average(self, mesh8):
+        def step(x):
+            return parallel.sync_gradients(
+                {"w": x}, "data", gradient_average=False)["w"]
+
+        out = _shard_eval(mesh8, step, jnp.ones(8))
+        np.testing.assert_allclose(out, 8.0)
+
+    def test_fp32_allreduce_of_bf16(self, mesh8):
+        # bf16 grads reduced in fp32 keep more precision than bf16 psum
+        def step(x):
+            g = x.astype(jnp.bfloat16)
+            synced = parallel.sync_gradients(
+                {"w": g}, "data", allreduce_always_fp32=True)["w"]
+            assert synced.dtype == jnp.bfloat16
+            return synced.astype(jnp.float32)
+
+        vals = jnp.float32([1.0, 1 + 1/256, 1 - 1/256, 1.0,
+                            1.0, 1.0, 1.0, 1.0])
+        out = _shard_eval(mesh8, step, vals)
+        expect = np.mean([float(jnp.bfloat16(v)) for v in vals])
+        np.testing.assert_allclose(float(out[0]), expect, rtol=1e-2)
+
+    def test_int_leaves_untouched(self, mesh8):
+        def step(x):
+            g = {"w": x, "count": jnp.int32(3)}
+            s = parallel.sync_gradients(g, "data")
+            return s["count"]
+
+        out = _shard_eval(mesh8, step, jnp.ones(8))
+        assert int(out) == 3
+
+
+class TestReducer:
+    def test_manual_reduce(self, mesh8):
+        red = parallel.Reducer("data")
+
+        def step(x):
+            return red.reduce({"p": x})["p"]
+
+        out = _shard_eval(mesh8, step, jnp.arange(8.0))
+        np.testing.assert_allclose(out, 3.5)
+
+
+class TestDDP:
+    def test_wrapped_training_matches_single_device(self, mesh8):
+        """A DDP step on 8 shards == single-device step on the full batch
+        (the fundamental DDP equivalence the reference race test checks)."""
+        ddp = parallel.DistributedDataParallel(mesh8)
+        w0 = jnp.ones((128,)) * 0.5
+        x = jnp.arange(64.0 * 128).reshape(64, 128) / 1e4
+        lr = 0.1
+
+        def loss_fn(w, xb):
+            return jnp.mean(jnp.square(xb @ w))
+
+        def step(w, xb):
+            loss, g = jax.value_and_grad(loss_fn)(w, xb)
+            g = ddp.sync({"w": g})["w"]
+            return w - lr * g, jax.lax.pmean(loss, "data")
+
+        stepped = ddp.wrap(step, donate_state=False)
+        w_ddp, loss_ddp = stepped(w0, x)
+
+        loss_ref, g_ref = jax.value_and_grad(loss_fn)(w0, x)
+        w_ref = w0 - lr * g_ref
+        np.testing.assert_allclose(np.asarray(w_ddp), np.asarray(w_ref),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(loss_ddp), float(loss_ref),
+                                   rtol=1e-5)
+
+    def test_no_sync_accumulation(self, mesh8):
+        ddp = parallel.DistributedDataParallel(mesh8)
+
+        def step(x):
+            return ddp.sync({"g": x})["g"]
+
+        with ddp.no_sync():
+            out = _shard_eval(mesh8, step, jnp.arange(8.0),
+                              out_specs=P("data"))
+        np.testing.assert_allclose(out, np.arange(8.0))  # untouched
+        out = _shard_eval(mesh8, step, jnp.arange(8.0))
+        np.testing.assert_allclose(out, 3.5)
+
+    def test_no_sync_after_compile(self, mesh8):
+        """no_sync must affect a step already compiled by ddp.wrap (the flag
+        is trace-time state; wrap keeps one program per flag value)."""
+        ddp = parallel.DistributedDataParallel(mesh8)
+
+        def step(state, x):
+            return state, ddp.sync({"g": x})["g"]
+
+        stepped = ddp.wrap(step, donate_state=False,
+                           out_specs=(P(), P("data")))
+        s = jnp.float32(0.0)
+        _, synced = stepped(s, jnp.arange(8.0))
+        np.testing.assert_allclose(np.unique(np.asarray(synced)), [3.5])
+        with ddp.no_sync():
+            _, raw = stepped(s, jnp.arange(8.0))
+        np.testing.assert_allclose(np.asarray(raw), np.arange(8.0))
+        _, synced2 = stepped(s, jnp.arange(8.0))
+        np.testing.assert_allclose(np.unique(np.asarray(synced2)), [3.5])
+
+    def test_flat_all_reduce(self, mesh8):
+        def step(b):
+            return parallel.flat_all_reduce(b, "data")
+
+        buf = jnp.ones((8 * 65536,))
+        out = _shard_eval(mesh8, step, buf, in_specs=P("data"),
+                          out_specs=P())
+        np.testing.assert_allclose(out, np.ones(65536))
+
+    def test_replicate(self, mesh8):
+        p = parallel.replicate({"w": jnp.arange(4.0)}, mesh8)
+        assert p["w"].sharding.is_fully_replicated
+
+
+class TestLARC:
+    def test_rewrite_matches_reference_formula(self):
+        """Leaf-wise trust ratio per `apex/parallel/LARC.py:78-105`."""
+        p = jnp.float32(np.random.RandomState(0).randn(16, 8))
+        g = jnp.float32(np.random.RandomState(1).randn(16, 8)) * 0.01
+        lr, trust, wd, eps = 0.1, 0.02, 1e-4, 1e-8
+
+        out = parallel.larc_rewrite_grads(
+            {"w": g}, {"w": p}, lr=lr, trust_coefficient=trust,
+            weight_decay=wd, eps=eps)["w"]
+
+        pn = np.linalg.norm(np.asarray(p))
+        gn = np.linalg.norm(np.asarray(g))
+        adaptive = trust * pn / (gn + pn * wd + eps)
+        adaptive = min(adaptive / lr, 1.0)
+        expect = (np.asarray(g) + wd * np.asarray(p)) * adaptive
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-5)
+
+    def test_scale_mode(self):
+        p = jnp.ones((4,)) * 2.0
+        g = jnp.ones((4,)) * 1.0
+        out = parallel.larc_rewrite_grads(
+            {"w": g}, {"w": p}, lr=None, clip=False,
+            trust_coefficient=0.01)["w"]
+        # adaptive = 0.01 * |p|/|g| = 0.02
+        np.testing.assert_allclose(np.asarray(out), 0.02 * np.ones(4),
+                                   rtol=1e-5)
+
+    def test_zero_grad_passthrough(self):
+        # zero grad norm leaves the gradient COMPLETELY untouched — no wd
+        # fold either (`LARC.py:88` skips the whole rewrite)
+        p = jnp.ones((4,))
+        g = jnp.zeros((4,))
+        out = parallel.larc_rewrite_grads(
+            {"w": g}, {"w": p}, lr=0.1, weight_decay=0.01)["w"]
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    def test_wrapper_with_fused_sgd(self):
+        from apex_tpu.optim import FusedSGD
+        larc = parallel.LARC(FusedSGD(lr=0.1), trust_coefficient=0.02)
+        params = {"w": jnp.ones((256,))}
+        state = larc.init(params)
+        g = {"w": jnp.ones((256,)) * 0.5}
+        new_p, _ = larc.step(g, state, params)
+        assert not np.allclose(np.asarray(new_p["w"]), 1.0)

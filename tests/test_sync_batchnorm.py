@@ -1,0 +1,245 @@
+"""SyncBatchNorm vs a NumPy reference on the *combined* batch.
+
+Mirrors `tests/distributed/synced_batchnorm/two_gpu_unit_test.py` (fwd/bwd
+against combined-batch stats), `two_gpu_test_different_batch_size.py`
+(count-weighted Welford for unequal batches, via valid_count),
+`test_groups.py` (partitioned stats groups), and the fused relu/add variant.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import parallel
+
+
+def np_batchnorm(x, scale, bias, eps=1e-5):
+    """Reference BN over the full combined batch (channel-last)."""
+    axes = tuple(range(x.ndim - 1))
+    mean = x.mean(axis=axes)
+    var = x.var(axis=axes)
+    y = (x - mean) / np.sqrt(var + eps)
+    return y * scale + bias, mean, var
+
+
+def _run_sharded(mesh, fn, *args, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)(*args)
+
+
+class TestSyncBNForward:
+    def test_matches_combined_batch(self, mesh8):
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4, 4, 8).astype(np.float32)  # NHWC, N split 8 ways
+        scale = rng.rand(8).astype(np.float32) + 0.5
+        bias = rng.randn(8).astype(np.float32)
+
+        def fwd(xs):
+            y, mean, var, count = parallel.sync_batch_norm(
+                xs, jnp.asarray(scale), jnp.asarray(bias),
+                axis_name="data")
+            return y, mean, var
+
+        y, mean, var = _run_sharded(
+            mesh8, fwd, jnp.asarray(x),
+            in_specs=P("data"), out_specs=(P("data"), P(), P()))
+
+        y_ref, mean_ref, var_ref = np_batchnorm(x, scale, bias)
+        np.testing.assert_allclose(np.asarray(mean), mean_ref, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(var), var_ref, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y), y_ref, atol=1e-4)
+
+    def test_unequal_batch_sizes(self, mesh8):
+        """Ragged local batches via zero-padding + valid_count: combine must
+        be count-weighted (`two_gpu_test_different_batch_size.py`)."""
+        rng = np.random.RandomState(1)
+        C = 4
+        # device i contributes i+1 valid rows (rest zero padding)
+        counts = np.arange(1, 9)
+        rows = []
+        for i, n in enumerate(counts):
+            block = np.zeros((8, C), np.float32)
+            block[:n] = rng.randn(n, C)
+            rows.append(block)
+        x = np.stack(rows)  # (8, 8, C)
+        valid = np.concatenate([np.full(n, True).tolist()
+                                + np.full(8 - n, False).tolist()
+                                for n in counts])
+        flat_valid = np.concatenate([r[:n] for r, n in zip(rows, counts)])
+
+        def fwd(xs, n_valid):
+            # zero-padded local batch + valid_count: the public API path
+            return parallel.sync_moments(
+                xs, axis_name="data", reduce_axes=(0,),
+                valid_count=n_valid[0])
+
+        mean, var, count = _run_sharded(
+            mesh8, fwd,
+            jnp.asarray(x).reshape(64, C), jnp.asarray(counts, jnp.float32),
+            in_specs=(P("data"), P("data")), out_specs=(P(), P(), P()))
+
+        np.testing.assert_allclose(float(count), counts.sum())
+        np.testing.assert_allclose(np.asarray(mean),
+                                   flat_valid.mean(axis=0), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(var),
+                                   flat_valid.var(axis=0), atol=1e-5)
+
+    def test_stats_groups(self, mesh8):
+        """Two stats groups of 4: each group normalizes with its own
+        combined stats (`test_groups.py`)."""
+        rng = np.random.RandomState(2)
+        x = rng.randn(16, 4).astype(np.float32)
+        groups = parallel.syncbn_stats_groups(8, 4)
+        assert groups == [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+        def fwd(xs):
+            mean, var, count = parallel.sync_moments(
+                xs, axis_name="data", reduce_axes=(0,),
+                axis_index_groups=groups)
+            return jax.lax.all_gather(mean, "data")
+
+        means = _run_sharded(mesh8, fwd, jnp.asarray(x),
+                             in_specs=P("data"), out_specs=P())
+        # first 4 devices see rows 0..7, last 4 see rows 8..15
+        np.testing.assert_allclose(np.asarray(means)[0],
+                                   x[:8].mean(axis=0), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(means)[7],
+                                   x[8:].mean(axis=0), atol=1e-5)
+
+    def test_fused_add_relu(self, mesh8):
+        rng = np.random.RandomState(3)
+        x = rng.randn(16, 4).astype(np.float32)
+        z = rng.randn(16, 4).astype(np.float32)
+
+        def fwd(xs, zs):
+            y, *_ = parallel.sync_batch_norm(
+                xs, None, None, axis_name="data", z=zs, relu=True)
+            return y
+
+        y = _run_sharded(mesh8, fwd, jnp.asarray(x), jnp.asarray(z),
+                         in_specs=(P("data"), P("data")),
+                         out_specs=P("data"))
+        mean, var = x.mean(0), x.var(0)
+        expect = np.maximum((x - mean) / np.sqrt(var + 1e-5) + z, 0.0)
+        np.testing.assert_allclose(np.asarray(y), expect, atol=1e-4)
+
+
+class TestSyncBNBackward:
+    def test_grads_match_full_batch_bn(self, mesh8):
+        """d(loss)/dx through SyncBN across shards == through plain BN on
+        the combined batch — the hand-written backward of the reference
+        (`optimized_sync_batchnorm_kernel.py:77-119`) via autodiff."""
+        rng = np.random.RandomState(4)
+        x = rng.randn(16, 4).astype(np.float32)
+        scale = rng.rand(4).astype(np.float32) + 0.5
+        bias = rng.randn(4).astype(np.float32)
+
+        def loss_sharded(xs):
+            y, *_ = parallel.sync_batch_norm(
+                xs, jnp.asarray(scale), jnp.asarray(bias),
+                axis_name="data")
+            return jax.lax.psum(jnp.sum(y * y), "data")
+
+        def sharded_grad(xs):
+            return jax.grad(loss_sharded)(xs)
+
+        gx = _run_sharded(mesh8, sharded_grad, jnp.asarray(x),
+                          in_specs=P("data"), out_specs=P("data"))
+
+        def loss_full(xf):
+            axes = (0,)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.mean(jnp.square(xf), axis=axes) - mean**2
+            y = (xf - mean) / jnp.sqrt(var + 1e-5) * scale + bias
+            return jnp.sum(y * y)
+
+        gx_ref = jax.grad(loss_full)(jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
+                                   atol=1e-3)
+
+    def test_scale_bias_grads(self, mesh8):
+        rng = np.random.RandomState(5)
+        x = rng.randn(16, 4).astype(np.float32)
+        sb = {"scale": jnp.ones(4), "bias": jnp.zeros(4)}
+
+        def loss(sb_, xs):
+            y, *_ = parallel.sync_batch_norm(
+                xs, sb_["scale"], sb_["bias"], axis_name="data")
+            return jax.lax.psum(jnp.sum(y**3), "data")
+
+        def g(sb_, xs):
+            # loss is psum'd (replicated), and cross-device terms flow back
+            # through the stat collectives' transposes, so every device
+            # already holds the full gradient; pmean collapses rounding.
+            return jax.tree_util.tree_map(
+                lambda t: jax.lax.pmean(t, "data"),
+                jax.grad(loss)(sb_, xs))
+
+        got = _run_sharded(mesh8, lambda xs: g(sb, xs), jnp.asarray(x),
+                           in_specs=P("data"), out_specs=P())
+
+        def loss_full(sb_):
+            mean, var = x.mean(0), x.var(0)
+            y = (jnp.asarray(x) - mean) / np.sqrt(var + 1e-5)
+            y = y * sb_["scale"] + sb_["bias"]
+            return jnp.sum(y**3)
+
+        ref = jax.grad(loss_full)(sb)
+        np.testing.assert_allclose(np.asarray(got["scale"]),
+                                   np.asarray(ref["scale"]), rtol=1e-3)
+        np.testing.assert_allclose(np.asarray(got["bias"]),
+                                   np.asarray(ref["bias"]), rtol=1e-3)
+
+
+class TestSyncBNModule:
+    def test_module_train_and_eval(self, mesh8):
+        rng = np.random.RandomState(6)
+        x = rng.randn(16, 4, 4, 3).astype(np.float32)
+        bn = parallel.SyncBatchNorm(num_features=3, axis_name="data",
+                                    momentum=0.5)
+        variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+
+        def train_fwd(xs):
+            y, mutated = bn.apply(variables, xs,
+                                  mutable=["batch_stats"])
+            return y, mutated["batch_stats"]
+
+        y, stats = _run_sharded(mesh8, train_fwd, jnp.asarray(x),
+                                in_specs=P("data"),
+                                out_specs=(P("data"), P()))
+        mean_ref = x.mean(axis=(0, 1, 2))
+        var_ref = x.var(axis=(0, 1, 2))
+        n = x.size // 3
+        np.testing.assert_allclose(np.asarray(stats["mean"]),
+                                   0.5 * mean_ref, atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(stats["var"]),
+            0.5 * 1.0 + 0.5 * var_ref * n / (n - 1), atol=1e-4)
+
+        # eval uses running stats, no collectives needed
+        y_eval = bn.apply(
+            {"params": variables.get("params", {}),
+             "batch_stats": stats},
+            jnp.asarray(x), use_running_average=True)
+        assert y_eval.shape == x.shape
+
+    def test_convert_interceptor(self, mesh8):
+        """Unmodified flax BatchNorm syncs stats inside the context."""
+        import flax.linen as nn
+        rng = np.random.RandomState(7)
+        x = rng.randn(16, 4).astype(np.float32)
+        bn = nn.BatchNorm(use_running_average=False, momentum=0.9)
+        variables = bn.init(jax.random.PRNGKey(0), jnp.asarray(x[:2]))
+
+        def fwd(xs):
+            with parallel.convert_sync_batchnorm("data"):
+                y, _ = bn.apply(variables, xs, mutable=["batch_stats"])
+            return y
+
+        y = _run_sharded(mesh8, fwd, jnp.asarray(x),
+                         in_specs=P("data"), out_specs=P("data"))
+        y_ref, _, _ = np_batchnorm(x, np.ones(4, np.float32),
+                                   np.zeros(4, np.float32))
+        np.testing.assert_allclose(np.asarray(y), y_ref, atol=1e-4)
