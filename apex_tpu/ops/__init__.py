@@ -14,8 +14,24 @@ from apex_tpu.ops.multi_tensor import (
     per_tensor_l2norm,
 )
 from apex_tpu.ops import optim_kernels
+from apex_tpu.ops.layer_norm import (
+    FusedLayerNorm,
+    fused_layer_norm,
+    fused_layer_norm_affine,
+    layer_norm_reference,
+)
+from apex_tpu.ops.mlp import MLP, fused_mlp, mlp_reference
+from apex_tpu.ops.xentropy import (
+    softmax_cross_entropy_loss,
+    softmax_cross_entropy_reference,
+)
+from apex_tpu.ops.group_bn import BatchNorm2d_NHWC, bn_group_spec
 
 __all__ = [
     "multi_tensor_axpby", "multi_tensor_l2norm", "multi_tensor_maxnorm",
     "multi_tensor_scale", "per_tensor_l2norm", "optim_kernels",
+    "FusedLayerNorm", "fused_layer_norm", "fused_layer_norm_affine",
+    "layer_norm_reference", "MLP", "fused_mlp", "mlp_reference",
+    "softmax_cross_entropy_loss", "softmax_cross_entropy_reference",
+    "BatchNorm2d_NHWC", "bn_group_spec",
 ]

@@ -1,0 +1,77 @@
+"""NHWC BatchNorm with device-group statistics — groupbn parity.
+
+Rebuild of `apex.contrib.groupbn.BatchNorm2d_NHWC`
+(`apex/contrib/groupbn/batch_norm.py:1-225`, kernels
+`apex/contrib/csrc/groupbn/*`): NHWC BN whose statistics are exchanged
+across a group of ``bn_group`` devices, with fused residual-add and ReLU.
+
+Where the CUDA machinery went:
+
+- **NHWC persistent kernels + occupancy tuning** (`nhwc_batch_norm_kernel.h`)
+  → channels-last is the native TPU layout and XLA fuses the normalize/
+  add/relu elementwise chain into one HBM pass on its own; there is no
+  occupancy knob to tune. The capability survives; the machinery is the
+  compiler's.
+- **CUDA-IPC peer stat exchange** (`ipc.cu:68-130`, ``bn_group`` ranks
+  share buffers intra-node) → a stats sub-group over the mesh axis
+  (``axis_index_groups``), riding ICI. Group construction mirrors
+  `batch_norm.py`'s rank/bn_group bookkeeping.
+- **fused add+relu fwd/bwd** (`batch_norm_add_relu.cu`) → the ``z``/
+  ``fuse_relu`` arguments of the shared syncbn core; the ReLU mask backward
+  falls out of autodiff.
+
+The module is the same object as :class:`apex_tpu.parallel.SyncBatchNorm`
+specialized to the groupbn surface, because on TPU "optimized NHWC BN" and
+"sync BN" are one implementation — the reference needed two CUDA codebases
+for them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm, sync_batch_norm, syncbn_stats_groups,
+)
+
+
+def bn_group_spec(world_size: int, bn_group: int):
+    """axis_index_groups for a ``bn_group``-way stat exchange — the peer
+    set the reference derives from (rank, bn_group) and shares via IPC
+    handles (`batch_norm.py:46-76`)."""
+    return syncbn_stats_groups(world_size, bn_group)
+
+
+def BatchNorm2d_NHWC(num_features: int, *, fuse_relu: bool = False,
+                     bn_group: int = 1, world_size: Optional[int] = None,
+                     axis_name: Optional[str] = None,
+                     momentum: float = 0.9, epsilon: float = 1e-5,
+                     param_dtype: Any = jnp.float32) -> SyncBatchNorm:
+    """Constructor mirror of ``BatchNorm2d_NHWC(planes, fuse_relu=...,
+    bn_group=...)`` (`apex/contrib/groupbn/batch_norm.py:18-90`).
+
+    With ``bn_group > 1`` an ``axis_name`` (and ``world_size``) must be
+    given; statistics then combine across each group of ``bn_group``
+    adjacent devices on that axis. Call with a residual: ``bn(x, z)`` for
+    the bn_add_relu variant.
+    """
+    groups = None
+    if bn_group > 1:
+        if axis_name is None or world_size is None:
+            raise ValueError("bn_group > 1 needs axis_name and world_size")
+        groups = bn_group_spec(world_size, bn_group)
+    return SyncBatchNorm(
+        num_features=num_features,
+        epsilon=epsilon,
+        # reference momentum semantics: running = m*running + (1-m)*new
+        # (`batch_norm.py:93-101`); SyncBatchNorm uses the torch convention
+        # running = (1-m)*running + m*new — convert here.
+        momentum=1.0 - momentum,
+        axis_name=axis_name if bn_group > 1 else None,
+        axis_index_groups=groups,
+        channel_axis=-1,
+        fuse_relu=fuse_relu,
+        param_dtype=param_dtype,
+    )

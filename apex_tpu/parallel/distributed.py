@@ -38,8 +38,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.parallel.mesh import DATA_AXIS
 
-_REAL_DTYPES = (jnp.floating,)
-
 
 def _is_float(x):
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
@@ -171,10 +169,11 @@ class DistributedDataParallel:
 
         class _NoSync:
             def __enter__(self):
+                self._prev = ddp._sync_enabled
                 ddp._sync_enabled = False
 
             def __exit__(self, *exc):
-                ddp._sync_enabled = True
+                ddp._sync_enabled = self._prev
 
         return _NoSync()
 

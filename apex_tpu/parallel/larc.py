@@ -31,6 +31,9 @@ def larc_rewrite_grads(grads, params, *, lr, trust_coefficient: float = 0.02,
     (`LARC.py:100-103`) so the inner optimizer must not re-apply it.
     Zero-norm params or grads leave the gradient untouched (`LARC.py:88`).
     """
+    if clip and lr is None:
+        raise ValueError("clip mode requires lr")
+
     def _rewrite(g, p):
         if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
             return g
@@ -78,7 +81,7 @@ class LARC:
 
     def step(self, grads, state, params, *, lr=None):
         grads = larc_rewrite_grads(
-            grads, params, lr=self._lr(lr),
+            grads, params, lr=self._lr(lr) if self.clip else None,
             trust_coefficient=self.trust_coefficient, clip=self.clip,
             eps=self.eps, weight_decay=self.weight_decay)
         if hasattr(self.inner, "step"):
@@ -90,7 +93,7 @@ class LARC:
 
     def update(self, grads, state, params, *, lr=None):
         grads = larc_rewrite_grads(
-            grads, params, lr=self._lr(lr),
+            grads, params, lr=self._lr(lr) if self.clip else None,
             trust_coefficient=self.trust_coefficient, clip=self.clip,
             eps=self.eps, weight_decay=self.weight_decay)
         return self.inner.update(grads, state, params)

@@ -40,7 +40,10 @@ def syncbn_stats_groups(world_size: int, group_size: int):
     — `create_syncbn_process_group` (`apex/parallel/__init__.py:55-95`).
     Returns ``axis_index_groups`` for the collectives."""
     if group_size == 0 or group_size >= world_size:
-        return None
+        return None                               # whole axis
+    if group_size == 1:
+        # per-device stats (non-sync BN) — None would mean the WHOLE axis
+        return [[i] for i in range(world_size)]
     if world_size % group_size:
         raise ValueError(f"world {world_size} % group {group_size} != 0")
     return [list(range(i, i + group_size))
@@ -53,7 +56,10 @@ def _local_moments(x, reduce_axes):
     one-pass moments are the XLA equivalent)."""
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=reduce_axes)
-    var = jnp.mean(jnp.square(x32), axis=reduce_axes) - jnp.square(mean)
+    # two-pass variance: E[x²]−E[x]² cancels catastrophically in fp32 for
+    # large-mean/small-std channels (the reason the reference uses Welford)
+    shape = [1 if a in reduce_axes else s for a, s in enumerate(x.shape)]
+    var = jnp.mean(jnp.square(x32 - mean.reshape(shape)), axis=reduce_axes)
     return mean, var
 
 
