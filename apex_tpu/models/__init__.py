@@ -1,0 +1,23 @@
+"""apex_tpu.models — the model families the reference's examples/configs
+exercise (BASELINE.json): ResNet (imagenet example), DCGAN (multi-loss amp
+example), BERT-style transformer (FusedLAMB config), RNN stacks
+(`apex.RNN`).
+"""
+
+from apex_tpu.models.resnet import (
+    ResNet, ResNet18, ResNet50, ResNet101,
+    BasicBlock, BottleneckBlock, RESNET50_FLOPS_PER_IMAGE,
+)
+from apex_tpu.models.transformer import (
+    BertEncoder, BertLarge, TransformerLayer, MultiheadAttention,
+    FusedLayerNormModule, mlm_loss,
+)
+from apex_tpu.models.dcgan import Generator, Discriminator
+
+__all__ = [
+    "ResNet", "ResNet18", "ResNet50", "ResNet101",
+    "BasicBlock", "BottleneckBlock", "RESNET50_FLOPS_PER_IMAGE",
+    "BertEncoder", "BertLarge", "TransformerLayer", "MultiheadAttention",
+    "FusedLayerNormModule", "mlm_loss",
+    "Generator", "Discriminator",
+]

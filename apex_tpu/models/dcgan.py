@@ -1,0 +1,57 @@
+"""DCGAN generator/discriminator — the multi-model/multi-loss example.
+
+The reference's DCGAN example is the canonical exercise of multi-model amp
+(`examples/dcgan/main_amp.py:215-253`: ``amp.initialize([netD, netG],
+[optD, optG], num_losses=3)`` with a ``loss_id`` per backward). These are
+the same G/D architectures in NHWC flax, used by the multi-scaler tests
+and the dcgan example.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class Generator(nn.Module):
+    """z (N, 1, 1, nz) → image (N, 64, 64, nc)."""
+    nz: int = 100
+    ngf: int = 64
+    nc: int = 3
+
+    @nn.compact
+    def __call__(self, z, train: bool = True):
+        def up(x, feats, first=False):
+            x = nn.ConvTranspose(
+                feats, (4, 4), (2, 2) if not first else (1, 1),
+                padding="VALID" if first else "SAME", use_bias=False)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.relu(x)
+
+        x = up(z, self.ngf * 8, first=True)        # 4x4
+        x = up(x, self.ngf * 4)                    # 8x8
+        x = up(x, self.ngf * 2)                    # 16x16
+        x = up(x, self.ngf)                        # 32x32
+        x = nn.ConvTranspose(self.nc, (4, 4), (2, 2), use_bias=False)(x)
+        return jnp.tanh(x)                         # 64x64
+
+
+class Discriminator(nn.Module):
+    """image (N, 64, 64, nc) → logit (N,)."""
+    ndf: int = 64
+    nc: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        def down(x, feats, bn=True):
+            x = nn.Conv(feats, (4, 4), (2, 2), use_bias=False)(x)
+            if bn:
+                x = nn.BatchNorm(use_running_average=not train)(x)
+            return nn.leaky_relu(x, 0.2)
+
+        x = down(x, self.ndf, bn=False)            # 32x32
+        x = down(x, self.ndf * 2)                  # 16x16
+        x = down(x, self.ndf * 4)                  # 8x8
+        x = down(x, self.ndf * 8)                  # 4x4
+        x = nn.Conv(1, (4, 4), (1, 1), padding="VALID", use_bias=False)(x)
+        return x.reshape(x.shape[0])

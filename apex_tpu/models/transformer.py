@@ -1,0 +1,123 @@
+"""BERT-style transformer encoder built on the fused ops.
+
+The reference's transformer story is its kernel set — fused MHA
+(`apex/contrib/multihead_attn`), FusedLayerNorm, fused softmax-CE, and the
+"BERT-Large pretraining with FusedLAMB" config in BASELINE.json. This
+module assembles those pieces into the encoder those configs describe:
+pre/post-LN blocks over :func:`apex_tpu.ops.fused_layer_norm_affine`,
+attention through :mod:`apex_tpu.ops.attention` (fused blockwise softmax
+when available), and an MLM head matching
+:func:`apex_tpu.ops.softmax_cross_entropy_loss`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from apex_tpu import ops
+
+
+class FusedLayerNormModule(nn.Module):
+    features: int
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("scale", nn.initializers.ones, (self.features,),
+                       jnp.float32)
+        b = self.param("bias", nn.initializers.zeros, (self.features,),
+                       jnp.float32)
+        return ops.fused_layer_norm_affine(x, w, b, self.epsilon)
+
+
+class MultiheadAttention(nn.Module):
+    """Thin wrapper over :class:`apex_tpu.ops.SelfMultiheadAttn` taking a
+    boolean mask (True = attend) instead of an additive bias — one
+    attention implementation for the whole framework."""
+    hidden: int
+    heads: int
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        from apex_tpu.ops.multihead_attn import SelfMultiheadAttn
+
+        bias = None
+        if mask is not None:
+            bias = jnp.where(mask, 0.0, -1e9).astype(jnp.float32)
+        attn = SelfMultiheadAttn(self.hidden, self.heads,
+                                 dropout=self.dropout)
+        return attn(x, attn_bias=bias, deterministic=deterministic)
+
+
+class TransformerLayer(nn.Module):
+    hidden: int
+    heads: int
+    ffn_hidden: int
+    dropout: float = 0.0
+    pre_ln: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None, deterministic: bool = True):
+        attn = MultiheadAttention(self.hidden, self.heads, self.dropout)
+        ln1 = FusedLayerNormModule(self.hidden)
+        ln2 = FusedLayerNormModule(self.hidden)
+        if self.pre_ln:
+            x = x + attn(ln1(x), mask, deterministic)
+            y = ln2(x)
+            y = nn.Dense(self.ffn_hidden)(y)
+            y = jax.nn.gelu(y)
+            y = nn.Dense(self.hidden)(y)
+            return x + y
+        x = ln1(x + attn(x, mask, deterministic))
+        y = nn.Dense(self.ffn_hidden)(x)
+        y = jax.nn.gelu(y)
+        y = nn.Dense(self.hidden)(y)
+        return ln2(x + y)
+
+
+class BertEncoder(nn.Module):
+    """BERT-style encoder: embeddings + N layers + optional MLM head."""
+    vocab_size: int
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    ffn_hidden: Optional[int] = None
+    max_len: int = 512
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, tokens, attn_mask=None, deterministic: bool = True):
+        ffn = self.ffn_hidden or 4 * self.hidden
+        emb = nn.Embed(self.vocab_size, self.hidden, name="tok_emb")(tokens)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (self.max_len, self.hidden), jnp.float32)
+        x = emb + pos[None, :tokens.shape[1]].astype(emb.dtype)
+        x = FusedLayerNormModule(self.hidden, epsilon=1e-12)(x)
+        mask = None
+        if attn_mask is not None:
+            mask = attn_mask[:, None, None, :].astype(bool)
+        for _ in range(self.layers):
+            x = TransformerLayer(self.hidden, self.heads, ffn,
+                                 self.dropout)(x, mask, deterministic)
+        return x
+
+
+def BertLarge(vocab_size: int = 30522, **kw):
+    return BertEncoder(vocab_size, hidden=1024, layers=24, heads=16, **kw)
+
+
+def mlm_loss(encoder, variables, tokens, labels, smoothing=0.0):
+    """Masked-LM loss over the fused softmax-CE (labels < 0 = unmasked)."""
+    hidden = encoder.apply(variables, tokens)
+    vocab = encoder.vocab_size
+    emb = variables["params"]["tok_emb"]["embedding"]
+    logits = hidden @ emb.T.astype(hidden.dtype)
+    losses = ops.softmax_cross_entropy_loss(logits, labels, smoothing)
+    n = jnp.maximum(jnp.sum(labels >= 0), 1)
+    return jnp.sum(losses) / n

@@ -26,6 +26,12 @@ from apex_tpu.ops.xentropy import (
     softmax_cross_entropy_reference,
 )
 from apex_tpu.ops.group_bn import BatchNorm2d_NHWC, bn_group_spec
+from apex_tpu.ops.attention import (
+    flash_attention,
+    attention_reference,
+    mask_softmax_dropout,
+)
+from apex_tpu.ops.multihead_attn import SelfMultiheadAttn, EncdecMultiheadAttn
 
 __all__ = [
     "multi_tensor_axpby", "multi_tensor_l2norm", "multi_tensor_maxnorm",
@@ -34,4 +40,6 @@ __all__ = [
     "layer_norm_reference", "MLP", "fused_mlp", "mlp_reference",
     "softmax_cross_entropy_loss", "softmax_cross_entropy_reference",
     "BatchNorm2d_NHWC", "bn_group_spec",
+    "flash_attention", "attention_reference", "mask_softmax_dropout",
+    "SelfMultiheadAttn", "EncdecMultiheadAttn",
 ]

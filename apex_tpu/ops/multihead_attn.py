@@ -1,0 +1,151 @@
+"""SelfMultiheadAttn / EncdecMultiheadAttn modules.
+
+Module mirrors of `apex.contrib.multihead_attn`
+(`self_multihead_attn.py:27-200`, `encdec_multihead_attn.py`): packed
+QKV/KV projections, ``impl='fast'`` (fused blockwise kernel,
+apex_tpu.ops.attention) vs ``impl='default'`` (pure-jnp reference path),
+optional pre-LayerNorm + residual add (``include_norm_add``, the
+``*_norm_add`` CUDA variants), additive masks, and softmax/output dropout.
+
+With softmax dropout active (training), the fast path falls back to the
+default impl — the fused kernel is deterministic; see ops.attention
+docstring.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import flax.linen as nn
+
+from apex_tpu.ops import attention as A
+from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+
+def _dropout_attention(mod, q, k, v, bias, causal, rate, deterministic):
+    """Default-impl attention with *softmax-probability* dropout — the
+    single dropout the reference applies (`softmax.h` dropout fused into
+    the probability matrix; `self_multihead_attn_func.py:120-140`)."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(d)
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        sq, sk = s.shape[-2:]
+        cmask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(cmask, s, A.NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    if rate > 0 and not deterministic:
+        rng = mod.make_rng("dropout")
+        keep = jax.random.bernoulli(rng, 1.0 - rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - rate), 0.0)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+class SelfMultiheadAttn(nn.Module):
+    """Packed-QKV self attention (`self_multihead_attn.py:27-90`).
+
+    ``separate_qkv_params`` mirrors the reference flag of the same name.
+    Inputs/outputs are (B, S, H) batch-first.
+    """
+    hidden: int
+    heads: int
+    dropout: float = 0.0
+    bias: bool = True
+    include_norm_add: bool = False
+    separate_qkv_params: bool = False
+    impl: str = "fast"
+
+    @nn.compact
+    def __call__(self, x, attn_bias=None, causal: bool = False,
+                 deterministic: bool = True):
+        h, nh = self.hidden, self.heads
+        d = h // nh
+        B, S = x.shape[0], x.shape[1]
+
+        residual = x
+        if self.include_norm_add:
+            w = self.param("ln_scale", nn.initializers.ones, (h,),
+                           jnp.float32)
+            b = self.param("ln_bias", nn.initializers.zeros, (h,),
+                           jnp.float32)
+            x = fused_layer_norm_affine(x, w, b, 1e-5)
+
+        if self.separate_qkv_params:
+            q = nn.Dense(h, use_bias=self.bias, name="q_proj")(x)
+            k = nn.Dense(h, use_bias=self.bias, name="k_proj")(x)
+            v = nn.Dense(h, use_bias=self.bias, name="v_proj")(x)
+        else:
+            qkv = nn.Dense(3 * h, use_bias=self.bias, name="qkv_proj")(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        shape4 = lambda t: t.reshape(B, S, nh, d)
+        q, k, v = map(shape4, (q, k, v))
+
+        use_fast = (self.impl == "fast"
+                    and not (self.dropout > 0 and not deterministic))
+        if use_fast:
+            ctx = A.flash_attention(q, k, v, bias=attn_bias, causal=causal)
+        else:
+            ctx = _dropout_attention(
+                self, q, k, v, attn_bias, causal, self.dropout,
+                deterministic)
+        ctx = ctx.reshape(B, S, h)
+        out = nn.Dense(h, use_bias=self.bias, name="out_proj")(ctx)
+        if self.include_norm_add:
+            out = out + residual
+        return out
+
+
+class EncdecMultiheadAttn(nn.Module):
+    """Encoder-decoder attention with packed KV
+    (`encdec_multihead_attn.py`): q from the decoder stream, k/v projected
+    together from the encoder memory."""
+    hidden: int
+    heads: int
+    dropout: float = 0.0
+    bias: bool = True
+    include_norm_add: bool = False
+    impl: str = "fast"
+
+    @nn.compact
+    def __call__(self, query, key, attn_bias=None,
+                 deterministic: bool = True):
+        h, nh = self.hidden, self.heads
+        d = h // nh
+        B, Sq = query.shape[0], query.shape[1]
+        Sk = key.shape[1]
+
+        residual = query
+        if self.include_norm_add:
+            w = self.param("ln_scale", nn.initializers.ones, (h,),
+                           jnp.float32)
+            b = self.param("ln_bias", nn.initializers.zeros, (h,),
+                           jnp.float32)
+            query = fused_layer_norm_affine(query, w, b, 1e-5)
+
+        q = nn.Dense(h, use_bias=self.bias, name="q_proj")(query)
+        kv = nn.Dense(2 * h, use_bias=self.bias, name="kv_proj")(key)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        q = q.reshape(B, Sq, nh, d)
+        k = k.reshape(B, Sk, nh, d)
+        v = v.reshape(B, Sk, nh, d)
+
+        use_fast = (self.impl == "fast"
+                    and not (self.dropout > 0 and not deterministic))
+        if use_fast:
+            ctx = A.flash_attention(q, k, v, bias=attn_bias)
+        else:
+            ctx = _dropout_attention(self, q, k, v, attn_bias, False,
+                                     self.dropout, deterministic)
+        ctx = ctx.reshape(B, Sq, h)
+        out = nn.Dense(h, use_bias=self.bias, name="out_proj")(ctx)
+        if self.include_norm_add:
+            out = out + residual
+        return out

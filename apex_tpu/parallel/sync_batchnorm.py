@@ -161,12 +161,14 @@ class SyncBatchNorm(nn.Module):
     channel_axis: int = -1
     fuse_relu: bool = False
     param_dtype: Any = jnp.float32
+    scale_init: Any = None
 
     @nn.compact
     def __call__(self, x, z=None, *, use_running_average: bool = False,
                  valid_count=None):
         c = self.num_features
-        scale = (self.param("scale", nn.initializers.ones, (c,),
+        scale_init = self.scale_init or nn.initializers.ones
+        scale = (self.param("scale", scale_init, (c,),
                             self.param_dtype) if self.affine else None)
         bias = (self.param("bias", nn.initializers.zeros, (c,),
                            self.param_dtype) if self.affine else None)

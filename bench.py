@@ -1,0 +1,101 @@
+"""Headline bench: ResNet-50 mixed-precision training throughput.
+
+The BASELINE.json metric — images/sec/chip + MFU on ResNet-50, amp O2
+(bf16 compute, fp32 masters) + fused SGD — measured on whatever single
+accelerator is present. Prints ONE JSON line.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# per-chip peak bf16 FLOP/s by device kind (public spec sheets)
+_PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in _PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 0.0  # unknown/CPU: MFU reported as 0
+
+
+def main():
+    from apex_tpu import amp, models, ops
+    from apex_tpu.optim import FusedSGD
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 128 if on_tpu else 8
+    size = 224 if on_tpu else 64
+
+    model = models.ResNet50(num_classes=1000)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, size, size, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
+
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    amp_opt = amp.Amp(amp.Policy.from_opt_level("O2"),  # bf16 compute
+                      FusedSGD(lr=0.1, momentum=0.9))
+    state = amp_opt.init(params)
+
+    @jax.jit
+    def step(state, batch_stats, xb, yb):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": batch_stats}, xb, train=True,
+                mutable=["batch_stats"])
+            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
+            return loss, mut["batch_stats"]
+
+        (loss, new_bs), grads, state, finite = amp_opt.backward(
+            state, loss_fn, has_aux=True)
+        state = amp_opt.apply_gradients(state, grads, finite)
+        return state, new_bs, loss
+
+    # warmup / compile. NOTE: sync via host fetch of the loss —
+    # block_until_ready does not actually block on the experimental axon
+    # TPU platform, producing fantasy timings.
+    for _ in range(3):
+        state, batch_stats, loss = step(state, batch_stats, x, y)
+    float(loss)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, batch_stats, loss = step(state, batch_stats, x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    # fwd+bwd ≈ 3x fwd FLOPs, scaled to the bench image size
+    flops_img = models.RESNET50_FLOPS_PER_IMAGE * 3 * (size / 224) ** 2
+    peak = peak_flops(jax.devices()[0])
+    mfu = (img_s * flops_img / peak) if peak else 0.0
+
+    print(json.dumps({
+        "metric": "resnet50_amp_o2_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(mfu / 0.60, 4),  # north star: 60% MFU
+        "extra": {"mfu": round(mfu, 4), "batch": batch, "size": size,
+                  "device": getattr(jax.devices()[0], "device_kind", "?"),
+                  "loss": float(loss)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

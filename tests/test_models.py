@@ -1,0 +1,92 @@
+"""Model family smoke + driver artifact tests (CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import models
+
+
+class TestResNet:
+    def test_resnet18_forward(self):
+        model = models.ResNet18(num_classes=10, width=16)
+        x = jnp.ones((2, 64, 64, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        y = model.apply(variables, x, train=False)
+        assert y.shape == (2, 10)
+
+    def test_resnet_train_updates_stats(self):
+        model = models.ResNet(stage_sizes=[1], num_classes=4, width=8)
+        x = jnp.asarray(np.random.RandomState(0)
+                        .randn(2, 32, 32, 3).astype(np.float32))
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        y, mut = model.apply(variables, x, train=True,
+                             mutable=["batch_stats"])
+        assert y.shape == (2, 4)
+        assert "batch_stats" in mut
+
+    def test_resnet50_param_count(self):
+        model = models.ResNet50(num_classes=1000)
+        x = jnp.ones((1, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        n = sum(int(np.prod(p.shape)) for p in
+                jax.tree_util.tree_leaves(variables["params"]))
+        # torchvision resnet50: 25.56M params
+        assert 25e6 < n < 26e6, n
+
+
+class TestTransformer:
+    def test_encoder_forward(self):
+        enc = models.BertEncoder(vocab_size=100, hidden=64, layers=2,
+                                 heads=4, max_len=32)
+        toks = jnp.ones((2, 16), jnp.int32)
+        variables = enc.init(jax.random.PRNGKey(0), toks)
+        y = enc.apply(variables, toks)
+        assert y.shape == (2, 16, 64)
+
+    def test_mlm_loss(self):
+        enc = models.BertEncoder(vocab_size=50, hidden=32, layers=1,
+                                 heads=2, max_len=16)
+        toks = jnp.ones((2, 8), jnp.int32)
+        variables = enc.init(jax.random.PRNGKey(0), toks)
+        labels = jnp.full((2, 8), -1, jnp.int32).at[0, 2].set(5)
+        loss = models.mlm_loss(enc, variables, toks, labels)
+        assert np.isfinite(float(loss))
+
+    def test_attention_mask(self):
+        enc = models.BertEncoder(vocab_size=50, hidden=32, layers=1,
+                                 heads=2, max_len=16)
+        toks = jnp.ones((1, 8), jnp.int32)
+        variables = enc.init(jax.random.PRNGKey(0), toks)
+        mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]])
+        y = enc.apply(variables, toks, attn_mask=mask)
+        assert y.shape == (1, 8, 32)
+
+
+class TestDCGAN:
+    def test_generator_shapes(self):
+        g = models.Generator(nz=16, ngf=8, nc=3)
+        z = jnp.ones((2, 1, 1, 16))
+        variables = g.init(jax.random.PRNGKey(0), z, train=False)
+        img = g.apply(variables, z, train=False)
+        assert img.shape == (2, 64, 64, 3)
+        assert bool(jnp.all(jnp.abs(img) <= 1.0))
+
+    def test_discriminator_shapes(self):
+        d = models.Discriminator(ndf=8, nc=3)
+        x = jnp.ones((2, 64, 64, 3))
+        variables = d.init(jax.random.PRNGKey(0), x, train=False)
+        logit = d.apply(variables, x, train=False)
+        assert logit.shape == (2,)
+
+
+class TestGraftEntry:
+    def test_dryrun_multichip_8(self):
+        """The driver contract: 8-virtual-device full training step."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", "__graft_entry__.py")
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.dryrun_multichip(8)
