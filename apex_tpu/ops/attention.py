@@ -266,7 +266,7 @@ def _bwd_dkv_kernel(scale, causal, kv_len, q_len, has_bias, refs):
 
 
 def _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale, causal,
-               block_q, block_k):
+               block_q, block_k, delta_shift=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
@@ -281,9 +281,12 @@ def _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale, causal,
     qp, kp, vp = pad3(q3, sqp, dp), pad3(k3, skp, dp), pad3(v3, skp, dp)
     dop = pad3(do3, sqp, dp)
 
-    # delta_i = rowsum(do * o) — flash backward's precomputed correction
+    # delta_i = rowsum(do * o) — flash backward's precomputed correction;
+    # an lse cotangent shifts it (ds = p*(dp - delta) + p*dlse)
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)
+    if delta_shift is not None:
+        delta = delta - delta_shift.astype(jnp.float32)
     # lay lse/delta out as (bh*nq*bq, LANES) lane-broadcast rows
     def lanes(x):
         xpad = jnp.pad(x, ((0, 0), (0, sqp - sq)))
@@ -450,3 +453,47 @@ def mask_softmax_dropout(scores, mask=None, dropout_rate=0.0,
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, p.shape)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     return p.astype(scores.dtype)
+
+
+# --- lse-returning variant (sequence-parallel building block) ---------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Like :func:`flash_attention` but returns ``(out, lse)`` with
+    ``lse`` (B, H, Sq) differentiable — the building block ring attention
+    needs to merge partial results across sequence shards.
+    """
+    o, (_, _, _, _, _, lse) = _flash_attention_fwd_res(
+        q, k, v, bias, scale, causal, block_q, block_k)
+    b, sq, h, d = q.shape
+    return o, lse.reshape(b, h, sq)
+
+
+def _fal_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+    o, res = _flash_attention_fwd_res(q, k, v, bias, scale, causal,
+                                      block_q, block_k)
+    b, sq, h, _ = q.shape
+    return (o, res[5].reshape(b, h, sq)), res
+
+
+def _fal_bwd(scale, causal, block_q, block_k, res, cot):
+    do, dlse = cot
+    q, k, v, bias, o, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
+    q3, k3, v3, bias3 = _to3(q, k, v, bias)
+    o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
+    do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
+    # d lse/d s = p, so the lse cotangent folds into the delta term:
+    # ds = p*(dp - delta) + p*dlse = p*(dp - (delta - dlse))
+    dlse3 = dlse.reshape(b * h, sq)
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale_,
+                               causal, block_q, block_k,
+                               delta_shift=dlse3)
+    un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
+    return un(dq3, sq), un(dk3, sk), un(dv3, sk), None
+
+
+flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)

@@ -98,6 +98,11 @@ class SelfMultiheadAttn(nn.Module):
         ctx = ctx.reshape(B, S, h)
         out = nn.Dense(h, use_bias=self.bias, name="out_proj")(ctx)
         if self.include_norm_add:
+            # reference applies output dropout before the residual add in
+            # the norm-add variant (`self_multihead_attn.py:165`,
+            # jit_dropout_add)
+            if self.dropout > 0 and not deterministic:
+                out = nn.Dropout(self.dropout, deterministic=False)(out)
             out = out + residual
         return out
 
@@ -147,5 +152,9 @@ class EncdecMultiheadAttn(nn.Module):
         ctx = ctx.reshape(B, Sq, h)
         out = nn.Dense(h, use_bias=self.bias, name="out_proj")(ctx)
         if self.include_norm_add:
+            # output dropout before residual, `encdec_multihead_attn.py`
+            # norm-add path
+            if self.dropout > 0 and not deterministic:
+                out = nn.Dropout(self.dropout, deterministic=False)(out)
             out = out + residual
         return out

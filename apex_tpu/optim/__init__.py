@@ -1,9 +1,9 @@
 """apex_tpu.optim — fused optimizers (SURVEY.md §2.4, §2.6).
 
 Single-process fused optimizers run one Pallas kernel per dtype partition
-over the flat arena. ZeRO-style distributed variants (reduce-scatter →
-sharded update → all-gather) land in apex_tpu.optim.distributed in the
-distributed milestone.
+over the flat arena. The ZeRO-style distributed variants (reduce-scatter →
+sharded update → all-gather, optionally compressed) live in
+apex_tpu.optim.distributed and run inside shard_map.
 """
 
 from apex_tpu.optim.fused import (
@@ -15,8 +15,14 @@ from apex_tpu.optim.fused import (
     FusedOptState,
     FusedSGD,
 )
+from apex_tpu.optim.distributed import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    ShardedOptState,
+)
 
 __all__ = [
     "FusedAdagrad", "FusedAdam", "FusedLAMB", "FusedNovoGrad",
     "FusedOptimizer", "FusedOptState", "FusedSGD",
+    "DistributedFusedAdam", "DistributedFusedLAMB", "ShardedOptState",
 ]

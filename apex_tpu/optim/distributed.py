@@ -1,0 +1,289 @@
+"""ZeRO-style sharded distributed optimizers.
+
+TPU-native rebuild of `apex.contrib.optimizers.DistributedFusedAdam` /
+`DistributedFusedLAMB` (`distributed_fused_adam.py:7-564`,
+`distributed_fused_lamb.py:7-607`): gradients are **reduce-scattered** over
+the data axis, the fused update runs on each device's **shard** of the
+fp32 master/momentum arena, and the new parameters come back with one
+**all-gather** — optionally in a compressed dtype (the reference's e5m2
+all-gather; here any jnp dtype incl. ``float8_e5m2``/``bfloat16``).
+
+What the reference engineers by hand maps to mesh/XLA machinery:
+
+- block×chunk×shard layout with 128-byte alignment
+  (`distributed_fused_adam.py:99-148`) → the flat arena + shard padding;
+  a shard is a contiguous slice.
+- per-param backward hooks flushing blocks through round-robin CUDA
+  streams (`:303-353`) → XLA overlaps the reduce-scatter with remaining
+  backward compute under one jit.
+- two-level intra/inter-node reduction (`:250-290,319-341`) → pass
+  ``axis_name=("data_inter", "data_intra")`` over a factorized mesh: the
+  scatter applies per axis in order (shard index = axis-major
+  linearization), and XLA routes each hop on the right interconnect.
+- reversible update / fp32 double-buffer overflow revert (`:75-80,
+  446-533`) → unnecessary: the functional state simply isn't committed on
+  overflow (``amp.apply_gradients`` selects the old state) — a genuine
+  simplification the SURVEY calls out.
+- the v2/v3 iterations of the reference are earlier drafts of the same
+  pipeline; this one implementation covers their capability surface.
+
+Used inside ``shard_map``: ``init`` slices this device's shard, ``step``
+issues the collectives. The class speaks the fused-optimizer protocol
+(``step``/``update``), so ``apex_tpu.amp.Amp`` drives it unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import arena
+from apex_tpu.optim.fused import FusedOptimizer
+from apex_tpu.ops import optim_kernels as K
+from apex_tpu.ops import multi_tensor as MT
+from apex_tpu.ops._dispatch import BLOCK_ROWS, LANES
+
+Axis = Union[str, Tuple[str, ...]]
+
+_SHARD_ALIGN = BLOCK_ROWS * LANES  # per-shard length stays Pallas-tileable
+
+
+class ShardedOptState(NamedTuple):
+    """count + per-partition sharded slots. ``slots["master"][dt]`` is this
+    device's fp32 master shard for the ``dt`` param partition."""
+    count: jax.Array
+    slots: Dict[str, Dict[str, jax.Array]]
+
+
+def _axes(axis_name: Axis) -> Tuple[str, ...]:
+    return (axis_name,) if isinstance(axis_name, str) else tuple(axis_name)
+
+
+def _world(axis_name: Axis) -> int:
+    n = 1
+    for a in _axes(axis_name):
+        n *= jax.lax.axis_size(a)
+    return n
+
+
+def _my_rank(axis_name: Axis) -> jax.Array:
+    """Linearized rank, axis-major in axis order — matches the tile order
+    produced by scattering over each axis in sequence."""
+    r = jnp.int32(0)
+    for a in _axes(axis_name):
+        r = r * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return r
+
+
+def _padded_len(n: int, world: int) -> int:
+    per = -(-n // world)
+    per = -(-per // _SHARD_ALIGN) * _SHARD_ALIGN
+    return per * world
+
+
+def _reduce_scatter_mean(buf, axis_name: Axis, world: int):
+    """Mean-reducing scatter over (possibly nested) axes: scatter each
+    axis in order, so device (i0, i1, ...) ends with tile
+    i0·n1·… + i1·… (axis-major) — the intra/inter-group pipeline of
+    `_pipeline_block_reductions` (`distributed_fused_adam.py:319-341`)."""
+    out = buf
+    for a in _axes(axis_name):
+        out = jax.lax.psum_scatter(out, a, scatter_dimension=0, tiled=True)
+    return out / world
+
+
+def _all_gather_shard(shard, axis_name: Axis):
+    """Exact inverse of :func:`_reduce_scatter_mean`'s tiling: gather the
+    axes in reverse order."""
+    out = shard
+    for a in reversed(_axes(axis_name)):
+        out = jax.lax.all_gather(out, a, axis=0, tiled=True)
+    return out
+
+
+class DistributedFusedAdam(FusedOptimizer):
+    """Sharded Adam/AdamW over a mesh axis (or axis tuple).
+
+    Constructor mirrors `distributed_fused_adam.py:30-95`'s semantic knobs;
+    ``param_gather_dtype`` is the compressed all-gather
+    (``e5m2_allgather``): new params travel in this dtype and are cast to
+    the param dtype on arrival. ``update`` (optax protocol) is inherited
+    from :class:`FusedOptimizer`.
+    """
+
+    slot_names = ("master", "m", "v")
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adam_w_mode=True, bias_correction=True,
+                 axis_name: Axis = "data", max_grad_norm: float = 0.0,
+                 param_gather_dtype=None):
+        super().__init__(lr)
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+        self.axis_name = axis_name
+        self.max_grad_norm = max_grad_norm
+        self.param_gather_dtype = param_gather_dtype
+
+    # -- sharding helpers ----------------------------------------------------
+
+    def _pad_full(self, buf, buffer_len: int, world: int):
+        total = _padded_len(buffer_len, world)
+        return jnp.pad(buf, (0, total - buffer_len))
+
+    def _scatter_grads(self, spec, grads, world: int):
+        """flatten + pad + reduce-scatter every partition (one flatten for
+        the whole tree)."""
+        g_bufs = arena.flatten(grads, spec, cast=jnp.float32)
+        out = {}
+        for part in spec.partitions:
+            g = self._pad_full(g_bufs[part.dtype], part.buffer_len, world)
+            out[part.dtype] = _reduce_scatter_mean(g, self.axis_name,
+                                                   world)
+        return out
+
+    def _shard_segment_ids(self, spec, part, world: int):
+        """This shard's slice of the arena position→tensor map (-1 in
+        padding) — arena.segment_ids_device padded and sliced."""
+        ids = arena.segment_ids_device(spec, part.dtype)
+        total = _padded_len(part.buffer_len, world)
+        per = total // world
+        ids = jnp.pad(ids, (0, total - part.buffer_len),
+                      constant_values=-1)
+        rank = _my_rank(self.axis_name)
+        return jax.lax.dynamic_slice_in_dim(ids, rank * per, per)
+
+    # -- state ---------------------------------------------------------------
+
+    def init(self, params) -> ShardedOptState:
+        """Build this device's shard of master + moment state. Must run
+        inside shard_map over ``axis_name``."""
+        spec = arena.plan(params)
+        world = _world(self.axis_name)
+        rank = _my_rank(self.axis_name)
+        full_bufs = arena.flatten(params, spec, cast=jnp.float32)
+        slots = {name: {} for name in self.slot_names}
+        for part in spec.partitions:
+            dt = part.dtype
+            full = self._pad_full(full_bufs[dt], part.buffer_len, world)
+            per = full.shape[0] // world
+            shard = jax.lax.dynamic_slice_in_dim(full, rank * per, per)
+            slots["master"][dt] = shard
+            slots["m"][dt] = jnp.zeros_like(shard)
+            slots["v"][dt] = jnp.zeros_like(shard)
+        return ShardedOptState(count=jnp.int32(0), slots=slots)
+
+    # -- update --------------------------------------------------------------
+
+    def _grad_clip_scale(self, g_shards):
+        """Global grad-norm clip from sharded pieces: local shard sq-sums
+        psum to the exact global norm (the async L2-norm pipeline,
+        `distributed_fused_adam.py:343-353`)."""
+        if not self.max_grad_norm:
+            return 1.0
+        sq = sum(jnp.square(MT.multi_tensor_l2norm(g))
+                 for g in g_shards.values())
+        for a in _axes(self.axis_name):
+            sq = jax.lax.psum(sq, a)
+        gnorm = jnp.sqrt(sq)
+        return jnp.where(gnorm > self.max_grad_norm,
+                         self.max_grad_norm / gnorm, 1.0)
+
+    def _shard_update(self, spec, part, g, slots, count, lr, clip, world):
+        """Per-partition sharded update → (state slot updates, wire buf)."""
+        dt = part.dtype
+        res = K.adam_update(
+            slots["master"][dt], g, slots["m"][dt], slots["v"][dt],
+            lr=lr, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay, step=count,
+            adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, grad_scale=clip,
+            param_copy_dtype=self.param_gather_dtype)
+        if self.param_gather_dtype is not None:
+            p_shard, m2, v2, wire = res
+        else:
+            p_shard, m2, v2 = res
+            wire = p_shard
+        return {"master": p_shard, "m": m2, "v": v2}, wire
+
+    def step(self, grads, state: ShardedOptState, params):
+        spec = arena.plan(params)
+        world = _world(self.axis_name)
+        count = state.count + 1
+        lr = self.lr(count) if callable(self.lr) else self.lr
+
+        g_shards = self._scatter_grads(spec, grads, world)
+        clip = self._grad_clip_scale(g_shards)
+
+        new_p, new_slots = {}, {name: {} for name in self.slot_names}
+        for part in spec.partitions:
+            dt = part.dtype
+            slot_updates, wire = self._shard_update(
+                spec, part, g_shards[dt], state.slots, count, lr, clip,
+                world)
+            for name, val in slot_updates.items():
+                new_slots[name][dt] = val
+            gathered = _all_gather_shard(wire, self.axis_name)
+            new_p[dt] = gathered[:part.buffer_len].astype(jnp.dtype(dt))
+        return (arena.unflatten(new_p, spec),
+                ShardedOptState(count=count, slots=new_slots))
+
+
+class DistributedFusedLAMB(DistributedFusedAdam):
+    """Sharded LAMB (`distributed_fused_lamb.py:7-607`): the Adam pipeline
+    plus per-tensor trust ratios computed from *sharded* param/update
+    norms — local segment sq-sums over the shard, psum'd to exact
+    per-tensor norms (`__compute_contrib_param_norm`,
+    `distributed_fused_lamb.py:453-472`)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
+                 weight_decay=0.01, adam_w_mode=True, bias_correction=True,
+                 axis_name: Axis = "data", max_grad_norm: float = 1.0,
+                 use_nvlamb: bool = False, param_gather_dtype=None):
+        super().__init__(lr=lr, betas=betas, eps=eps,
+                         weight_decay=weight_decay, adam_w_mode=adam_w_mode,
+                         bias_correction=bias_correction,
+                         axis_name=axis_name, max_grad_norm=max_grad_norm,
+                         param_gather_dtype=param_gather_dtype)
+        self.use_nvlamb = use_nvlamb
+
+    def _per_tensor_sq(self, buf, seg, n):
+        sq = jnp.square(buf.astype(jnp.float32))
+        sq = jnp.where(seg >= 0, sq, 0.0)
+        out = jax.ops.segment_sum(sq, jnp.maximum(seg, 0), num_segments=n)
+        for a in _axes(self.axis_name):
+            out = jax.lax.psum(out, a)
+        return out
+
+    def _shard_update(self, spec, part, g, slots, count, lr, clip, world):
+        dt = part.dtype
+        n = len(part.sizes)
+        seg = self._shard_segment_ids(spec, part, world)
+        master = slots["master"][dt]
+
+        u, m2, v2 = K.lamb_stage1(
+            master, g, slots["m"][dt], slots["v"][dt],
+            beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay, step=count,
+            bias_correction=self.bias_correction,
+            adam_w_mode=self.adam_w_mode, clip_scale=clip)
+
+        p_norms = jnp.sqrt(self._per_tensor_sq(master, seg, n))
+        u_norms = jnp.sqrt(self._per_tensor_sq(u, seg, n))
+        ratio = jnp.where((p_norms > 0) & (u_norms > 0),
+                          p_norms / u_norms, 1.0)
+        if not self.use_nvlamb and self.weight_decay == 0.0:
+            ratio = jnp.ones_like(ratio)
+        ratio_pos = jnp.where(seg >= 0, ratio[jnp.maximum(seg, 0)], 0.0)
+        p_shard = K.lamb_stage2(master, u, ratio_pos, lr=lr)
+
+        if self.param_gather_dtype is not None:
+            wire = p_shard.astype(jnp.dtype(self.param_gather_dtype))
+        else:
+            wire = p_shard
+        return {"master": p_shard, "m": m2, "v": v2}, wire

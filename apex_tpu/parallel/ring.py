@@ -1,0 +1,117 @@
+"""Ring attention & Ulysses — sequence/context parallelism.
+
+The reference has **no** long-context machinery (SURVEY.md §5: attention
+kernels are full-sequence-on-device, `apex/contrib/csrc/multihead_attn/
+softmax.h`); a TPU framework at this scale owes it. Two standard schemes
+over a ``seq`` mesh axis:
+
+- :func:`ring_attention` — q/k/v sharded on sequence; k/v blocks rotate
+  around the ring via ``ppermute`` while each device merges blockwise
+  partial attention (out, lse) pairs in log space. Memory O(S_local·D),
+  communication N-1 ppermute hops riding ICI neighbors. The per-block
+  compute is the fused flash kernel (apex_tpu.ops.attention), whose
+  lse-differentiable variant makes the whole ring a plain composition —
+  autodiff derives the reverse ring (the transpose of ppermute is the
+  inverse rotation), no hand-written backward.
+- :func:`ulysses_attention` — all-to-all re-shard: sequence-sharded
+  q/k/v become head-sharded with the full sequence per device, local
+  flash attention runs unsharded, and a second all-to-all restores
+  sequence sharding. One collective pair, best when heads % devices == 0.
+
+Causality across shards uses global-position additive bias, so the kernel
+call stays identical on every device (SPMD-friendly: no data-dependent
+branching on rank).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention, flash_attention_lse
+
+NEG_INF = -1e30
+
+
+def _global_causal_bias(sq, sk, q_off, k_off):
+    """(1, 1, sq, sk) additive bias: 0 where global q pos >= global k pos."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_off
+    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + k_off
+    return jnp.where(rows >= cols, 0.0, NEG_INF)[None, None]
+
+
+def _merge(o, lse, o_i, lse_i):
+    """Merge normalized partial attention (out, lse) pairs in log space."""
+    lse_c = jnp.logaddexp(lse, lse_i)
+    w = jnp.exp(lse - lse_c)       # (B, H, S)
+    w_i = jnp.exp(lse_i - lse_c)
+    expand = lambda t: jnp.swapaxes(t, 1, 2)[..., None]  # (B, S, H, 1)
+    o_c = o * expand(w) + o_i * expand(w_i)
+    return o_c, lse_c
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   scale: Optional[float] = None):
+    """Blockwise-exact attention over a sequence-sharded ring.
+
+    q/k/v: (B, S_local, H, D), the local sequence shard of each device on
+    ``axis_name`` (global sequence = concatenation in axis order).
+    Returns the local output shard (B, S_local, H, D).
+    """
+    world = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    sq = q.shape[1]
+    sk = k.shape[1]
+
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def block(q, kv_k, kv_v, src):
+        if causal:
+            bias = _global_causal_bias(sq, sk, my * sq, src * sk)
+        else:
+            bias = None
+        return flash_attention_lse(q, kv_k, kv_v, bias=bias, scale=scale)
+
+    o, lse = block(q, k, v, my)
+    cur_k, cur_v = k, v
+    for step in range(1, world):
+        cur_k = jax.lax.ppermute(cur_k, axis_name, perm)
+        cur_v = jax.lax.ppermute(cur_v, axis_name, perm)
+        src = (my - step) % world
+        o_i, lse_i = block(q, cur_k, cur_v, src)
+        if causal:
+            # fully-masked blocks produce lse == log(safe) garbage only on
+            # rows with zero mass; their lse is ~NEG_INF so merging is a
+            # no-op — but guard explicitly for src > my (whole block off)
+            off = src > my
+            lse_i = jnp.where(off, NEG_INF, lse_i)
+        o, lse = _merge(o, lse, o_i, lse_i)
+    return o
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      scale: Optional[float] = None):
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Re-shards (seq-sharded, all heads) → (all seq, head-sharded), runs
+    local fused attention, and restores. Requires H % axis_size == 0.
+    """
+    world = jax.lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % world:
+        raise ValueError(f"heads {h} not divisible by axis size {world}")
+
+    def scatter_heads(t):
+        # (B, S/w, H, D) -> (B, S, H/w, D)
+        return jax.lax.all_to_all(t, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def gather_heads(t):
+        return jax.lax.all_to_all(t, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    qf, kf, vf = map(scatter_heads, (q, k, v))
+    of = flash_attention(qf, kf, vf, causal=causal, scale=scale)
+    return gather_heads(of)

@@ -1,0 +1,209 @@
+"""Sharded (ZeRO-1) optimizers vs their unsharded fused counterparts.
+
+The invariant (the reference validates it with `tests/distributed/
+amp_master_params`-style cross-rank comparisons): a sharded step over N
+devices with per-device grads g_i must produce exactly the params of the
+unsharded optimizer applied to mean(g_i), identically on every device.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import optim
+
+
+def make_params(rng):
+    """Params big enough that every one of 8 shards holds real content
+    (shards are 65536-aligned; ~720k elements span 6+ shards) — small
+    params would leave ranks 1-7 pure padding and mask rank-linearization
+    or tile-order bugs."""
+    return {
+        "w1": jnp.asarray(rng.randn(600, 1200).astype(np.float32)),
+        "w2": jnp.asarray(rng.randn(257).astype(np.float32)),
+        "w3": jnp.asarray(rng.randn(8, 4, 2).astype(np.float32)),
+    }
+
+
+def per_device_grads(rng, params, world):
+    """world stacked grad trees (device i gets slice i)."""
+    return [
+        jax.tree_util.tree_map(
+            lambda p: jnp.asarray(
+                rng.randn(*p.shape).astype(np.float32)) * 0.1, params)
+        for _ in range(world)
+    ]
+
+
+def stack_grads(grads_list):
+    return jax.tree_util.tree_map(lambda *g: jnp.stack(g), *grads_list)
+
+
+def mean_grads(grads_list):
+    return jax.tree_util.tree_map(
+        lambda *g: sum(g) / len(g), *grads_list)
+
+
+def run_sharded(mesh, opt, params, grads_stacked, steps):
+    def prog(params, gstack):
+        state = opt.init(params)
+        p = params
+        for _ in range(steps):
+            p, state = opt.step(gstack, state, p)
+        return p
+
+    def wrapper(params, gstack):
+        # each device takes its grad slice
+        return prog(params, jax.tree_util.tree_map(lambda g: g[0], gstack))
+
+    return jax.jit(jax.shard_map(
+        wrapper, mesh=mesh,
+        in_specs=(P(), P("data")),
+        out_specs=P(),
+        check_vma=False))(params, grads_stacked)
+
+
+class TestDistributedFusedAdam:
+    def test_matches_unsharded_adam(self, mesh8):
+        rng = np.random.RandomState(0)
+        params = make_params(rng)
+        glist = per_device_grads(rng, params, 8)
+
+        sharded = run_sharded(
+            mesh8, optim.DistributedFusedAdam(lr=1e-2, weight_decay=0.01),
+            params, stack_grads(glist), steps=3)
+
+        ref_opt = optim.FusedAdam(lr=1e-2, weight_decay=0.01)
+        state = ref_opt.init(params)
+        p = params
+        g = mean_grads(glist)
+        for _ in range(3):
+            p, state = ref_opt.step(g, state, p)
+
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(sharded[k]), np.asarray(p[k]), atol=1e-6,
+                err_msg=k)
+
+    def test_grad_norm_clip(self, mesh8):
+        rng = np.random.RandomState(1)
+        params = make_params(rng)
+        glist = per_device_grads(rng, params, 8)
+
+        sharded = run_sharded(
+            mesh8,
+            optim.DistributedFusedAdam(lr=1e-2, max_grad_norm=0.05),
+            params, stack_grads(glist), steps=2)
+
+        # unsharded reference: clip the mean grad by global norm
+        g = mean_grads(glist)
+        gnorm = float(jnp.sqrt(sum(
+            jnp.sum(jnp.square(x))
+            for x in jax.tree_util.tree_leaves(g))))
+        scale = min(1.0, 0.05 / gnorm)
+        g_clipped = jax.tree_util.tree_map(lambda x: x * scale, g)
+        ref_opt = optim.FusedAdam(lr=1e-2)
+        state = ref_opt.init(params)
+        p = params
+        for _ in range(2):
+            p, state = ref_opt.step(g_clipped, state, p)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(sharded[k]), np.asarray(p[k]), atol=1e-6,
+                err_msg=k)
+
+    def test_compressed_allgather(self, mesh8):
+        rng = np.random.RandomState(2)
+        params = make_params(rng)
+        glist = per_device_grads(rng, params, 8)
+
+        sharded = run_sharded(
+            mesh8,
+            optim.DistributedFusedAdam(lr=1e-2,
+                                       param_gather_dtype=jnp.bfloat16),
+            params, stack_grads(glist), steps=1)
+
+        ref_opt = optim.FusedAdam(lr=1e-2)
+        p, _ = ref_opt.step(mean_grads(glist), ref_opt.init(params), params)
+        for k in params:
+            # params traveled as bf16: match to bf16 resolution
+            np.testing.assert_allclose(
+                np.asarray(sharded[k]), np.asarray(p[k]), atol=2e-2,
+                rtol=1e-2, err_msg=k)
+            assert sharded[k].dtype == params[k].dtype
+
+    def test_hierarchical_axes(self, mesh4x2):
+        rng = np.random.RandomState(3)
+        params = make_params(rng)
+        glist = per_device_grads(rng, params, 8)
+
+        def wrapper(params, gstack):
+            opt = optim.DistributedFusedAdam(
+                lr=1e-2, axis_name=("data", "model"))
+            state = opt.init(params)
+            g = jax.tree_util.tree_map(lambda x: x[0, 0], gstack)
+            p, _ = opt.step(g, state, params)
+            return p
+
+        gstack = jax.tree_util.tree_map(
+            lambda g: g.reshape(4, 2, *g.shape[1:]), stack_grads(glist))
+        sharded = jax.jit(jax.shard_map(
+            wrapper, mesh=mesh4x2,
+            in_specs=(P(), P("data", "model")),
+            out_specs=P(),
+            check_vma=False))(params, gstack)
+
+        ref_opt = optim.FusedAdam(lr=1e-2)
+        p, _ = ref_opt.step(mean_grads(glist), ref_opt.init(params), params)
+        for k in params:
+            # two-stage reduction reorders the float sum: tiny drift only
+            np.testing.assert_allclose(
+                np.asarray(sharded[k]), np.asarray(p[k]), atol=1e-4,
+                err_msg=k)
+
+
+class TestDistributedFusedLAMB:
+    def test_matches_unsharded_lamb(self, mesh8):
+        rng = np.random.RandomState(4)
+        params = make_params(rng)
+        glist = per_device_grads(rng, params, 8)
+        kw = dict(lr=1e-2, weight_decay=0.01, max_grad_norm=1.0)
+
+        sharded = run_sharded(
+            mesh8, optim.DistributedFusedLAMB(**kw),
+            params, stack_grads(glist), steps=3)
+
+        ref_opt = optim.FusedLAMB(**kw)
+        state = ref_opt.init(params)
+        p = params
+        g = mean_grads(glist)
+        for _ in range(3):
+            p, state = ref_opt.step(g, state, p)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(sharded[k]), np.asarray(p[k]), atol=1e-5,
+                err_msg=k)
+
+    def test_state_is_sharded(self, mesh8):
+        """Master/moment state per device is 1/8 of the padded arena —
+        the actual ZeRO memory win."""
+        rng = np.random.RandomState(5)
+        params = make_params(rng)
+
+        def get_state_size(params):
+            opt = optim.DistributedFusedAdam(lr=1e-2)
+            state = opt.init(params)
+            return jnp.int32(sum(
+                x.size for x in jax.tree_util.tree_leaves(state.slots)))
+
+        size = jax.jit(jax.shard_map(
+            get_state_size, mesh=mesh8, in_specs=P(),
+            out_specs=P(), check_vma=False))(params)
+        from apex_tpu.optim.distributed import _padded_len
+        from apex_tpu import arena
+        spec = arena.plan(params)
+        total = sum(_padded_len(pt.buffer_len, 8)
+                    for pt in spec.partitions)
+        assert int(size) == 3 * total // 8
