@@ -1,0 +1,131 @@
+"""RNN stacks — `apex.RNN` rebuilt on lax.scan.
+
+The reference (`apex/RNN/models.py:8-54`, `RNNBackend.py:25-365`,
+`cells.py`) hand-rolls Python-loop RNN execution so fp16 works (torch's
+fused cuDNN RNNs didn't); on TPU the equivalent is ``lax.scan`` cells
+compiled by XLA — flax's scan-based ``nn.RNN`` over standard cells, plus
+the reference's signature extra: the multiplicative LSTM (``mLSTM``,
+`cells.py` mLSTMRNNCell). Same factory surface: ``LSTM``, ``GRU``,
+``Tanh``, ``ReLU``, ``mLSTM``, each returning a stacked (optionally
+bidirectional) module.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class mLSTMCell(nn.RNNCellBase):
+    """Multiplicative LSTM cell (`apex/RNN/cells.py` mLSTMRNNCell):
+    an intermediate multiplicative state m = (Wmx·x) ⊙ (Wmh·h) replaces h
+    in the gate computation."""
+    features: int
+
+    @nn.compact
+    def __call__(self, carry, x):
+        c, h = carry
+        f = self.features
+        m = nn.Dense(f, use_bias=False, name="wmx")(x) * \
+            nn.Dense(f, use_bias=False, name="wmh")(h)
+        z = nn.Dense(4 * f, name="wx")(x) + nn.Dense(4 * f, name="wm")(m)
+        i, fg, g, o = jnp.split(z, 4, axis=-1)
+        i, fg, o = map(jax.nn.sigmoid, (i, fg, o))
+        g = jnp.tanh(g)
+        new_c = fg * c + i * g
+        new_h = o * jnp.tanh(new_c)
+        return (new_c, new_h), new_h
+
+    @nn.nowrap
+    def initialize_carry(self, rng, input_shape):
+        batch = input_shape[:-1]
+        return (jnp.zeros((*batch, self.features)),
+                jnp.zeros((*batch, self.features)))
+
+    @property
+    def num_feature_axes(self):
+        return 1
+
+
+def _make_cell(kind: str, hidden: int):
+    if kind == "lstm":
+        return nn.LSTMCell(hidden)
+    if kind == "gru":
+        return nn.GRUCell(hidden)
+    if kind == "tanh":
+        return nn.SimpleCell(hidden, activation_fn=jnp.tanh)
+    if kind == "relu":
+        return nn.SimpleCell(hidden, activation_fn=jax.nn.relu)
+    if kind == "mlstm":
+        return mLSTMCell(hidden)
+    raise ValueError(f"unknown cell {kind!r}")
+
+
+class StackedRNN(nn.Module):
+    """Multi-layer (optionally bidirectional) RNN over (B, T, D) inputs —
+    the `stackedRNN`/`bidirectionalRNN` wrapper (`RNNBackend.py:25-160`).
+    Inter-layer dropout matches the reference's placement."""
+    cell_type: str
+    hidden: int
+    num_layers: int = 1
+    bidirectional: bool = False
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        for layer in range(self.num_layers):
+            if self.bidirectional:
+                fwd = nn.RNN(_make_cell(self.cell_type, self.hidden),
+                             name=f"fwd_{layer}")
+                bwd = nn.RNN(_make_cell(self.cell_type, self.hidden),
+                             reverse=True, keep_order=True,
+                             name=f"bwd_{layer}")
+                x = jnp.concatenate([fwd(x), bwd(x)], axis=-1)
+            else:
+                cell = nn.RNN(_make_cell(self.cell_type, self.hidden),
+                              name=f"cell_{layer}")
+                x = cell(x)
+            if self.dropout > 0 and not deterministic \
+                    and layer < self.num_layers - 1:
+                x = nn.Dropout(self.dropout, deterministic=False)(x)
+        return x
+
+
+def LSTM(input_size: int, hidden_size: int, num_layers: int = 1,
+         bidirectional: bool = False, dropout: float = 0.0) -> StackedRNN:
+    """`apex.RNN.LSTM` factory (`models.py:40-44`). ``input_size`` is
+    accepted for signature parity (flax infers it)."""
+    del input_size
+    return StackedRNN("lstm", hidden_size, num_layers, bidirectional,
+                      dropout)
+
+
+def GRU(input_size, hidden_size, num_layers=1, bidirectional=False,
+        dropout=0.0) -> StackedRNN:
+    del input_size
+    return StackedRNN("gru", hidden_size, num_layers, bidirectional,
+                      dropout)
+
+
+def Tanh(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0) -> StackedRNN:
+    del input_size
+    return StackedRNN("tanh", hidden_size, num_layers, bidirectional,
+                      dropout)
+
+
+def ReLU(input_size, hidden_size, num_layers=1, bidirectional=False,
+         dropout=0.0) -> StackedRNN:
+    del input_size
+    return StackedRNN("relu", hidden_size, num_layers, bidirectional,
+                      dropout)
+
+
+def mLSTM(input_size, hidden_size, num_layers=1, bidirectional=False,
+          dropout=0.0) -> StackedRNN:
+    del input_size
+    return StackedRNN("mlstm", hidden_size, num_layers, bidirectional,
+                      dropout)

@@ -1,0 +1,79 @@
+"""Weight normalization — `apex.reparameterization` rebuilt.
+
+The reference implements fp16-safe weight norm with module hooks that
+recompute ``w = g · v/‖v‖`` (in fp32) before every forward
+(`apex/reparameterization/weight_norm.py:22-78`,
+`reparameterization.py:4-151`). flax ships the same reparameterization as
+``nn.WeightNorm``; this module re-exports it under the reference's API
+shape and adds the ``remove`` operation (collapse (v, g) back into a
+plain kernel — ``remove_weight_norm``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+
+class WeightNorm(nn.Module):
+    """``WeightNorm(layer)``: reparameterize ``layer``'s kernel(s) as
+    direction × magnitude. fp16-safety: norms always accumulate in fp32
+    (the entire point of the reference's implementation —
+    `weight_norm.py:8-20` explains the fp16 underflow hazard)."""
+    layer: nn.Module
+    variable_filter: Any = None
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        kw = {}
+        if self.variable_filter is not None:
+            kw["variable_filter"] = self.variable_filter
+        wn = nn.WeightNorm(self.layer, use_scale=True, **kw)
+        return wn(*args, **kwargs)
+
+
+def apply_weight_norm(layer: nn.Module, name: Optional[str] = None,
+                      dim: int = 0) -> nn.Module:
+    """Constructor-style mirror of ``apex.reparameterization.
+    apply_weight_norm(module)``. ``name``/``dim`` accepted for signature
+    parity; flax normalizes per-feature along the last axis (computed in
+    fp32, the fp16-safe norm the reference hooks exist for)."""
+    del name, dim
+    return WeightNorm(layer)
+
+
+def _norm_but_last(v):
+    red = tuple(range(v.ndim - 1))
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)),
+                            axis=red, keepdims=True))
+
+
+def remove_weight_norm(params):
+    """Collapse a weight-normed ``params`` collection back to plain
+    kernels: ``kernel = g · v/‖v‖`` materialized once — the reference's
+    ``remove_weight_norm`` (`reparameterization.py:100-130`).
+
+    flax layout in: ``{"Inner_0": {...kernel...},
+    "WeightNorm_0": {"Inner_0/kernel/scale": g}}``; out: the same tree
+    with scales folded in and the WeightNorm_* nodes dropped.
+    """
+    out = {k: v for k, v in params.items()
+           if not str(k).startswith("WeightNorm")}
+    out = jax.tree_util.tree_map(lambda x: x, out)  # shallow copy tree
+    for k, sub in params.items():
+        if not str(k).startswith("WeightNorm"):
+            continue
+        for skey, g in sub.items():
+            parts = str(skey).split("/")          # path.../kernel/scale
+            assert parts[-1] == "scale", skey
+            node = out
+            for p in parts[:-2]:
+                node = node[p]
+            kname = parts[-2]
+            v = node[kname]
+            node[kname] = (g * v.astype(jnp.float32) / _norm_but_last(v)
+                           ).astype(v.dtype)
+    return out

@@ -1,0 +1,108 @@
+"""ASP — automatic sparsity: 2:4 masks woven into training.
+
+Rebuild of `apex/contrib/sparsity/asp.py:23-217`. The reference mutates:
+it registers mask buffers on whitelisted modules
+(`init_model_for_pruning`, `:30`), then monkey-patches ``optimizer.step``
+to re-prune grads before and weights after every update
+(`init_optimizer_for_pruning`, `:127`). Functionally that's a *gradient/
+parameter transform*: masks are state, pruning is two tree_maps around the
+inner optimizer — checkpointing the (masks, inner state) tuple round-trips
+everything the reference saves through module/optimizer state dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.sparsity import masklib
+
+
+def default_whitelist(path: Tuple = (), leaf=None) -> bool:
+    """Mirror of the reference's whitelist (torch.nn.Linear/Conv kernels,
+    `asp.py:30-60`): prune 2-D+ kernels, skip biases/scales/embeddings
+    named like norms."""
+    names = [str(p).lower() for p in path]
+    if leaf is None or getattr(leaf, "ndim", 0) < 2:
+        return False
+    banned = ("bias", "scale", "embedding", "norm", "bn")
+    return not any(b in n for n in names for b in banned)
+
+
+class ASPState(NamedTuple):
+    masks: Any            # pytree of bool masks (None = dense leaf)
+    inner: Any            # wrapped optimizer state
+
+
+def compute_sparse_masks(params, pattern: str = "m4n2_1d",
+                         whitelist: Optional[Callable] = None):
+    """Mask pytree for ``params`` (`compute_sparse_masks`, `asp.py:155`).
+    Non-whitelisted leaves get ``None`` (dense)."""
+    whitelist = whitelist or default_whitelist
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    treedef = jax.tree_util.tree_structure(params)
+    masks = []
+    for path, leaf in flat:
+        keys = tuple(getattr(p, "key", getattr(p, "idx", p)) for p in path)
+        masks.append(masklib.create_mask(leaf, pattern)
+                     if whitelist(keys, leaf) else None)
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
+def prune(tree, masks):
+    """Apply masks leaf-wise (None = identity)."""
+    return jax.tree_util.tree_map(
+        lambda x, m: x if m is None else jnp.where(m, x, 0).astype(x.dtype),
+        tree, masks, is_leaf=lambda x: x is None)
+
+
+class ASP:
+    """Optimizer wrapper: prune grads before and params after the inner
+    update — the semantics of the patched ``optimizer.step``
+    (`asp.py:127-153`). Works with fused (step) and optax (update)
+    optimizers.
+
+    Usage::
+
+        asp = ASP(FusedSGD(lr=0.1), pattern="m4n2_1d")
+        state = asp.init(params)              # masks computed here
+        params, state = asp.step(grads, state, params)
+    """
+
+    def __init__(self, optimizer, pattern: str = "m4n2_1d",
+                 whitelist: Optional[Callable] = None):
+        self.inner = optimizer
+        self.pattern = pattern
+        self.whitelist = whitelist
+
+    def init(self, params) -> ASPState:
+        masks = compute_sparse_masks(params, self.pattern, self.whitelist)
+        return ASPState(masks=masks,
+                        inner=self.inner.init(prune(params, masks)))
+
+    def recompute_masks(self, state: ASPState, params) -> ASPState:
+        """Refresh masks from current weights (the reference recomputes on
+        demand, e.g. after loading a dense checkpoint)."""
+        return state._replace(masks=compute_sparse_masks(
+            params, self.pattern, self.whitelist))
+
+    def step(self, grads, state: ASPState, params):
+        grads = prune(grads, state.masks)
+        if hasattr(self.inner, "step"):
+            new_params, inner = self.inner.step(grads, state.inner, params)
+        else:
+            updates, inner = self.inner.update(grads, state.inner, params)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates)
+        new_params = prune(new_params, state.masks)
+        return new_params, ASPState(masks=state.masks, inner=inner)
+
+    def update(self, grads, state: ASPState, params):
+        new_params, new_state = self.step(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda n, o: (n.astype(jnp.float32)
+                          - o.astype(jnp.float32)).astype(o.dtype),
+            new_params, params)
+        return updates, new_state

@@ -1,0 +1,83 @@
+"""2:4 structured sparsity mask computation.
+
+Rebuild of `apex/contrib/sparsity/sparse_masklib.py:25-160`: for every
+contiguous group of 4 elements along the last (reduction) dimension keep
+the 2 with the pattern maximizing preserved magnitude. ``m4n2_1d`` is the
+exhaustive 6-pattern search (`create_mask`'s "1d best"); ``m4n2_2d_greedy``
+approximates the 4x4 block variant by row-wise 1d on permuted layouts.
+
+Everything is pure tensor math (the reference computes masks in torch on
+device, `sparse_masklib.py:145-160`) — jit/vmap friendly, no host loops
+over elements.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# all C(4,2)=6 binary patterns with exactly 2 of 4 kept
+_PATTERNS_4C2 = np.array(
+    [p for p in itertools.product((0, 1), repeat=4) if sum(p) == 2],
+    np.float32)                                    # (6, 4)
+
+
+def m4n2_1d(w) -> jax.Array:
+    """Boolean mask, groups of 4 along the last dim, keep best 2.
+
+    Tail elements (last dim % 4) are always kept — same behavior as the
+    reference's padding treatment.
+    """
+    shape = w.shape
+    n = shape[-1]
+    ngroups = n // 4
+    body_len = ngroups * 4
+    body = jnp.abs(w[..., :body_len].astype(jnp.float32))
+    body = body.reshape(*shape[:-1], ngroups, 4)
+    patterns = jnp.asarray(_PATTERNS_4C2)          # (6, 4)
+    scores = jnp.einsum("...gi,pi->...gp", body, patterns)
+    best = jnp.argmax(scores, axis=-1)             # (..., g)
+    mask_body = patterns[best]                     # (..., g, 4)
+    mask_body = mask_body.reshape(*shape[:-1], body_len) > 0.5
+    if body_len < n:
+        tail = jnp.ones((*shape[:-1], n - body_len), bool)
+        return jnp.concatenate([mask_body, tail], axis=-1)
+    return mask_body
+
+
+def m4n2_2d_greedy(w) -> jax.Array:
+    """Greedy 4x4-block variant (`sparse_masklib.py` "2d greedy"): 2:4
+    along the last dim computed on the transposed view as well; keep the
+    better-scoring orientation per tensor."""
+    if w.ndim < 2:
+        return m4n2_1d(w)
+    m_row = m4n2_1d(w)
+    wt = jnp.swapaxes(w, -1, -2)
+    m_col = jnp.swapaxes(m4n2_1d(wt), -1, -2)
+    w32 = jnp.abs(w.astype(jnp.float32))
+    keep = (jnp.sum(w32 * m_row) >= jnp.sum(w32 * m_col))
+    return jnp.where(keep, m_row, m_col)
+
+
+_PATTERNS = {
+    "m4n2_1d": m4n2_1d,
+    "m4n2_2d_greedy": m4n2_2d_greedy,
+}
+
+
+def create_mask(w, pattern: str = "m4n2_1d") -> jax.Array:
+    """Mask for one tensor (`sparse_masklib.py:145-160`). Tensors with
+    fewer than 4 elements in the last dim are left dense."""
+    if pattern not in _PATTERNS:
+        raise ValueError(f"unknown pattern {pattern!r}; "
+                         f"have {sorted(_PATTERNS)}")
+    if w.shape[-1] < 4:
+        return jnp.ones(w.shape, bool)
+    return _PATTERNS[pattern](w)
+
+
+def density(mask) -> float:
+    return float(jnp.mean(mask.astype(jnp.float32)))
