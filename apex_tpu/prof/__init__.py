@@ -1,0 +1,32 @@
+"""apex_tpu.prof — profiling/tracing subsystem (the pyprof equivalent).
+
+The reference's pyprof pipeline is three offline stages
+(`apex/pyprof/nvtx/nvmarker.py` annotate → nvprof → `parse/` → `prof/`
+FLOPs analyzers). TPU-native, the same capability is:
+
+- :mod:`~apex_tpu.prof.annotate` — ``scope``/``annotate`` named-scope
+  helpers + ``annotate_modules`` flax interceptor (arg shapes/dtypes per
+  module call, reversible, no monkey-patching);
+- :mod:`~apex_tpu.prof.xplane` — parse ``jax.profiler`` xplane.pb traces
+  into per-HLO-op timing records;
+- :mod:`~apex_tpu.prof.hlo` — XLA cost analysis + per-instruction
+  FLOPs/bytes estimates from optimized HLO;
+- :mod:`~apex_tpu.prof.report` — ``profile_step`` one-stop capture →
+  parse → MFU report.
+"""
+
+from apex_tpu.prof.annotate import (CallRecord, annotate, annotate_modules,
+                                    scope)
+from apex_tpu.prof.hlo import (OpEstimate, compiled_hlo, cost_analysis,
+                               op_estimates)
+from apex_tpu.prof.report import (PEAK_FLOPS, StepReport, device_peak_flops,
+                                  profile_step, trace)
+from apex_tpu.prof.xplane import OpRecord, TraceProfile, parse_trace
+
+__all__ = [
+    "CallRecord", "annotate", "annotate_modules", "scope",
+    "OpEstimate", "compiled_hlo", "cost_analysis", "op_estimates",
+    "PEAK_FLOPS", "StepReport", "device_peak_flops", "profile_step",
+    "trace",
+    "OpRecord", "TraceProfile", "parse_trace",
+]

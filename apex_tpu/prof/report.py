@@ -1,0 +1,129 @@
+"""One-stop step profiling: trace capture + per-op report + MFU.
+
+Combines the capture (``jax.profiler.trace``), the xplane parser
+(:mod:`apex_tpu.prof.xplane`) and XLA cost analysis
+(:mod:`apex_tpu.prof.hlo`) into the workflow the reference needed three
+tools for (nvtx annotate → nvprof → pyprof.parse → pyprof.prof):
+
+    rep = prof.profile_step(step_fn, state, batch)
+    print(rep.table())
+    print(rep.mfu(peak_flops=197e12))
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import tempfile
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from apex_tpu.prof import hlo as _hlo
+from apex_tpu.prof import xplane as _xplane
+
+__all__ = ["trace", "profile_step", "StepReport"]
+
+# per-chip peak bf16 FLOP/s by device kind (public spec sheets)
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak bf16 FLOP/s of a jax device, 0.0 if unknown (CPU)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "cpu")
+    for k, v in PEAK_FLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 0.0
+
+
+@contextlib.contextmanager
+def trace(logdir: str, **kwargs):
+    """Capture a profiler trace to ``logdir`` (jax.profiler.trace shim)."""
+    with jax.profiler.trace(logdir, **kwargs):
+        yield logdir
+
+
+@dataclasses.dataclass
+class StepReport:
+    """Profile of one jitted step: measured per-op times + static costs."""
+
+    profile: _xplane.TraceProfile     # measured device activity
+    cost: Dict[str, float]            # XLA cost analysis of the step
+    wall_us: float                    # host wall time per iteration
+    iters: int
+    logdir: str
+
+    @property
+    def device_us(self) -> float:
+        """Measured device time per iteration (XLA module runs)."""
+        if self.profile.module_runs:
+            return self.profile.module_total_us / self.profile.module_runs
+        return self.wall_us
+
+    def mfu(self, peak_flops: Optional[float] = None) -> float:
+        """Model FLOPs utilization vs the chip's peak, from measured time."""
+        peak = device_peak_flops() if peak_flops is None else peak_flops
+        if not peak or not self.cost["flops"]:
+            return 0.0
+        return self.cost["flops"] / (self.device_us * 1e-6) / peak
+
+    def by_category(self) -> Dict[str, float]:
+        return self.profile.by_category()
+
+    def table(self, top: int = 20) -> str:
+        head = (f"device={self.profile.device or '(none)'} "
+                f"iters={self.iters} wall/iter={self.wall_us:.0f}us "
+                f"device/iter={self.device_us:.0f}us "
+                f"flops={self.cost['flops']:.3g} "
+                f"bytes={self.cost['bytes_accessed']:.3g}")
+        cats = "  ".join(f"{k}={v:.0f}us" for k, v in
+                         list(self.by_category().items())[:8])
+        return "\n".join([head, cats, self.profile.table(top=top)])
+
+
+def profile_step(fn, *args, iters: int = 5, warmup: int = 2,
+                 logdir: Optional[str] = None, **kwargs) -> StepReport:
+    """Profile a jittable step function end to end.
+
+    Jits (if needed), warms up ``warmup`` calls, then runs ``iters``
+    calls under a profiler trace and parses the resulting xplane into
+    per-op records. Works with functions returning pytrees; results are
+    synced via host fetch of one leaf (block_until_ready is unreliable on
+    the experimental axon platform — see bench.py).
+    """
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    logdir = logdir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
+
+    def _sync(out):
+        leaves = jax.tree_util.tree_leaves(out)
+        if leaves:
+            import numpy as np
+            np.asarray(jax.device_get(leaves[0]))
+
+    for _ in range(max(warmup, 1)):
+        out = jitted(*args, **kwargs)
+    _sync(out)
+
+    t0 = time.perf_counter()
+    with trace(logdir):
+        for _ in range(iters):
+            out = jitted(*args, **kwargs)
+        _sync(out)
+    wall = (time.perf_counter() - t0) / iters
+
+    cost = _hlo.cost_analysis(jitted, *args, **kwargs)
+    prof = _xplane.parse_trace(logdir)
+    return StepReport(profile=prof, cost=cost, wall_us=wall * 1e6,
+                      iters=iters, logdir=logdir)

@@ -1,0 +1,177 @@
+"""XSpace (xplane.pb) trace parser — the pyprof.parse equivalent.
+
+The reference parses nvprof's SQLite database and correlates kernels with
+NVTX ranges (`apex/pyprof/parse/parse.py`, `db.py`, `kernel.py`). The TPU
+analogue: ``jax.profiler.trace`` writes an XSpace protobuf per host
+(``*.xplane.pb``) containing one plane per device with an "XLA Ops" line —
+one timed event per executed HLO instruction, whose metadata carries the
+full HLO text (op name, shapes, fusion kind). This module decodes that
+file into per-op records and aggregates them.
+
+Decoding uses the xplane proto bundled with the baked-in tensorflow
+(``tensorflow.tsl.profiler.protobuf.xplane_pb2``) — imported lazily so
+apex_tpu itself never depends on tensorflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import re
+from typing import Dict, List, Optional
+
+__all__ = ["OpRecord", "TraceProfile", "parse_trace", "latest_xplane"]
+
+# HLO instruction text → opcode: "%fusion.3 = f32[8]{0} fusion(...)" → the
+# word after the result shape. Shapes may be tuples "(f32[...], u32[])"
+# whose layout annotations themselves contain parens ("T(8,128)S(1)"), so
+# the tuple alternative must match balanced parens one level deep.
+_OPCODE_RE = re.compile(
+    r"^%?(?P<name>[^ ]+) = (?:\((?:[^()]|\([^()]*\))*\)|[^ ]+) "
+    r"(?P<opcode>[\w-]+)\(")
+
+_CATEGORIES = (
+    ("convolution", "conv"),
+    ("dot", "gemm"),
+    ("all-reduce", "collective"),
+    ("all-gather", "collective"),
+    ("reduce-scatter", "collective"),
+    ("all-to-all", "collective"),
+    ("collective-permute", "collective"),
+    ("copy", "copy"),
+    ("fusion", "fusion"),
+    ("custom-call", "custom-call"),
+    ("scatter", "scatter"),
+    ("reduce", "reduction"),
+    ("sort", "sort"),
+)
+
+
+def _categorize(opcode: str, hlo_text: str) -> str:
+    for prefix, cat in _CATEGORIES:
+        if opcode.startswith(prefix):
+            if cat == "fusion":
+                m = re.search(r"kind=(\w+)", hlo_text)
+                return f"fusion.{m.group(1)[1:].lower()}" if m else "fusion"
+            return cat
+    return "other"
+
+
+@dataclasses.dataclass
+class OpRecord:
+    """Aggregated timing for one HLO instruction across a trace."""
+
+    name: str           # instruction name, e.g. "fusion.31"
+    opcode: str         # HLO opcode, e.g. "fusion", "convolution"
+    category: str       # coarse category (gemm/conv/fusion.*/collective/...)
+    occurrences: int
+    total_us: float
+    hlo: str            # full HLO instruction text
+
+    @property
+    def avg_us(self) -> float:
+        return self.total_us / max(self.occurrences, 1)
+
+
+@dataclasses.dataclass
+class TraceProfile:
+    """Parsed device activity of one xplane.pb."""
+
+    path: str
+    device: str                       # plane name, e.g. "/device:TPU:0"
+    ops: List[OpRecord]               # sorted by total_us desc
+    module_runs: int                  # XLA Modules line event count
+    module_total_us: float            # wall device time inside XLA modules
+
+    def by_category(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for r in self.ops:
+            out[r.category] = out.get(r.category, 0.0) + r.total_us
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def table(self, top: int = 20) -> str:
+        total = sum(r.total_us for r in self.ops) or 1.0
+        lines = [f"{'op':<40} {'category':<16} {'count':>6} "
+                 f"{'total_us':>12} {'avg_us':>10} {'%':>6}"]
+        for r in self.ops[:top]:
+            lines.append(
+                f"{r.name[:40]:<40} {r.category:<16} {r.occurrences:>6} "
+                f"{r.total_us:>12.1f} {r.avg_us:>10.2f} "
+                f"{100 * r.total_us / total:>5.1f}%")
+        return "\n".join(lines)
+
+
+def latest_xplane(logdir: str) -> Optional[str]:
+    """Newest ``*.xplane.pb`` under a profiler logdir, or None."""
+    files = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    return max(files, key=os.path.getmtime) if files else None
+
+
+def _load_xspace(path: str):
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except Exception as e:  # pragma: no cover - env without tensorflow
+        raise ImportError(
+            "parsing xplane.pb requires the xplane proto bundled with "
+            "tensorflow (tensorflow.tsl.profiler.protobuf.xplane_pb2); "
+            f"import failed: {e!r}") from e
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def parse_trace(logdir_or_file: str, device_index: int = 0) -> TraceProfile:
+    """Parse a profiler logdir (or a specific xplane.pb) into per-op records.
+
+    Aggregates every "XLA Ops" event on the selected device plane by HLO
+    instruction. On non-TPU backends the device plane may be absent; the
+    result then has empty ``ops`` (and ``module_runs == 0``) rather than
+    raising, so callers can degrade gracefully.
+    """
+    path = logdir_or_file
+    if os.path.isdir(path):
+        found = latest_xplane(path)
+        if found is None:
+            raise FileNotFoundError(
+                f"no *.xplane.pb under {logdir_or_file!r}; did the "
+                "jax.profiler trace finish?")
+        path = found
+    xs = _load_xspace(path)
+
+    device_planes = [p for p in xs.planes if "/device:" in p.name
+                     and "CUSTOM" not in p.name and p.lines]
+    if not device_planes:
+        return TraceProfile(path=path, device="", ops=[], module_runs=0,
+                            module_total_us=0.0)
+    plane = device_planes[min(device_index, len(device_planes) - 1)]
+
+    agg: Dict[int, OpRecord] = {}
+    module_runs, module_total_ps = 0, 0
+    for line in plane.lines:
+        if line.name == "XLA Modules":
+            module_runs = len(line.events)
+            module_total_ps = sum(e.duration_ps for e in line.events)
+            continue
+        if line.name != "XLA Ops":
+            continue
+        for ev in line.events:
+            rec = agg.get(ev.metadata_id)
+            if rec is None:
+                md = plane.event_metadata[ev.metadata_id]
+                text = md.name or md.display_name
+                m = _OPCODE_RE.match(text)
+                name = m.group("name") if m else text[:40]
+                opcode = m.group("opcode") if m else "unknown"
+                rec = agg[ev.metadata_id] = OpRecord(
+                    name=name, opcode=opcode,
+                    category=_categorize(opcode, text),
+                    occurrences=0, total_us=0.0, hlo=text)
+            rec.occurrences += 1
+            rec.total_us += ev.duration_ps / 1e6
+    ops = sorted(agg.values(), key=lambda r: -r.total_us)
+    return TraceProfile(path=path, device=plane.name, ops=ops,
+                        module_runs=module_runs,
+                        module_total_us=module_total_ps / 1e6)

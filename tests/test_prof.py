@@ -1,0 +1,186 @@
+"""apex_tpu.prof tests — annotate / xplane parse / HLO cost analysis.
+
+Mirrors the reference's pyprof tests (`tests/L0/run_pyprof_nvtx`,
+`run_pyprof_data`): the nvtx tier asserts every wrapped call still
+computes correctly and markers are emitted; the data tier feeds
+hand-built kernel records through the analyzers. Here: named scopes must
+appear in lowered HLO, the module interceptor must record call shapes,
+the xplane parser is fed a hand-built XSpace proto, and cost analysis
+must report real FLOPs for a matmul.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import prof
+
+
+def test_scope_names_appear_in_hlo():
+    def f(x):
+        with prof.scope("my_marker_scope"):
+            y = x @ x
+        return jnp.tanh(y).sum()
+
+    text = jax.jit(f).lower(jnp.ones((64, 64))).as_text(debug_info=True)
+    assert "my_marker_scope" in text
+
+
+def test_annotate_decorator_preserves_semantics():
+    @prof.annotate("step")
+    def f(x):
+        return 2.0 * x
+
+    np.testing.assert_allclose(f(jnp.arange(4.0)), [0, 2, 4, 6])
+
+
+def test_annotate_modules_records_calls():
+    import flax.linen as nn
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            x = nn.Dense(8)(x)
+            return nn.Dense(4)(x)
+
+    net = Net()
+    x = jnp.ones((2, 16))
+    params = net.init(jax.random.PRNGKey(0), x)
+    with prof.annotate_modules() as records:
+        out = net.apply(params, x)
+    assert out.shape == (2, 4)
+    paths = [r.path for r in records]
+    assert any("Dense_0" in p for p in paths)
+    assert any("Dense_1" in p for p in paths)
+    dense0 = next(r for r in records if "Dense_0" in r.path)
+    assert dense0.method == "__call__"
+    assert ((2, 16), "float32") in jax.tree_util.tree_leaves(
+        [dense0.args]) or str(dense0.args).count("16")
+
+
+def test_cost_analysis_matmul_flops():
+    def f(a, b):
+        return a @ b
+
+    a = jnp.ones((128, 256), jnp.float32)
+    b = jnp.ones((256, 64), jnp.float32)
+    cost = prof.cost_analysis(f, a, b)
+    # 2*M*N*K = 2*128*64*256 = 4.19e6; XLA may count slightly differently
+    assert cost["flops"] >= 2 * 128 * 64 * 256 * 0.9
+    assert cost["bytes_accessed"] > 0
+
+
+def test_op_estimates_finds_dot():
+    def f(a, b):
+        return jnp.tanh(a @ b)
+
+    a = jnp.ones((32, 64), jnp.float32)
+    b = jnp.ones((64, 16), jnp.float32)
+    ests = prof.op_estimates(f, a, b)
+    assert ests, "no instructions parsed from optimized HLO"
+    dots = [e for e in ests if e.opcode == "dot"]
+    fusion_flops = sum(e.flops for e in ests)
+    # the dot may stay top-level or be fused; either way some op should
+    # carry the matmul flops when a top-level dot exists
+    if dots:
+        assert dots[0].flops == pytest.approx(2 * 32 * 16 * 64)
+    assert all(e.bytes >= 0 for e in ests)
+    assert fusion_flops >= 0
+
+
+def _build_xspace(tmp_path):
+    """Hand-build an XSpace proto shaped like a real TPU trace."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    plane = xs.planes.add()
+    plane.name = "/device:TPU:0"
+
+    md_mod = plane.event_metadata[1]
+    md_mod.id = 1
+    md_mod.name = "jit_step(123)"
+    md_fus = plane.event_metadata[2]
+    md_fus.id = 2
+    md_fus.name = ("%fusion.3 = f32[128,128]{1,0:T(8,128)} "
+                   "fusion(f32[128,128]{1,0} %p0), kind=kLoop, "
+                   "calls=%fused_computation")
+    md_conv = plane.event_metadata[3]
+    md_conv.id = 3
+    md_conv.name = ("%convolution.7 = f32[8,16,16,64]{3,2,1,0} "
+                    "convolution(f32[8,16,16,32]{3,2,1,0} %x, "
+                    "f32[3,3,32,64]{3,2,1,0} %w), dim_labels=b01f_01io->b01f")
+
+    mods = plane.lines.add()
+    mods.name = "XLA Modules"
+    for i in range(2):
+        ev = mods.events.add()
+        ev.metadata_id = 1
+        ev.offset_ps = i * 10**9
+        ev.duration_ps = 500_000_000  # 500 us
+
+    ops = plane.lines.add()
+    ops.name = "XLA Ops"
+    for i in range(2):
+        ev = ops.events.add()
+        ev.metadata_id = 2
+        ev.duration_ps = 100_000_000  # 100 us
+        ev = ops.events.add()
+        ev.metadata_id = 3
+        ev.duration_ps = 300_000_000  # 300 us
+
+    p = tmp_path / "host.xplane.pb"
+    p.write_bytes(xs.SerializeToString())
+    return str(p)
+
+
+def test_xplane_parser_synthetic(tmp_path):
+    pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    path = _build_xspace(tmp_path)
+    tp = prof.parse_trace(path)
+    assert tp.device == "/device:TPU:0"
+    assert tp.module_runs == 2
+    assert tp.module_total_us == pytest.approx(1000.0)
+    assert len(tp.ops) == 2
+    conv = tp.ops[0]  # sorted by total time desc: conv 600us > fusion 200us
+    assert conv.opcode == "convolution"
+    assert conv.category == "conv"
+    assert conv.occurrences == 2
+    assert conv.total_us == pytest.approx(600.0)
+    fus = tp.ops[1]
+    assert fus.category == "fusion.loop"
+    assert fus.avg_us == pytest.approx(100.0)
+    cats = tp.by_category()
+    assert cats["conv"] == pytest.approx(600.0)
+    assert "conv" in tp.table()
+
+
+def test_trace_capture_roundtrip(tmp_path):
+    """End-to-end: capture a real trace, parse it without raising."""
+    logdir = str(tmp_path / "trace")
+
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x @ x).sum()
+
+    x = jnp.ones((64, 64))
+    f(x).block_until_ready()
+    with prof.trace(logdir):
+        np.asarray(f(x))
+    found = prof.parse_trace.__globals__["latest_xplane"](logdir)
+    assert found is not None, "trace produced no xplane.pb"
+    tp = prof.parse_trace(logdir)
+    # CPU backend has no device plane; parser must degrade, not raise
+    assert isinstance(tp.ops, list)
+
+
+def test_profile_step_cpu():
+    def f(x):
+        return (x @ x).sum()
+
+    rep = prof.profile_step(f, jnp.ones((64, 64)), iters=2, warmup=1)
+    assert rep.cost["flops"] > 0
+    assert rep.wall_us > 0
+    assert isinstance(rep.table(), str)
+    # CPU: no device plane → mfu computes to 0 (peak unknown)
+    assert rep.mfu() == 0.0
