@@ -32,6 +32,9 @@ from apex_tpu import arena
 from apex_tpu import ops
 from apex_tpu import optim
 from apex_tpu import parallel
+from apex_tpu import prof
+from apex_tpu import reparam
 from apex_tpu import utils
 
-__all__ = ["amp", "arena", "ops", "optim", "parallel", "utils", "__version__"]
+__all__ = ["amp", "arena", "ops", "optim", "parallel", "prof", "reparam",
+           "utils", "__version__"]

@@ -1,0 +1,11 @@
+"""apex_tpu.reparam — weight reparameterization (apex.reparameterization).
+
+Reference: `apex/reparameterization/__init__.py` exports
+``apply_weight_norm`` / ``remove_weight_norm`` and the ``WeightNorm``
+reparameterization class.
+"""
+
+from apex_tpu.reparam.weight_norm import (WeightNorm, apply_weight_norm,
+                                          remove_weight_norm)
+
+__all__ = ["WeightNorm", "apply_weight_norm", "remove_weight_norm"]
