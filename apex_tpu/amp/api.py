@@ -125,8 +125,18 @@ class Amp:
             return scale_loss(loss, sstate), out
 
         grads, out = jax.grad(scaled, has_aux=True)(state.params)
-        grads, finite = unscale_grads(grads, sstate)
-        new_sstate = loss_scale_update(sstate, finite, self.scale_cfg)
+        if self.scale_cfg is None:
+            # No loss scaler in the policy (bf16 paths): no overflow
+            # machinery at all — grads only upcast to fp32. `finite` is a
+            # *static* True so downstream selects compile away entirely,
+            # matching the reference where no scaler means no
+            # _overflow_buf check anywhere in the step.
+            grads = tree_cast(grads, jnp.float32)
+            finite = True
+            new_sstate = sstate
+        else:
+            grads, finite = unscale_grads(grads, sstate)
+            new_sstate = loss_scale_update(sstate, finite, self.scale_cfg)
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(state.scalers))
         return out, grads, state._replace(scalers=scalers), finite
@@ -154,7 +164,11 @@ class Amp:
         committed_params = tree_select(grads_finite, new_params, state.params)
         committed_opt = tree_select(grads_finite, new_opt_state,
                                     state.opt_state)
-        new_step = state.step + jnp.where(grads_finite, 1, 0).astype(jnp.int32)
+        if isinstance(grads_finite, bool):
+            new_step = state.step + (1 if grads_finite else 0)
+        else:
+            new_step = state.step + jnp.where(grads_finite, 1, 0).astype(
+                jnp.int32)
         return state._replace(step=new_step, params=committed_params,
                               opt_state=committed_opt)
 

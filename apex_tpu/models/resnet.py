@@ -21,15 +21,24 @@ from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
 
 
 class _BN(nn.Module):
-    """BatchNorm selecting sync (mesh-axis stats) or local, NHWC."""
+    """BatchNorm selecting sync (mesh-axis stats) or local, NHWC.
+
+    ``dtype`` is the *activation* dtype (output in that dtype, stats and
+    scale/offset always fp32) — keep_batchnorm_fp32 the TPU way: fp32
+    parameters and statistics, half activations in and out, the cast
+    fused into the normalize instead of materialized in HBM.
+    """
     features: int
     axis_name: Optional[str] = None
     momentum: float = 0.9
     epsilon: float = 1e-5
     init_scale: float = 1.0
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.dtype is not None:
+            x = x.astype(self.dtype)
         if self.axis_name is not None:
             bn = SyncBatchNorm(
                 num_features=self.features, momentum=1 - self.momentum,
@@ -38,7 +47,7 @@ class _BN(nn.Module):
             return bn(x, use_running_average=not train)
         bn = nn.BatchNorm(
             use_running_average=not train, momentum=self.momentum,
-            epsilon=self.epsilon,
+            epsilon=self.epsilon, dtype=self.dtype,
             scale_init=nn.initializers.constant(self.init_scale))
         return bn(x)
 
@@ -47,27 +56,26 @@ class BottleneckBlock(nn.Module):
     features: int
     strides: Tuple[int, int] = (1, 1)
     bn_axis_name: Optional[str] = None
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(_BN, axis_name=self.bn_axis_name, dtype=self.dtype)
         residual = x
-        y = nn.Conv(self.features, (1, 1), use_bias=False)(x)
-        y = _BN(self.features, self.bn_axis_name)(y, train)
+        y = conv(self.features, (1, 1))(x)
+        y = bn(self.features)(y, train)
         y = nn.relu(y)
-        y = nn.Conv(self.features, (3, 3), self.strides,
-                    use_bias=False)(y)
-        y = _BN(self.features, self.bn_axis_name)(y, train)
+        y = conv(self.features, (3, 3), self.strides)(y)
+        y = bn(self.features)(y, train)
         y = nn.relu(y)
-        y = nn.Conv(self.features * 4, (1, 1), use_bias=False)(y)
+        y = conv(self.features * 4, (1, 1))(y)
         # zero-init the last BN scale: standard ResNet recipe (identity
         # residual at init)
-        y = _BN(self.features * 4, self.bn_axis_name, init_scale=0.0)(
-            y, train)
+        y = bn(self.features * 4, init_scale=0.0)(y, train)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.features * 4, (1, 1), self.strides,
-                               use_bias=False)(x)
-            residual = _BN(self.features * 4, self.bn_axis_name)(
-                residual, train)
+            residual = conv(self.features * 4, (1, 1), self.strides)(x)
+            residual = bn(self.features * 4)(residual, train)
         return nn.relu(residual + y)
 
 
@@ -75,19 +83,21 @@ class BasicBlock(nn.Module):
     features: int
     strides: Tuple[int, int] = (1, 1)
     bn_axis_name: Optional[str] = None
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        bn = partial(_BN, axis_name=self.bn_axis_name, dtype=self.dtype)
         residual = x
-        y = nn.Conv(self.features, (3, 3), self.strides, use_bias=False)(x)
-        y = _BN(self.features, self.bn_axis_name)(y, train)
+        y = conv(self.features, (3, 3), self.strides)(x)
+        y = bn(self.features)(y, train)
         y = nn.relu(y)
-        y = nn.Conv(self.features, (3, 3), use_bias=False)(y)
-        y = _BN(self.features, self.bn_axis_name, init_scale=0.0)(y, train)
+        y = conv(self.features, (3, 3))(y)
+        y = bn(self.features, init_scale=0.0)(y, train)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.features, (1, 1), self.strides,
-                               use_bias=False)(x)
-            residual = _BN(self.features, self.bn_axis_name)(residual, train)
+            residual = conv(self.features, (1, 1), self.strides)(x)
+            residual = bn(self.features)(residual, train)
         return nn.relu(residual + y)
 
 
@@ -98,21 +108,27 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     width: int = 64
     bn_axis_name: Optional[str] = None
+    #: activation/compute dtype — set to ``policy.compute_dtype`` for mixed
+    #: precision (the O2 model-cast; params stay ``param_dtype`` fp32 and
+    #: are cast per-op by flax, masters live in AmpState).
+    dtype: Optional[Any] = None
 
     @nn.compact
     def __call__(self, x, train: bool = True):
+        if self.dtype is not None:
+            x = x.astype(self.dtype)  # patched-forward input cast
         y = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False)(x)
-        y = _BN(self.width, self.bn_axis_name)(y, train)
+                    use_bias=False, dtype=self.dtype)(x)
+        y = _BN(self.width, self.bn_axis_name, dtype=self.dtype)(y, train)
         y = nn.relu(y)
         y = nn.max_pool(y, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 y = self.block(self.width * 2 ** i, strides,
-                               self.bn_axis_name)(y, train)
+                               self.bn_axis_name, self.dtype)(y, train)
         y = jnp.mean(y, axis=(1, 2))
-        return nn.Dense(self.num_classes)(y)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(y)
 
 
 def ResNet18(**kw):

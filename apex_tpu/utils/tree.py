@@ -60,7 +60,12 @@ def tree_select(pred, on_true, on_false):
     makes the reference's reversible-update machinery
     (`distributed_fused_adam.py:509-533`) unnecessary — we simply do not
     select the new state.
+
+    A Python-bool ``pred`` (statically known, e.g. no loss scaler in the
+    policy) short-circuits to the chosen tree with zero compiled ops.
     """
+    if isinstance(pred, bool):
+        return on_true if pred else on_false
     return jax.tree_util.tree_map(
         lambda a, b: jnp.where(pred, a, b), on_true, on_false)
 
