@@ -1,0 +1,91 @@
+"""Multi-host bring-up — the `apex.parallel.multiproc` equivalent.
+
+The reference launches one Python process per GPU with ``--rank i`` args
+and env-var rendezvous (`apex/parallel/multiproc.py:1-35`,
+`torch.distributed.launch`). On TPU pods the runtime already starts one
+process per host; what remains is initializing the JAX distributed
+client so every host sees the global device set. :func:`distributed_init`
+wraps ``jax.distributed.initialize`` with the same env-var conventions
+(`MASTER_ADDR``/``MASTER_PORT``/``RANK``/``WORLD_SIZE``) the reference's
+launcher exports, so scripts written against either convention come up.
+
+Single-host / single-process runs are a no-op — exactly like running a
+reference script without the launcher.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["distributed_init", "is_distributed", "process_index",
+           "process_count", "maybe_print"]
+
+_initialized = False
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     local_device_ids=None) -> None:
+    """Initialize multi-host JAX, tolerating the reference's env vars.
+
+    Resolution order per field: explicit argument → JAX's own env/TPU
+    metadata (pass-through None) → the torch.distributed.launch
+    convention (``MASTER_ADDR:MASTER_PORT``, ``WORLD_SIZE``, ``RANK``).
+    Safe to call unconditionally: single-process (no env, no args) is a
+    no-op, and repeat calls are ignored.
+    """
+    global _initialized
+    if _initialized:
+        return
+
+    if coordinator_address is None and "MASTER_ADDR" in os.environ:
+        port = os.environ.get("MASTER_PORT", "1234")
+        coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
+    if num_processes is None and "WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and "RANK" in os.environ:
+        process_id = int(os.environ["RANK"])
+
+    if (coordinator_address is None and num_processes is None
+            and process_id is None
+            and not os.environ.get("TPU_WORKER_HOSTNAMES")
+            and not os.environ.get("COORDINATOR_ADDRESS")):
+        return  # single process — nothing to initialize
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+#: print verbosity, the `_amp_state.verbosity` knob
+#: (`apex/amp/_amp_state.py:36-50`). 0 silences maybe_print entirely.
+verbosity = 1
+
+
+def maybe_print(msg: str, rank0: bool = False) -> None:
+    """Verbosity- and rank-aware print (`_amp_state.maybe_print`,
+    `apex/amp/_amp_state.py:38-50`)."""
+    if verbosity <= 0:
+        return
+    if rank0 and jax.process_index() != 0:
+        return
+    print(msg)
