@@ -29,6 +29,7 @@ __version__ = "0.1.0"
 
 from apex_tpu import amp
 from apex_tpu import arena
+from apex_tpu import fp16_utils
 from apex_tpu import ops
 from apex_tpu import optim
 from apex_tpu import parallel
@@ -36,5 +37,5 @@ from apex_tpu import prof
 from apex_tpu import reparam
 from apex_tpu import utils
 
-__all__ = ["amp", "arena", "ops", "optim", "parallel", "prof", "reparam",
-           "utils", "__version__"]
+__all__ = ["amp", "arena", "fp16_utils", "ops", "optim", "parallel", "prof",
+           "reparam", "utils", "__version__"]

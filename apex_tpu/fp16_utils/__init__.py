@@ -1,0 +1,31 @@
+"""apex_tpu.fp16_utils — the legacy explicit-master-weights surface.
+
+Rebuild of `apex/fp16_utils` (`apex/fp16_utils/__init__.py:1-16`): the
+pre-Amp API where the user owns the master-weight bookkeeping —
+``FP16_Optimizer`` plus the ``network_to_half`` / ``prep_param_lists`` /
+``master_params_to_model_params`` / ``clip_grad_norm`` utility family.
+Everything the modern :mod:`apex_tpu.amp` bundle does implicitly is
+explicit here, for users who want the pieces.
+"""
+
+from apex_tpu.fp16_utils.fp16util import (
+    FP16Model,
+    clip_grad_norm,
+    convert_network,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    to_python_float,
+    tofp16,
+)
+from apex_tpu.fp16_utils.fp16_optimizer import FP16_Optimizer, FP16OptState
+from apex_tpu.fp16_utils.loss_scaler import DynamicLossScaler, LossScaler
+
+__all__ = [
+    "FP16Model", "clip_grad_norm", "convert_network",
+    "master_params_to_model_params", "model_grads_to_master_grads",
+    "network_to_half", "prep_param_lists", "to_python_float", "tofp16",
+    "FP16_Optimizer", "FP16OptState",
+    "DynamicLossScaler", "LossScaler",
+]

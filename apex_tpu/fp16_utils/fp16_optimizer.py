@@ -1,0 +1,179 @@
+"""FP16_Optimizer — explicit master-weights wrapper.
+
+Rebuild of `apex/fp16_utils/fp16_optimizer.py:13-554`: the pre-Amp API
+where master weights, loss scaling, overflow skipping, and gradient
+clipping are explicit user-visible operations rather than a bundled
+policy. The reference mutates the wrapped ``torch.optim`` optimizer in
+place; here the same lifecycle is a functional state machine:
+
+    opt = FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True)
+    state = opt.init(model_params)                 # fp32 masters + scaler
+    mp = opt.model_params(state, like=model_params)  # half view for fwd
+
+    loss, grads, finite, state = opt.backward(state, loss_fn)
+    grads, norm = opt.clip_master_grads(grads, 5.0)  # optional
+    state = opt.step(state, grads, finite)
+
+``backward`` mirrors ``FP16_Optimizer.backward(loss)`` +
+``update_master_grads`` (`fp16_optimizer.py:373-491`): scale → grad →
+master-fp32 cast → unscale → overflow check → scale-schedule update.
+``step`` mirrors `fp16_optimizer.py:272-332`: skipped entirely when the
+last backward overflowed (params, optimizer state and step counter all
+hold — the bitwise-skip contract).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
+                                 loss_scale_init, loss_scale_update,
+                                 scale_loss, unscale_grads)
+from apex_tpu.utils import global_norm, tree_cast, tree_select
+
+
+class FP16OptState(NamedTuple):
+    """Masters + inner optimizer state + scaler — a checkpointable pytree.
+
+    The reference's ``state_dict`` saves exactly this set
+    (`fp16_optimizer.py:209-270`): scaler state, overflow flag, inner
+    optimizer state, and the fp32 master groups.
+    """
+    step: jax.Array
+    masters: Any                       # fp32 master params
+    inner_state: Any                   # wrapped optimizer state
+    scaler: Optional[LossScaleState]
+
+
+class FP16_Optimizer:
+    def __init__(self, init_optimizer, *, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 half_dtype=jnp.float16, verbose: bool = False):
+        self.tx = init_optimizer
+        self.half_dtype = jnp.dtype(half_dtype)
+        if dynamic_loss_scale:
+            args = dynamic_loss_args or {}
+            # legacy dynamic defaults: init 2**32, window 1000
+            self.cfg = LossScaleConfig(
+                init_scale=args.get("init_scale", 2.0 ** 32),
+                growth_interval=args.get("scale_window", 1000),
+                backoff_factor=1.0 / args.get("scale_factor", 2.0),
+                growth_factor=args.get("scale_factor", 2.0),
+                max_loss_scale=args.get("init_scale", 2.0 ** 32),
+                dynamic=True)
+        else:
+            self.cfg = LossScaleConfig(init_scale=static_loss_scale,
+                                       dynamic=False)
+        self.verbose = verbose
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def init(self, model_params) -> FP16OptState:
+        """fp32 masters from (possibly half) model params —
+        ``prep_param_lists`` at construction (`fp16_optimizer.py:43-95`)."""
+        masters = tree_cast(model_params, jnp.float32)
+        return FP16OptState(
+            step=jnp.int32(0),
+            masters=masters,
+            inner_state=self.tx.init(masters),
+            scaler=loss_scale_init(self.cfg))
+
+    def model_params(self, state: FP16OptState, like=None):
+        """Half-precision view of the masters for the forward pass —
+        ``_master_params_to_model_params`` (`fp16_optimizer.py:160-172`).
+        ``like`` (a params tree) overrides the target dtype per leaf."""
+        if like is not None:
+            return jax.tree_util.tree_map(
+                lambda m, p: m.astype(p.dtype), state.masters, like)
+        return tree_cast(state.masters, self.half_dtype)
+
+    # -- backward ------------------------------------------------------------
+
+    def backward(self, state: FP16OptState, loss_fn: Callable, *args,
+                 has_aux: bool = False, **kwargs):
+        """Scaled backward + master-grad production.
+
+        ``loss_fn(model_params, ...)`` runs at the half view of the
+        masters (so grads arrive w.r.t. masters in fp32 — the reference's
+        ``model_grads_to_master_grads`` copy falls out of autodiff).
+        Returns ``(out, master_grads, finite, state')`` with the scale
+        schedule already advanced (`backward` + ``update_master_grads``,
+        `fp16_optimizer.py:373-491`).
+        """
+        sstate = state.scaler
+
+        def scaled(masters):
+            mp = tree_cast(masters, self.half_dtype)
+            out = loss_fn(mp, *args, **kwargs)
+            loss = out[0] if has_aux else out
+            return scale_loss(loss, sstate), out
+
+        grads, out = jax.grad(scaled, has_aux=True)(state.masters)
+        grads, finite = unscale_grads(grads, sstate)
+        new_scaler = loss_scale_update(sstate, finite, self.cfg)
+        return out, grads, finite, state._replace(scaler=new_scaler)
+
+    # -- utilities -----------------------------------------------------------
+
+    def clip_master_grads(self, grads, max_norm, norm_type=2):
+        """Clip master grads by global norm, returning (grads, norm) —
+        ``clip_master_grads`` (`fp16_optimizer.py:185-207`)."""
+        total = global_norm(grads, ord=norm_type)
+        scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads), total
+
+    def loss_scale(self, state: FP16OptState) -> jax.Array:
+        return state.scaler.loss_scale
+
+    # -- step ----------------------------------------------------------------
+
+    def step(self, state: FP16OptState, master_grads, finite) -> FP16OptState:
+        """Inner-optimizer step on the masters, skipped on overflow
+        (`fp16_optimizer.py:272-332`: "OVERFLOW! Skipping step")."""
+        if hasattr(self.tx, "step") and callable(self.tx.step):
+            new_masters, new_inner = self.tx.step(
+                master_grads, state.inner_state, state.masters)
+        else:                                     # optax transform
+            updates, new_inner = self.tx.update(
+                master_grads, state.inner_state, state.masters)
+            new_masters = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), state.masters, updates)
+        masters = tree_select(finite, new_masters, state.masters)
+        inner = tree_select(finite, new_inner, state.inner_state)
+        if isinstance(finite, bool):
+            new_step = state.step + (1 if finite else 0)
+        else:
+            new_step = state.step + jnp.where(finite, 1, 0).astype(jnp.int32)
+        return state._replace(step=new_step, masters=masters,
+                              inner_state=inner)
+
+    # -- checkpoint parity ---------------------------------------------------
+
+    def state_dict(self, state: FP16OptState) -> dict:
+        """Everything `fp16_optimizer.py:209-230` saves."""
+        return {
+            "loss_scaler": None if state.scaler is None else {
+                "loss_scale": state.scaler.loss_scale,
+                "unskipped": state.scaler.growth_tracker},
+            "first_closure_call_this_step": True,   # legacy field, constant
+            "optimizer_state_dict": state.inner_state,
+            "fp32_from_fp16": state.masters,
+            "step": state.step,
+        }
+
+    def load_state_dict(self, state: FP16OptState, sd: dict) -> FP16OptState:
+        """`fp16_optimizer.py:230-270`."""
+        scaler = state.scaler
+        if sd.get("loss_scaler") is not None and scaler is not None:
+            scaler = LossScaleState(
+                loss_scale=jnp.float32(sd["loss_scaler"]["loss_scale"]),
+                growth_tracker=jnp.int32(sd["loss_scaler"]["unskipped"]))
+        return FP16OptState(
+            step=jnp.int32(sd.get("step", state.step)),
+            masters=sd["fp32_from_fp16"],
+            inner_state=sd["optimizer_state_dict"],
+            scaler=scaler)

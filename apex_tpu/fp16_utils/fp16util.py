@@ -1,0 +1,145 @@
+"""fp16 utility family — `apex/fp16_utils/fp16util.py:1-187` rebuilt.
+
+The reference operates on ``nn.Module`` instances in place (``.half()``
+walks, master clones, ``_flat_master`` concat); here the same operations
+are pure functions over param pytrees, with the flat-master path backed
+by the arena (one contiguous fp32 buffer — exactly the
+``_flatten_dense_tensors`` trick, `fp16util.py:108-113`, minus the
+per-tensor marshalling).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu import arena
+from apex_tpu.amp.policy import _NORM_COMPONENT_RE
+from apex_tpu.utils import global_norm, tree_cast
+
+
+def tofp16(tree):
+    """Cast floating leaves to fp16 — the ``tofp16`` module
+    (`fp16util.py:7-12`) as a function."""
+    return tree_cast(tree, jnp.float16)
+
+
+def _norm_exempt(path, _leaf) -> bool:
+    names = [str(getattr(k, "key", getattr(k, "name", k))).lower()
+             for k in path]
+    return any(_NORM_COMPONENT_RE.match(n) for n in names)
+
+
+def convert_network(params, dtype):
+    """Cast params to ``dtype`` keeping norm-layer params fp32 —
+    ``convert_network`` + ``BN_convert_float`` (`fp16util.py:22-71`)."""
+    return tree_cast(params, dtype,
+                     predicate=lambda p, x: not _norm_exempt(p, x))
+
+
+def network_to_half(params, half_dtype=jnp.float16):
+    """``network_to_half`` (`fp16util.py:35-41`): half params, fp32 norms."""
+    return convert_network(params, half_dtype)
+
+
+class FP16Model(nn.Module):
+    """Wrap a flax module: inputs cast to half and the network run at
+    half params (`fp16util.py:73-84`: ``network_to_half`` + input cast).
+
+    Storage params stay fp32 (they are the masters a wrapping
+    ``FP16_Optimizer`` owns); the half cast happens in-graph on the way
+    into the wrapped module, with norm-layer params exempt — exactly
+    ``convert_network``'s contract.
+    """
+
+    network: nn.Module
+    half_dtype: Any = jnp.float16
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        args = tree_cast(args, jnp.dtype(self.half_dtype))
+        half = jnp.dtype(self.half_dtype)
+
+        def run(net, *a, **k):
+            return net(*a, **k)
+
+        mapped = nn.map_variables(
+            run, "params",
+            trans_in_fn=lambda vs: convert_network(vs, half),
+            init=self.is_initializing())
+        return mapped(self.network, *args, **kwargs)
+
+
+class MasterParams(NamedTuple):
+    """Result of :func:`prep_param_lists`.
+
+    ``flat`` is None for per-tensor masters (a pytree mirroring the model
+    params in fp32), or the arena (buffers, spec) pair when
+    ``flat_master=True`` — the single contiguous fp32 buffer of
+    `fp16util.py:108-113`.
+    """
+    tree: Optional[Any]
+    flat: Optional[Tuple[Any, Any]]   # ({dtype: buffer}, ArenaSpec)
+
+    def to_tree(self):
+        if self.flat is not None:
+            bufs, spec = self.flat
+            return arena.unflatten(bufs, spec)
+        return self.tree
+
+
+def prep_param_lists(params, flat_master: bool = False):
+    """(model_params, master_params): fp32 master copies of the params
+    (`prep_param_lists`, `fp16util.py:90-134`). With ``flat_master`` the
+    masters live in one flat fp32 arena buffer."""
+    if flat_master:
+        spec = arena.plan(params)
+        bufs = arena.flatten(params, spec, cast=jnp.float32)
+        # one fp32 buffer regardless of model dtypes, like the reference's
+        # single concatenated master (`fp16util.py:108`)
+        merged = {jnp.dtype(jnp.float32): jnp.concatenate(
+            [b.astype(jnp.float32) for b in bufs.values()])} \
+            if len(bufs) > 1 else {k: v.astype(jnp.float32)
+                                   for k, v in bufs.items()}
+        if len(bufs) > 1:
+            raise NotImplementedError(
+                "flat_master with mixed model dtypes is not supported "
+                "(the reference raises here too, fp16util.py:104-107)")
+        return params, MasterParams(tree=None, flat=(merged, spec))
+    masters = tree_cast(params, jnp.float32)
+    return params, MasterParams(tree=masters, flat=None)
+
+
+def model_grads_to_master_grads(model_grads, master: MasterParams):
+    """fp16 model grads → fp32 master grads, matching the master layout
+    (`fp16util.py:136-155`)."""
+    if master.flat is not None:
+        _, spec = master.flat
+        return arena.flatten(model_grads, spec, cast=jnp.float32)
+    return tree_cast(model_grads, jnp.float32)
+
+
+def master_params_to_model_params(master: MasterParams, model_params):
+    """fp32 masters → model-dtype params (`fp16util.py:158-173`)."""
+    tree = master.to_tree()
+    return jax.tree_util.tree_map(
+        lambda m, p: m.astype(p.dtype), tree, model_params)
+
+
+def clip_grad_norm(grads, max_norm, norm_type=2):
+    """Global-norm gradient clipping returning (clipped, total_norm) —
+    the fp16-safe ``clip_grad_norm`` (`fp16util.py:187`, re-exported from
+    torch but listed as part of this surface; norms accumulate fp32)."""
+    total = global_norm(grads, ord=norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads), \
+        total
+
+
+def to_python_float(t):
+    """Host scalar fetch (`fp16util.py:176-180`)."""
+    return float(jax.device_get(t))

@@ -1,0 +1,58 @@
+"""Legacy loss scalers — `apex/fp16_utils/loss_scaler.py:10-186` rebuilt.
+
+Thin classful mirrors over the functional scaler state in
+:mod:`apex_tpu.amp.scaler`, keeping the legacy defaults (dynamic init
+2**32, window 1000) that differ from the amp scaler's (2**16, 2000).
+These exist for API parity; new code should thread
+``amp.LossScaleState`` through the step directly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
+                                 loss_scale_init, loss_scale_update,
+                                 unscale_grads)
+from apex_tpu.utils import tree_all_finite
+
+
+class LossScaler:
+    """Static scaler (`loss_scaler.py:10-60`)."""
+
+    def __init__(self, scale: float = 1.0):
+        self.cfg = LossScaleConfig(init_scale=scale, dynamic=False)
+        self.state = loss_scale_init(self.cfg)
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.state.loss_scale)
+
+    def scale_gradient(self, grads):
+        return unscale_grads(grads, self.state)[0]
+
+    def update_scale(self, overflow: bool) -> None:
+        pass  # static
+
+    def has_overflow(self, grads) -> bool:
+        return not bool(tree_all_finite(grads))
+
+    def backward(self, loss):
+        return jnp.asarray(loss, jnp.float32) * self.state.loss_scale
+
+
+class DynamicLossScaler(LossScaler):
+    """Dynamic scaler with legacy schedule (`loss_scaler.py:63-186`):
+    init 2**32, halve on overflow, double after 1000 clean steps."""
+
+    def __init__(self, init_scale: float = 2.0 ** 32, scale_factor: float = 2.0,
+                 scale_window: int = 1000):
+        self.cfg = LossScaleConfig(
+            init_scale=init_scale, growth_factor=scale_factor,
+            backoff_factor=1.0 / scale_factor, growth_interval=scale_window,
+            max_loss_scale=init_scale, dynamic=True)
+        self.state = loss_scale_init(self.cfg)
+
+    def update_scale(self, overflow: bool) -> None:
+        self.state = loss_scale_update(
+            self.state, jnp.logical_not(jnp.bool_(overflow)), self.cfg)

@@ -110,7 +110,7 @@ def _fwd_kernel(scale, causal, kv_len, q_len, has_bias, refs):
             + jnp.zeros_like(lse_ref)
 
 
-def _flash_fwd(q3, k3, v3, bias3, scale, causal, block_q, block_k):
+def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
@@ -124,7 +124,7 @@ def _flash_fwd(q3, k3, v3, bias3, scale, causal, block_q, block_k):
     qp, kp, vp = pad3(q3, sqp, dp), pad3(k3, skp, dp), pad3(v3, skp, dp)
     nq, nk = sqp // bq, skp // bk
 
-    has_bias = bias3 is not None
+    has_bias = bias_g is not None
     in_specs = [
         pl.BlockSpec((1, bq, dp), lambda b, i, j: (b, i, 0),
                      memory_space=pltpu.VMEM),
@@ -135,10 +135,10 @@ def _flash_fwd(q3, k3, v3, bias3, scale, causal, block_q, block_k):
     ]
     args = [qp, kp, vp]
     if has_bias:
-        bias_p = jnp.pad(bias3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
-        in_specs.append(pl.BlockSpec((1, bq, bk),
-                                     lambda b, i, j: (b, i, j),
-                                     memory_space=pltpu.VMEM))
+        bias_p = jnp.pad(bias_g, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j: (bidx(b), i, j),
+            memory_space=pltpu.VMEM))
         args.append(bias_p)
 
     kernel = functools.partial(_fwd_kernel, scale, causal, sk, sq,
@@ -265,7 +265,7 @@ def _bwd_dkv_kernel(scale, causal, kv_len, q_len, has_bias, refs):
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale, causal,
+def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
                block_q, block_k, delta_shift=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
@@ -295,10 +295,10 @@ def _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale, causal,
 
     lse_l, delta_l = lanes(lse), lanes(delta)
 
-    has_bias = bias3 is not None
+    has_bias = bias_g is not None
     bias_p = None
     if has_bias:
-        bias_p = jnp.pad(bias3, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+        bias_p = jnp.pad(bias_g, ((0, 0), (0, sqp - sq), (0, skp - sk)))
 
     q_spec_q = pl.BlockSpec((1, bq, dp), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
@@ -310,9 +310,9 @@ def _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale, causal,
     in_specs = [q_spec_q, k_spec_q, k_spec_q]
     args = [qp, kp, vp]
     if has_bias:
-        in_specs.append(pl.BlockSpec((1, bq, bk),
-                                     lambda b, i, j: (b, i, j),
-                                     memory_space=pltpu.VMEM))
+        in_specs.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, i, j: (bidx(b), i, j),
+            memory_space=pltpu.VMEM))
         args.append(bias_p)
     in_specs += [q_spec_q, lane_spec_q, lane_spec_q]
     args += [dop, lse_l, delta_l]
@@ -338,9 +338,9 @@ def _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale, causal,
     in_specs2 = [q_spec_k, k_spec_k, k_spec_k]
     args2 = [qp, kp, vp]
     if has_bias:
-        in_specs2.append(pl.BlockSpec((1, bq, bk),
-                                      lambda b, j, i: (b, i, j),
-                                      memory_space=pltpu.VMEM))
+        in_specs2.append(pl.BlockSpec(
+            (1, bq, bk), lambda b, j, i: (bidx(b), i, j),
+            memory_space=pltpu.VMEM))
         args2.append(bias_p)
     in_specs2 += [q_spec_k, lane_spec_k, lane_spec_k]
     args2 += [dop, lse_l, delta_l]
@@ -369,31 +369,54 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); bias: optional additive
     (B|1, H|1, Sq, Sk) — the additive-mask variants of the reference
     (`self_multihead_attn_func.py` additive mask path). Returns
-    (B, Sq, H, D). ``bias`` is non-differentiable (masks, not params).
+    (B, Sq, H, D). ``bias`` is differentiable (learned relative-position
+    biases work); its gradient path materializes O(S²) scores, computed
+    only when actually requested (see ``_bias_grad``).
     """
     o, _ = _flash_attention_fwd_res(q, k, v, bias, scale, causal,
                                     block_q, block_k)
     return o
 
 
-def _to3(q, k, v, bias):
+def _to3(q, k, v):
     b, sq, h, d = q.shape
-    sk = k.shape[1]
     tr = lambda t: jnp.swapaxes(t, 1, 2).reshape(b * h, t.shape[1], d)
-    q3, k3, v3 = tr(q), tr(k), tr(v)
-    bias3 = None
-    if bias is not None:
-        bias_b = jnp.broadcast_to(bias, (b, h, sq, sk))
-        bias3 = bias_b.reshape(b * h, sq, sk)
-    return q3, k3, v3, bias3
+    return tr(q), tr(k), tr(v)
+
+
+def _bias_group(bias, b, h):
+    """(B|1, H|1, Sq, Sk) bias → ((G, Sq, Sk), idx_fn) with NO broadcast.
+
+    The kernels index the bias through ``idx_fn(grid_b)`` in their
+    BlockSpecs, so a (1, 1, Sq, Sk) causal bias (the ring-attention
+    per-hop case) occupies exactly one copy in HBM instead of B·H
+    score-sized buffers.
+    """
+    if bias is None:
+        return None, None
+    bb, bh_ = bias.shape[0], bias.shape[1]
+    if bb not in (1, b) or bh_ not in (1, h):
+        raise ValueError(f"bias dims {bias.shape[:2]} must broadcast "
+                         f"against (B={b}, H={h})")
+    bias_g = bias.reshape(bb * bh_, *bias.shape[2:])
+    if bb == 1 and bh_ == 1:
+        idx = lambda g: 0
+    elif bb == 1:                       # (1, H, ...) — per-head bias
+        idx = lambda g: g % h
+    elif bh_ == 1:                      # (B, 1, ...) — per-batch mask
+        idx = lambda g: g // h
+    else:
+        idx = lambda g: g
+    return bias_g, idx
 
 
 def _flash_attention_fwd_res(q, k, v, bias, scale, causal, block_q,
                              block_k):
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
-    q3, k3, v3, bias3 = _to3(q, k, v, bias)
-    o3, lse = _flash_fwd(q3, k3, v3, bias3, scale, causal, block_q,
+    q3, k3, v3 = _to3(q, k, v)
+    bias_g, bidx = _bias_group(bias, b, h)
+    o3, lse = _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q,
                          block_k)
     o = jnp.swapaxes(o3.reshape(b, h, sq, d), 1, 2)
     return o, (q, k, v, bias, o, lse)
@@ -410,13 +433,46 @@ def _fa_bwd(scale, causal, block_q, block_k, res, do):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
-    q3, k3, v3, bias3 = _to3(q, k, v, bias)
+    q3, k3, v3 = _to3(q, k, v)
+    bias_g, bidx = _bias_group(bias, b, h)
     o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
     do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
-    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale_,
-                               causal, block_q, block_k)
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3,
+                               scale_, causal, block_q, block_k)
     un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
-    return un(dq3, sq), un(dk3, sk), un(dv3, sk), None
+    dbias = None if bias is None else _bias_grad(
+        q, k, v, bias, o, lse, do, scale_, causal)
+    return un(dq3, sq), un(dk3, sk), un(dv3, sk), dbias
+
+
+def _bias_grad(q, k, v, bias, o, lse, do, scale, causal):
+    """Cotangent for a learned additive bias (e.g. relative-position
+    biases): ds = p * (dp - delta), reduced to the bias's broadcast
+    shape. Recomputes p from the saved lse so no extra softmax pass is
+    needed — but it DOES materialize the (B, H, Sq, Sk) score matrix, the
+    very thing flash attention avoids. That is inherent to producing a
+    dense dbias; XLA dead-code-eliminates this whole computation whenever
+    the caller does not differentiate w.r.t. the bias, so pure-mask users
+    pay nothing."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    s = s + bias.astype(jnp.float32)
+    if causal:
+        mask = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse.reshape(b, h, sq)[..., None])
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do.astype(jnp.float32),
+                    v.astype(jnp.float32))
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                       # (b, sq, h)
+    ds = p * (dp - jnp.swapaxes(delta, 1, 2)[..., None])
+    if bias.shape[0] == 1:
+        ds = jnp.sum(ds, axis=0, keepdims=True)
+    if bias.shape[1] == 1:
+        ds = jnp.sum(ds, axis=1, keepdims=True)
+    return ds.astype(bias.dtype)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -483,14 +539,15 @@ def _fal_bwd(scale, causal, block_q, block_k, res, cot):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
-    q3, k3, v3, bias3 = _to3(q, k, v, bias)
+    q3, k3, v3 = _to3(q, k, v)
+    bias_g, bidx = _bias_group(bias, b, h)
     o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
     do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     # d lse/d s = p, so the lse cotangent folds into the delta term:
     # ds = p*(dp - delta) + p*dlse = p*(dp - (delta - dlse))
     dlse3 = dlse.reshape(b * h, sq)
-    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias3, o3, lse, do3, scale_,
-                               causal, block_q, block_k,
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3,
+                               scale_, causal, block_q, block_k,
                                delta_shift=dlse3)
     un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
     return un(dq3, sq), un(dk3, sk), un(dv3, sk), None

@@ -65,10 +65,11 @@ def BatchNorm2d_NHWC(num_features: int, *, fuse_relu: bool = False,
     return SyncBatchNorm(
         num_features=num_features,
         epsilon=epsilon,
-        # reference momentum semantics: running = m*running + (1-m)*new
-        # (`batch_norm.py:93-101`); SyncBatchNorm uses the torch convention
-        # running = (1-m)*running + m*new — convert here.
-        momentum=1.0 - momentum,
+        # reference momentum semantics (torch convention, inherited from
+        # _BatchNorm and applied by the kernel as
+        # running = (1-m)*running + m*new, `nhwc_batch_norm_kernel.h:1250`);
+        # SyncBatchNorm uses the same convention — pass through unchanged.
+        momentum=momentum,
         axis_name=axis_name if bn_group > 1 else None,
         axis_index_groups=groups,
         channel_axis=-1,

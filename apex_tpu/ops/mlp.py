@@ -180,20 +180,22 @@ class MLP:
             def __call__(self, x):
                 weights, biases = [], ([] if bias else None)
                 for i in range(len(sizes) - 1):
-                    # reference initializes U(-1/sqrt(fan_in), +) per layer
-                    # (`apex/mlp/mlp.py:44-50`)
-                    bound = 1.0 / np.sqrt(sizes[i])
+                    # reference init (`apex/mlp/mlp.py:63-72`): weights
+                    # N(0, sqrt(2/(fan_in+fan_out))), biases
+                    # N(0, sqrt(1/fan_out))
+                    w_std = np.sqrt(2.0 / (sizes[i] + sizes[i + 1]))
                     w = self.param(
                         f"weight_{i}",
-                        nn.initializers.uniform(scale=2 * bound),
+                        nn.initializers.normal(stddev=w_std),
                         (sizes[i], sizes[i + 1]), jnp.float32)
-                    weights.append(w - bound)
+                    weights.append(w)
                     if bias:
+                        b_std = np.sqrt(1.0 / sizes[i + 1])
                         b = self.param(
                             f"bias_{i}",
-                            nn.initializers.uniform(scale=2 * bound),
+                            nn.initializers.normal(stddev=b_std),
                             (sizes[i + 1],), jnp.float32)
-                        biases.append(b - bound)
+                        biases.append(b)
                 return fused_mlp(x, tuple(weights),
                                  tuple(biases) if bias else None,
                                  activation)

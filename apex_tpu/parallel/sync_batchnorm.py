@@ -95,8 +95,21 @@ def sync_moments(x, *, axis_name: Optional[str], reduce_axes,
         count = jnp.float32(valid_count)
         x32 = x.astype(jnp.float32)
         mean = jnp.sum(x32, axis=reduce_axes) / count
-        var = jnp.sum(jnp.square(x32), axis=reduce_axes) / count \
-            - jnp.square(mean)
+        # second pass on centered values: non-valid positions are
+        # zero-padded, so sum((x-mean)^2) over valid positions equals
+        # sum(x^2) - 2*mean*sum(x) + count*mean^2 computed via the
+        # centered form below minus the padding correction. Using the
+        # centered subtraction only at valid positions would need the
+        # mask; instead center everywhere and correct for the
+        # (n_padded - count) zero positions that became (-mean)^2.
+        shape = [1 if a in reduce_axes else s for a, s in enumerate(x.shape)]
+        n_padded = 1
+        for a in reduce_axes:
+            n_padded *= x.shape[a]
+        centered_sq = jnp.sum(jnp.square(x32 - mean.reshape(shape)),
+                              axis=reduce_axes)
+        pad_correction = (jnp.float32(n_padded) - count) * jnp.square(mean)
+        var = (centered_sq - pad_correction) / count
     if axis_name is None:
         return mean, var, count
     # all_gather of the stat triple over the stats group, then combine —

@@ -1,0 +1,193 @@
+"""fp16_utils tests — mirrors `tests/L0/run_fp16util` + the
+FP16_Optimizer training/overflow/checkpoint semantics from
+`tests/L0/run_amp/test_checkpointing.py` and `test_fused_sgd.py`.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import flax.linen as nn
+
+from apex_tpu import fp16_utils
+from apex_tpu.optim import FusedSGD
+
+
+class Net(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32)(x)
+        x = nn.BatchNorm(use_running_average=True)(x)
+        x = nn.relu(x)
+        return nn.Dense(4)(x)
+
+
+@pytest.fixture(scope="module")
+def net_and_params():
+    net = Net()
+    x = jnp.ones((8, 16))
+    variables = net.init(jax.random.PRNGKey(0), x)
+    return net, variables, x
+
+
+def test_network_to_half_keeps_norms_fp32(net_and_params):
+    _, variables, _ = net_and_params
+    half = fp16_utils.network_to_half(variables["params"])
+    assert half["Dense_0"]["kernel"].dtype == jnp.float16
+    assert half["Dense_1"]["bias"].dtype == jnp.float16
+    # BN params exempt — BN_convert_float (`fp16util.py:22-33`)
+    assert half["BatchNorm_0"]["scale"].dtype == jnp.float32
+    assert half["BatchNorm_0"]["bias"].dtype == jnp.float32
+
+
+def test_convert_network_bf16(net_and_params):
+    _, variables, _ = net_and_params
+    conv = fp16_utils.convert_network(variables["params"], jnp.bfloat16)
+    assert conv["Dense_0"]["kernel"].dtype == jnp.bfloat16
+    assert conv["BatchNorm_0"]["scale"].dtype == jnp.float32
+
+
+def test_prep_param_lists_roundtrip(net_and_params):
+    _, variables, _ = net_and_params
+    model_p = fp16_utils.tofp16(variables["params"])
+    model_p, masters = fp16_utils.prep_param_lists(model_p)
+    tree = masters.to_tree()
+    for m, p in zip(jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(model_p)):
+        assert m.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(m, np.float32),
+                                   np.asarray(p, np.float32), rtol=1e-3)
+    back = fp16_utils.master_params_to_model_params(masters, model_p)
+    for b, p in zip(jax.tree_util.tree_leaves(back),
+                    jax.tree_util.tree_leaves(model_p)):
+        assert b.dtype == p.dtype
+
+
+def test_prep_param_lists_flat_master(net_and_params):
+    _, variables, _ = net_and_params
+    params = variables["params"]  # uniform fp32 -> single partition
+    model_p, masters = fp16_utils.prep_param_lists(params, flat_master=True)
+    assert masters.flat is not None
+    bufs, spec = masters.flat
+    (buf,) = bufs.values()
+    assert buf.ndim == 1 and buf.dtype == jnp.float32
+    rt = masters.to_tree()
+    for a, b in zip(jax.tree_util.tree_leaves(rt),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_model_grads_to_master_grads(net_and_params):
+    _, variables, _ = net_and_params
+    model_p = fp16_utils.tofp16(variables["params"])
+    _, masters = fp16_utils.prep_param_lists(model_p)
+    grads = jax.tree_util.tree_map(jnp.ones_like, model_p)
+    mg = fp16_utils.model_grads_to_master_grads(grads, masters)
+    assert all(g.dtype == jnp.float32
+               for g in jax.tree_util.tree_leaves(mg))
+
+
+def test_clip_grad_norm():
+    grads = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+    clipped, norm = fp16_utils.clip_grad_norm(grads, max_norm=1.0)
+    np.testing.assert_allclose(float(norm), 10.0, rtol=1e-6)
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                         for g in jax.tree_util.tree_leaves(clipped)))
+    np.testing.assert_allclose(float(total), 1.0, rtol=1e-4)
+
+
+def test_fp16model_casts_inputs(net_and_params):
+    net, _, x = net_and_params
+    wrapped = fp16_utils.FP16Model(network=nn.Dense(4))
+    variables = wrapped.init(jax.random.PRNGKey(0), x)
+    out = wrapped.apply(variables, x)
+    assert out.dtype == jnp.float16
+
+
+# --- FP16_Optimizer ----------------------------------------------------------
+
+def _quadratic_loss(target):
+    def loss_fn(mp):
+        err = mp["w"].astype(jnp.float32) - target
+        return jnp.mean(jnp.square(err))
+    return loss_fn
+
+
+def test_fp16_optimizer_trains():
+    opt = fp16_utils.FP16_Optimizer(FusedSGD(lr=0.5, momentum=0.9),
+                                    static_loss_scale=128.0)
+    params = {"w": jnp.zeros((256,), jnp.float16)}
+    state = opt.init(params)
+    target = jnp.linspace(-1, 1, 256)
+    loss_fn = _quadratic_loss(target)
+
+    @jax.jit
+    def train(state):
+        def body(state, _):
+            loss, grads, finite, state = opt.backward(state, loss_fn)
+            state = opt.step(state, grads, finite)
+            return state, loss
+        return jax.lax.scan(body, state, None, length=60)
+
+    state, losses = train(state)
+    assert float(losses[-1]) < 1e-3 * float(losses[0])
+    assert int(state.step) == 60
+    mp = opt.model_params(state, like=params)
+    assert mp["w"].dtype == jnp.float16
+
+
+def test_fp16_optimizer_overflow_skips_and_backs_off():
+    opt = fp16_utils.FP16_Optimizer(FusedSGD(lr=0.1), dynamic_loss_scale=True,
+                                    dynamic_loss_args={"init_scale": 2.0**8})
+    params = {"w": jnp.ones((128,), jnp.float16)}
+    state = opt.init(params)
+
+    def poisoned(mp, poison):
+        base = jnp.mean(jnp.square(mp["w"].astype(jnp.float32)))
+        return base * jnp.where(poison, jnp.inf, 1.0)
+
+    @jax.jit
+    def one(state, poison):
+        loss, grads, finite, state = opt.backward(state, poisoned, poison)
+        return opt.step(state, grads, finite), finite
+
+    state, finite = one(state, jnp.bool_(False))
+    assert bool(finite) and int(state.step) == 1
+    w_before = np.asarray(state.masters["w"])
+    scale_before = float(opt.loss_scale(state))
+    state, finite = one(state, jnp.bool_(True))
+    assert not bool(finite)
+    assert int(state.step) == 1, "overflow step must not count"
+    np.testing.assert_array_equal(np.asarray(state.masters["w"]), w_before)
+    assert float(opt.loss_scale(state)) == scale_before / 2
+
+
+def test_fp16_optimizer_checkpoint_roundtrip():
+    opt = fp16_utils.FP16_Optimizer(FusedSGD(lr=0.3, momentum=0.9),
+                                    dynamic_loss_scale=True)
+    params = {"w": jnp.zeros((64,), jnp.float16)}
+    state = opt.init(params)
+    target = jnp.linspace(0, 1, 64)
+    loss_fn = _quadratic_loss(target)
+
+    @jax.jit
+    def one(state):
+        loss, grads, finite, state = opt.backward(state, loss_fn)
+        return opt.step(state, grads, finite), loss
+
+    for _ in range(5):
+        state, _ = one(state)
+
+    sd = opt.state_dict(state)
+    restored = opt.load_state_dict(opt.init(params), sd)
+
+    # continue both for 3 steps: trajectories must match bitwise
+    s_a, s_b = state, restored
+    for _ in range(3):
+        s_a, la = one(s_a)
+        s_b, lb = one(s_b)
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    np.testing.assert_array_equal(np.asarray(s_a.masters["w"]),
+                                  np.asarray(s_b.masters["w"]))
+    assert int(s_a.step) == int(s_b.step)
