@@ -32,6 +32,8 @@ from apex_tpu.amp.lists import (
     register_half_op,
     register_float_op,
     register_promote_op,
+    register_half_module,
+    register_float_module,
 )
 
 __all__ = [
@@ -43,4 +45,5 @@ __all__ = [
     "half_function", "float_function", "promote_function",
     "auto_cast", "make_interceptor",
     "register_half_op", "register_float_op", "register_promote_op",
+    "register_half_module", "register_float_module",
 ]

@@ -112,13 +112,41 @@ class Amp:
         ``_prepare/_post_amp_backward`` (`_process_optimizer.py:142-202`)
         falls out of autodiff for free.
         """
+        return self.backward_accumulate(
+            state, loss_fn, *args, stashed=None, finite=True,
+            loss_id=loss_id, has_aux=has_aux, **kwargs)
+
+    def backward_accumulate(self, state: AmpState, loss_fn: Callable,
+                            *args, stashed=None, finite=True,
+                            loss_id: int = 0, has_aux: bool = False,
+                            **kwargs):
+        """Scaled backward that ADDS onto fp32 grads stashed from previous
+        microbatches — the accumulate-across-backwards semantics of
+        ``unscale_with_stashed`` / ``multi_tensor_axpby``
+        (`apex/amp/scaler.py:152-190`, `_process_optimizer.py:142-158`).
+
+        Each microbatch may unscale at a *different* dynamic scale (the
+        schedule advances between backwards, exactly like the reference);
+        the stash is always fp32 and already unscaled, so the combine is
+        ``stash + grads/scale`` in one pass. ``finite`` accumulates with
+        logical-and so a single overflowing microbatch skips the whole
+        accumulated step.
+
+        Returns ``(out, acc_grads, state', finite')``. Typical use::
+
+            acc, fin = None, True
+            for mb in microbatches:          # or lax.scan
+                out, acc, state, fin = amp_opt.backward_accumulate(
+                    state, loss_fn, mb, stashed=acc, finite=fin)
+            state = amp_opt.apply_gradients(state, acc, fin)
+
+        Gradients accumulate as a SUM; divide each microbatch loss by the
+        number of microbatches for a mean (the reference convention).
+        """
         sstate = state.scalers[loss_id]
 
         def scaled(p):
             mp = self.policy.cast_params(p)
-            # Bind the ambient policy so apex_tpu.ops / half_function-style
-            # consumers inside loss_fn see it — the trace-time analogue of
-            # O1's namespace patching being active during forward+backward.
             with policy_scope(self.policy):
                 out = loss_fn(mp, *args, **kwargs)
             loss = out[0] if has_aux else out
@@ -126,20 +154,26 @@ class Amp:
 
         grads, out = jax.grad(scaled, has_aux=True)(state.params)
         if self.scale_cfg is None:
-            # No loss scaler in the policy (bf16 paths): no overflow
-            # machinery at all — grads only upcast to fp32. `finite` is a
-            # *static* True so downstream selects compile away entirely,
-            # matching the reference where no scaler means no
-            # _overflow_buf check anywhere in the step.
             grads = tree_cast(grads, jnp.float32)
-            finite = True
-            new_sstate = sstate
+            if stashed is not None:
+                grads = jax.tree_util.tree_map(
+                    lambda s, g: s + g if jnp.issubdtype(
+                        jnp.asarray(g).dtype, jnp.floating) else g,
+                    stashed, grads)
+            return out, grads, state, finite
+        if stashed is None:
+            acc, this_finite = unscale_grads(grads, sstate)
         else:
-            grads, finite = unscale_grads(grads, sstate)
-            new_sstate = loss_scale_update(sstate, finite, self.scale_cfg)
+            acc, this_finite = _scaler.unscale_grads_with_stashed(
+                grads, stashed, sstate)
+        new_sstate = loss_scale_update(sstate, this_finite, self.scale_cfg)
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(state.scalers))
-        return out, grads, state._replace(scalers=scalers), finite
+        if isinstance(finite, bool):
+            new_finite = this_finite if finite else jnp.bool_(False)
+        else:
+            new_finite = jnp.logical_and(finite, this_finite)
+        return out, acc, state._replace(scalers=scalers), new_finite
 
     # -- update --------------------------------------------------------------
 
@@ -207,16 +241,29 @@ class Amp:
 
 def initialize(params, tx, opt_level: str = "O1", *,
                half_dtype=jnp.bfloat16, num_losses: int = 1,
+               verbosity: int = 1,
                **policy_overrides) -> Tuple[Amp, AmpState]:
     """One-call setup: ``amp_opt, state = amp.initialize(params, tx, "O2")``.
 
     The ergonomic mirror of ``amp.initialize(model, optimizer, opt_level)``
     (`apex/amp/frontend.py:195-358`) for the functional world: builds the
     policy preset (kwarg overrides win), the Amp bundle, and the initial
-    state in one step.
+    state in one step. ``verbosity=1`` prints the selected-properties
+    banner on process 0 (`frontend.py:328-356`); 0 is silent.
     """
     policy = Policy.from_opt_level(opt_level, half_dtype=half_dtype,
                                    **policy_overrides)
+    if verbosity > 0:
+        from apex_tpu.parallel.launch import maybe_print
+        maybe_print(f"apex_tpu.amp: selected optimization level {opt_level}",
+                    rank0=True)
+        maybe_print("Settings for this optimization level "
+                    "(overrides applied):", rank0=True)
+        for field in ("enabled", "half_dtype", "cast_model_type",
+                      "patch_ops", "keep_batchnorm_fp32",
+                      "master_weights", "loss_scale"):
+            maybe_print(f"{field:<24}: {getattr(policy, field)}",
+                        rank0=True)
     amp_opt = Amp(policy, tx, num_losses=num_losses)
     return amp_opt, amp_opt.init(params)
 

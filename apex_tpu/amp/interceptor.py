@@ -27,10 +27,19 @@ from apex_tpu.utils import tree_cast
 
 
 def make_interceptor(policy: Policy):
-    """Build a flax interceptor applying ``policy``'s op cast tables."""
+    """Build a flax interceptor applying ``policy``'s op cast tables.
+
+    Classification order mirrors the reference rule that user
+    registrations out-prioritise the built-in lists
+    (`apex/amp/amp.py:94-114`): user float registry, user half registry
+    (``lists.register_float_module`` / ``register_half_module``), then the
+    built-in norm blacklist, then the MXU whitelist.
+    """
     import flax.linen as nn
 
     half_mods, float_mods = lists._flax_module_tables()
+    user_half = tuple(lists._EXTRA_HALF_MODULES)
+    user_float = tuple(lists._EXTRA_FLOAT_MODULES)
     half = jnp.dtype(policy.half_dtype)
 
     def interceptor(next_fun, args, kwargs, context):
@@ -39,22 +48,31 @@ def make_interceptor(policy: Policy):
         mod = context.module
         if context.method_name != "__call__":
             return next_fun(*args, **kwargs)
-        if isinstance(mod, float_mods):
+        if isinstance(mod, user_float):
+            target = jnp.float32
+        elif isinstance(mod, user_half):
+            target = half
+        elif isinstance(mod, float_mods):
             # blacklist: norms/statistics in fp32
-            args = tree_cast(args, jnp.float32)
-            kwargs = tree_cast(kwargs, jnp.float32)
-            _retarget_dtype(mod, jnp.float32)
+            target = jnp.float32
         elif isinstance(mod, half_mods):
             # whitelist: MXU ops in half
-            args = tree_cast(args, half)
-            kwargs = tree_cast(kwargs, half)
-            _retarget_dtype(mod, half)
-        return next_fun(*args, **kwargs)
+            target = half
+        else:
+            return next_fun(*args, **kwargs)
+        args = tree_cast(args, target)
+        kwargs = tree_cast(kwargs, target)
+        retargeted = _retarget_dtype(mod, target)
+        try:
+            return next_fun(*args, **kwargs)
+        finally:
+            if retargeted:
+                object.__setattr__(mod, "dtype", None)
 
     return interceptor
 
 
-def _retarget_dtype(mod, dtype) -> None:
+def _retarget_dtype(mod, dtype) -> bool:
     """Point ``mod.dtype`` at the policy dtype for this call.
 
     flax modules are frozen dataclasses, but ``dtype`` is a plain field read
@@ -62,11 +80,14 @@ def _retarget_dtype(mod, dtype) -> None:
     (the same escape hatch flax itself uses for internal state) makes the
     module compute in ``dtype`` while its params stay in ``param_dtype``.
     Only touched when the user left ``dtype=None`` (the flax default), so an
-    explicit user choice always wins — mirroring the reference rule that user
-    registrations out-prioritise the built-in lists (`apex/amp/amp.py:94-114`).
+    explicit user choice always wins. Returns whether a retarget happened;
+    the caller restores ``dtype=None`` after the call so a module instance
+    reused outside :func:`auto_cast` is unaffected.
     """
     if hasattr(mod, "dtype") and getattr(mod, "dtype") is None:
         object.__setattr__(mod, "dtype", dtype)
+        return True
+    return False
 
 
 @contextlib.contextmanager

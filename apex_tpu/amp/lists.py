@@ -92,12 +92,50 @@ def register_promote_op(name: str) -> None:
 
 # --- Flax module-class tables (consulted by the interceptor) ----------------
 
+# user-registered module classes (the module-level analogue of
+# register_half_function/register_float_function, `apex/amp/amp.py:30-64`;
+# user registrations out-prioritise the built-in tables)
+_EXTRA_HALF_MODULES: list = []
+_EXTRA_FLOAT_MODULES: list = []
+
+
+def register_half_module(cls) -> None:
+    """Intercepted calls of ``cls`` run in the policy half dtype."""
+    if cls in _EXTRA_FLOAT_MODULES:
+        _EXTRA_FLOAT_MODULES.remove(cls)
+    if cls not in _EXTRA_HALF_MODULES:
+        _EXTRA_HALF_MODULES.append(cls)
+
+
+def register_float_module(cls) -> None:
+    """Intercepted calls of ``cls`` run in fp32."""
+    if cls in _EXTRA_HALF_MODULES:
+        _EXTRA_HALF_MODULES.remove(cls)
+    if cls not in _EXTRA_FLOAT_MODULES:
+        _EXTRA_FLOAT_MODULES.append(cls)
+
+
 def _flax_module_tables():
-    """Lazily build (HALF_MODULES, FLOAT_MODULES) tuples of flax classes."""
+    """Lazily build (HALF_MODULES, FLOAT_MODULES) tuples of flax classes.
+
+    Mirrors the op surface of the reference O1 whitelist/blacklist
+    (`functional_overrides.py:18-80`) at module granularity: everything
+    MXU-bound (dense/conv/attention/embedding lookups feeding matmuls)
+    goes half; statistics/norm modules stay fp32. User registrations are
+    placed FIRST so they win isinstance checks over the built-ins.
+    """
     import flax.linen as nn
 
     half = [nn.Dense, nn.DenseGeneral, nn.Conv, nn.ConvTranspose,
-            nn.Einsum, nn.ConvLocal,
+            nn.Einsum, nn.ConvLocal, nn.Embed,
             nn.MultiHeadDotProductAttention, nn.SelfAttention]
     flt = [nn.LayerNorm, nn.BatchNorm, nn.GroupNorm, nn.RMSNorm]
+    # aliases present only in some flax versions
+    for name, dest in (("MultiHeadAttention", half),
+                       ("InstanceNorm", flt)):
+        cls = getattr(nn, name, None)
+        if cls is not None and cls not in dest:
+            dest.append(cls)
+    # the interceptor consults the user registries BEFORE these, so a
+    # user re-registration (or registered subclass) of a built-in wins
     return tuple(half), tuple(flt)

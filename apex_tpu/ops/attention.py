@@ -16,12 +16,12 @@ Layout: (B, S, H, D) inputs, kernel works on (B·H, S, D). Forward saves
 kernels: dq over q-blocks, dk/dv over k-blocks), the standard
 recompute-over-store trade that wins on HBM bandwidth.
 
-Additive bias (the reference's additive-mask variants) and causal masking
-run inside the kernel. Softmax dropout — fused in the reference via
-in-kernel Philox (`dropout.h`) — is applied by the module layer on the
-default impl; the fused path treats dropout as a training-time opt-out
-(use ``impl='default'`` when softmax dropout > 0), mirroring the
-reference's pairing of fused/unfused impls behind one module.
+Additive bias (the reference's additive-mask variants), causal masking,
+and softmax dropout all run inside the kernel. Dropout — fused in the
+reference via in-kernel Philox (`dropout.h`) — uses a counter-based hash
+RNG (see ``_keep_mask``): the mask is a pure function of the score
+element's coordinates, so forward and backward regenerate it exactly and
+no mask tensor ever exists in HBM.
 """
 
 from __future__ import annotations
@@ -56,13 +56,59 @@ def _kv_valid(ik, bk, kv_len, bq):
     return cols < kv_len
 
 
+def _keep_mask(seed, iq, ik, bq, bk, rate):
+    """In-kernel softmax-dropout keep mask — the TPU analogue of the
+    reference's Philox dropout fused into the softmax kernel
+    (`apex/contrib/csrc/multihead_attn/dropout.h:1-308`).
+
+    Counter-based (lowbias32 avalanche over the score element's grid
+    coordinates), so it is a pure function of (seed, batch·head, q-block,
+    k-block, row, col): the forward and both backward kernels regenerate
+    bitwise-identical masks regardless of grid iteration order, and
+    compiled/interpret modes agree exactly (unlike ``pltpu.prng_*``,
+    which has no interpret lowering).
+    """
+    gb = pl.program_id(0)
+    rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
+    return _mix_keep(seed, gb, iq, ik, rows, cols, rate)
+
+
+def _mix_keep(seed, gb, iq, ik, rows, cols, rate):
+    """The shared coordinate hash: block seed + per-element lowbias32
+    avalanche → keep bool. ONE definition used by both the kernels and
+    the dense replica (`_keep_mask_dense`) — their bitwise agreement is
+    what makes the bias-gradient dropout mask exact."""
+    x = (seed.astype(jnp.uint32)
+         + jnp.asarray(gb).astype(jnp.uint32) * np.uint32(0x9E3779B9)
+         + jnp.asarray(iq).astype(jnp.uint32) * np.uint32(0x85EBCA6B)
+         + jnp.asarray(ik).astype(jnp.uint32) * np.uint32(0xC2B2AE35)
+         + rows * np.uint32(0x27D4EB2F) + cols * np.uint32(0x165667B1))
+    x = x ^ (x >> 16)
+    x = x * np.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * np.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    # drop iff x < rate·2^32 ⇒ P(keep) = 1 - rate
+    return x >= np.uint32(int(rate * 4294967296.0))
+
+
 # --- forward ----------------------------------------------------------------
 
-def _fwd_kernel(scale, causal, kv_len, q_len, has_bias, refs):
+def _fwd_kernel(scale, causal, kv_len, q_len, has_bias, dropout_rate,
+                refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    b_ref = None
     if has_bias:
-        q_ref, k_ref, v_ref, b_ref, o_ref, lse_ref, m_scr, l_scr, acc = refs
-    else:
-        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc = refs
+        b_ref = refs[pos]
+        pos += 1
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    o_ref, lse_ref, m_scr, l_scr, acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -94,8 +140,15 @@ def _fwd_kernel(scale, causal, kv_len, q_len, has_bias, refs):
     alpha = jnp.exp(m_prev - m_new)
     p = jnp.exp(s - m_new)
     l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+    # softmax dropout: the normalizer l uses the *undropped* sum (dropout
+    # acts on the normalized probabilities, after the softmax), so only
+    # the accumulator sees the mask
+    pd = p
+    if dropout_rate > 0.0:
+        keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate)
+        pd = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
     acc[:] = acc[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
+        pd, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
     m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
     l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -110,7 +163,8 @@ def _fwd_kernel(scale, causal, kv_len, q_len, has_bias, refs):
             + jnp.zeros_like(lse_ref)
 
 
-def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k):
+def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k,
+               dropout_rate=0.0, seed=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
@@ -140,9 +194,12 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k):
             (1, bq, bk), lambda b, i, j: (bidx(b), i, j),
             memory_space=pltpu.VMEM))
         args.append(bias_p)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
 
     kernel = functools.partial(_fwd_kernel, scale, causal, sk, sq,
-                               has_bias)
+                               has_bias, dropout_rate)
     o, lse = pl.pallas_call(
         lambda *refs: kernel(refs),
         grid=(bh, nq, nk),
@@ -170,13 +227,20 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k):
 
 # --- backward ---------------------------------------------------------------
 
-def _bwd_dq_kernel(scale, causal, kv_len, q_len, has_bias, refs):
+def _bwd_dq_kernel(scale, causal, kv_len, q_len, has_bias, dropout_rate,
+                   refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    b_ref = None
     if has_bias:
-        (q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
-         dq_ref, dq_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-         dq_ref, dq_acc) = refs
+        b_ref = refs[pos]
+        pos += 1
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    do_ref, lse_ref, dl_ref, dq_ref, dq_acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -203,6 +267,12 @@ def _bwd_dq_kernel(scale, causal, kv_len, q_len, has_bias, refs):
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+    if dropout_rate > 0.0:
+        # gradient flows only through kept entries: dP = mask·dp̃/keep.
+        # delta = rowsum(do·o) already equals Σ_j dp̃_j·P̃_j (see
+        # _flash_bwd), so only dp needs the mask applied here
+        keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate)
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
     ds = p * (dp - delta)
     dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
         ds, k, (((1,), (0,)), ((), ())),
@@ -213,13 +283,20 @@ def _bwd_dq_kernel(scale, causal, kv_len, q_len, has_bias, refs):
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(scale, causal, kv_len, q_len, has_bias, refs):
+def _bwd_dkv_kernel(scale, causal, kv_len, q_len, has_bias, dropout_rate,
+                    refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    b_ref = None
     if has_bias:
-        (q_ref, k_ref, v_ref, b_ref, do_ref, lse_ref, dl_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        b_ref = refs[pos]
+        pos += 1
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    do_ref, lse_ref, dl_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[pos:]
     ik, iq = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -249,11 +326,20 @@ def _bwd_dkv_kernel(scale, causal, kv_len, q_len, has_bias, refs):
     valid = jnp.logical_and(valid, rows < q_len)
     p = jnp.where(valid, jnp.exp(s - lse), 0.0)
 
-    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-        p, do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
+    pv = p
+    if dropout_rate > 0.0:
+        # dv sees the dropped probabilities p̃ = mask·p/keep; dp gets the
+        # same mask (gradient only through kept entries) — identical mask
+        # to the forward because _keep_mask is counter-based on (iq, ik)
+        keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate)
+        inv_keep = 1.0 / (1.0 - dropout_rate)
+        pv = jnp.where(keep, p * inv_keep, 0.0)
+        dp = jnp.where(keep, dp * inv_keep, 0.0)
+    dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+        pv, do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
     ds = p * (dp - delta)
     dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
         ds, q, (((0,), (0,)), ((), ())),
@@ -266,7 +352,8 @@ def _bwd_dkv_kernel(scale, causal, kv_len, q_len, has_bias, refs):
 
 
 def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
-               block_q, block_k, delta_shift=None):
+               block_q, block_k, delta_shift=None, dropout_rate=0.0,
+               seed=None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
@@ -314,12 +401,16 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
             (1, bq, bk), lambda b, i, j: (bidx(b), i, j),
             memory_space=pltpu.VMEM))
         args.append(bias_p)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
     in_specs += [q_spec_q, lane_spec_q, lane_spec_q]
     args += [dop, lse_l, delta_l]
 
     dq = pl.pallas_call(
         lambda *refs: functools.partial(
-            _bwd_dq_kernel, scale, causal, sk, sq, has_bias)(refs),
+            _bwd_dq_kernel, scale, causal, sk, sq, has_bias,
+            dropout_rate)(refs),
         grid=(bh, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec_q,
@@ -342,12 +433,16 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
             (1, bq, bk), lambda b, j, i: (bidx(b), i, j),
             memory_space=pltpu.VMEM))
         args2.append(bias_p)
+    if dropout_rate > 0.0:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(seed)
     in_specs2 += [q_spec_k, lane_spec_k, lane_spec_k]
     args2 += [dop, lse_l, delta_l]
 
     dk, dv = pl.pallas_call(
         lambda *refs: functools.partial(
-            _bwd_dkv_kernel, scale, causal, sk, sq, has_bias)(refs),
+            _bwd_dkv_kernel, scale, causal, sk, sq, has_bias,
+            dropout_rate)(refs),
         grid=(bh, nk, nq),
         in_specs=in_specs2,
         out_specs=(k_spec_k, k_spec_k),
@@ -361,20 +456,29 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
 
 # --- public op --------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
-                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                    dropout_rate=0.0, dropout_seed=None):
     """Blockwise softmax attention.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); bias: optional additive
-    (B|1, H|1, Sq, Sk) — the additive-mask variants of the reference
+    (B|1, H|1, Sq|1, Sk|1) — the additive-mask variants of the reference
     (`self_multihead_attn_func.py` additive mask path). Returns
     (B, Sq, H, D). ``bias`` is differentiable (learned relative-position
     biases work); its gradient path materializes O(S²) scores, computed
     only when actually requested (see ``_bias_grad``).
+
+    ``dropout_rate > 0`` applies *softmax* dropout (on the normalized
+    probabilities) inside the kernel — the fused Philox dropout of the
+    reference (`dropout.h:1-308`) — seeded by ``dropout_seed`` (int32
+    scalar, typically drawn fresh per step from the training rng). The
+    backward kernels regenerate the identical mask from the same seed;
+    no mask tensor ever exists in HBM.
     """
-    o, _ = _flash_attention_fwd_res(q, k, v, bias, scale, causal,
-                                    block_q, block_k)
+    o, _ = _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale,
+                                    causal, block_q, block_k,
+                                    dropout_rate)
     return o
 
 
@@ -384,13 +488,14 @@ def _to3(q, k, v):
     return tr(q), tr(k), tr(v)
 
 
-def _bias_group(bias, b, h):
-    """(B|1, H|1, Sq, Sk) bias → ((G, Sq, Sk), idx_fn) with NO broadcast.
+def _bias_group(bias, b, h, sq, sk):
+    """(B|1, H|1, Sq|1, Sk|1) bias → ((G, Sq, Sk), idx_fn).
 
     The kernels index the bias through ``idx_fn(grid_b)`` in their
     BlockSpecs, so a (1, 1, Sq, Sk) causal bias (the ring-attention
     per-hop case) occupies exactly one copy in HBM instead of B·H
-    score-sized buffers.
+    score-sized buffers. Size-1 *sequence* dims can't ride the index
+    map (blocks tile them) and are materialized to (Sq, Sk).
     """
     if bias is None:
         return None, None
@@ -398,7 +503,11 @@ def _bias_group(bias, b, h):
     if bb not in (1, b) or bh_ not in (1, h):
         raise ValueError(f"bias dims {bias.shape[:2]} must broadcast "
                          f"against (B={b}, H={h})")
-    bias_g = bias.reshape(bb * bh_, *bias.shape[2:])
+    if bias.shape[2] not in (1, sq) or bias.shape[3] not in (1, sk):
+        raise ValueError(f"bias dims {bias.shape[2:]} must broadcast "
+                         f"against (Sq={sq}, Sk={sk})")
+    bias = jnp.broadcast_to(bias, (bb, bh_, sq, sk))
+    bias_g = bias.reshape(bb * bh_, sq, sk)
     if bb == 1 and bh_ == 1:
         idx = lambda g: 0
     elif bb == 1:                       # (1, H, ...) — per-head bias
@@ -410,42 +519,74 @@ def _bias_group(bias, b, h):
     return bias_g, idx
 
 
-def _flash_attention_fwd_res(q, k, v, bias, scale, causal, block_q,
-                             block_k):
+def _seed_arr(dropout_seed, dropout_rate):
+    if dropout_rate == 0.0:
+        return None
+    if not 0.0 < dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
+    if dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
+    return jnp.asarray(dropout_seed, jnp.int32).reshape(-1)[:1]
+
+
+def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
+                             block_q, block_k, dropout_rate):
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     q3, k3, v3 = _to3(q, k, v)
-    bias_g, bidx = _bias_group(bias, b, h)
+    bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
+    seed = _seed_arr(dropout_seed, dropout_rate)
     o3, lse = _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q,
-                         block_k)
+                         block_k, dropout_rate, seed)
     o = jnp.swapaxes(o3.reshape(b, h, sq, d), 1, 2)
-    return o, (q, k, v, bias, o, lse)
+    return o, (q, k, v, bias, dropout_seed, o, lse)
 
 
-def _fa_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    o, res = _flash_attention_fwd_res(q, k, v, bias, scale, causal,
-                                      block_q, block_k)
+def _fa_fwd(q, k, v, bias, scale, causal, block_q, block_k, dropout_rate,
+            dropout_seed):
+    o, res = _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale,
+                                      causal, block_q, block_k,
+                                      dropout_rate)
     return o, res
 
 
-def _fa_bwd(scale, causal, block_q, block_k, res, do):
-    q, k, v, bias, o, lse = res
+def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
+    q, k, v, bias, dropout_seed, o, lse = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
     q3, k3, v3 = _to3(q, k, v)
-    bias_g, bidx = _bias_group(bias, b, h)
+    bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
+    seed = _seed_arr(dropout_seed, dropout_rate)
     o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
     do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3,
-                               scale_, causal, block_q, block_k)
+                               scale_, causal, block_q, block_k,
+                               dropout_rate=dropout_rate, seed=seed)
     un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
     dbias = None if bias is None else _bias_grad(
-        q, k, v, bias, o, lse, do, scale_, causal)
-    return un(dq3, sq), un(dk3, sk), un(dv3, sk), dbias
+        q, k, v, bias, o, lse, do, scale_, causal,
+        dropout_rate=dropout_rate, seed=seed,
+        block_q=block_q, block_k=block_k)
+    return un(dq3, sq), un(dk3, sk), un(dv3, sk), dbias, None
 
 
-def _bias_grad(q, k, v, bias, o, lse, do, scale, causal):
+def _keep_mask_dense(seed, b, h, sq, sk, bq, bk, rate):
+    """Host-side (dense) replica of :func:`_keep_mask` over the full
+    (B·H, Sq, Sk) score tensor — bitwise identical to what the kernels
+    generate, reconstructed from global coordinates via the block
+    decomposition. Only used by the bias-gradient path, which is dense
+    anyway."""
+    gb = jax.lax.broadcasted_iota(jnp.uint32, (b * h, sq, sk), 0)
+    qr = jax.lax.broadcasted_iota(jnp.uint32, (b * h, sq, sk), 1)
+    kc = jax.lax.broadcasted_iota(jnp.uint32, (b * h, sq, sk), 2)
+    return _mix_keep(seed, gb, qr // bq, kc // bk, qr % bq, kc % bk, rate)
+
+
+def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
+               dropout_rate=0.0, seed=None, block_q=DEFAULT_BLOCK_Q,
+               block_k=DEFAULT_BLOCK_K):
     """Cotangent for a learned additive bias (e.g. relative-position
     biases): ds = p * (dp - delta), reduced to the bias's broadcast
     shape. Recomputes p from the saved lse so no extra softmax pass is
@@ -465,13 +606,18 @@ def _bias_grad(q, k, v, bias, o, lse, do, scale, causal):
     p = jnp.exp(s - lse.reshape(b, h, sq)[..., None])
     dp = jnp.einsum("bqhd,bkhd->bhqk", do.astype(jnp.float32),
                     v.astype(jnp.float32))
+    if dropout_rate > 0.0:
+        bq = min(block_q, max(16, sq))
+        bk = min(block_k, max(16, sk))
+        keep = _keep_mask_dense(seed[0], b, h, sq, sk, bq, bk,
+                                dropout_rate).reshape(b, h, sq, sk)
+        dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                       # (b, sq, h)
     ds = p * (dp - jnp.swapaxes(delta, 1, 2)[..., None])
-    if bias.shape[0] == 1:
-        ds = jnp.sum(ds, axis=0, keepdims=True)
-    if bias.shape[1] == 1:
-        ds = jnp.sum(ds, axis=1, keepdims=True)
+    for axis in range(4):
+        if bias.shape[axis] == 1:
+            ds = jnp.sum(ds, axis=axis, keepdims=True)
     return ds.astype(bias.dtype)
 
 
@@ -520,27 +666,27 @@ def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
     ``lse`` (B, H, Sq) differentiable — the building block ring attention
     needs to merge partial results across sequence shards.
     """
-    o, (_, _, _, _, _, lse) = _flash_attention_fwd_res(
-        q, k, v, bias, scale, causal, block_q, block_k)
+    o, (*_, lse) = _flash_attention_fwd_res(
+        q, k, v, bias, None, scale, causal, block_q, block_k, 0.0)
     b, sq, h, d = q.shape
     return o, lse.reshape(b, h, sq)
 
 
 def _fal_fwd(q, k, v, bias, scale, causal, block_q, block_k):
-    o, res = _flash_attention_fwd_res(q, k, v, bias, scale, causal,
-                                      block_q, block_k)
+    o, res = _flash_attention_fwd_res(q, k, v, bias, None, scale, causal,
+                                      block_q, block_k, 0.0)
     b, sq, h, _ = q.shape
-    return (o, res[5].reshape(b, h, sq)), res
+    return (o, res[6].reshape(b, h, sq)), res
 
 
 def _fal_bwd(scale, causal, block_q, block_k, res, cot):
     do, dlse = cot
-    q, k, v, bias, o, lse = res
+    q, k, v, bias, _, o, lse = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
     q3, k3, v3 = _to3(q, k, v)
-    bias_g, bidx = _bias_group(bias, b, h)
+    bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
     o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
     do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     # d lse/d s = p, so the lse cotangent folds into the delta term:

@@ -47,7 +47,7 @@ def bn_group_spec(world_size: int, bn_group: int):
 def BatchNorm2d_NHWC(num_features: int, *, fuse_relu: bool = False,
                      bn_group: int = 1, world_size: Optional[int] = None,
                      axis_name: Optional[str] = None,
-                     momentum: float = 0.9, epsilon: float = 1e-5,
+                     momentum: float = 0.1, epsilon: float = 1e-5,
                      param_dtype: Any = jnp.float32) -> SyncBatchNorm:
     """Constructor mirror of ``BatchNorm2d_NHWC(planes, fuse_relu=...,
     bn_group=...)`` (`apex/contrib/groupbn/batch_norm.py:18-90`).

@@ -127,9 +127,17 @@ def _ln_bwd_kernel(h, eps, affine, g_ref, x_ref, *rest):
     dx_ref[:] = jnp.where(mask, dx, 0.0).astype(dx_ref.dtype)
     if affine:
         gm = jnp.where(mask, g, 0.0)
-        # per-block partial reductions (`cuComputePartGradGammaBeta`)
-        dw_ref[:] = jnp.sum(gm * xhat, axis=0, keepdims=True)
-        db_ref[:] = jnp.sum(gm, axis=0, keepdims=True)
+        # per-block partial reductions (`cuComputePartGradGammaBeta`),
+        # written into row 0 of an 8-sublane slab: Mosaic requires the
+        # block's second-to-last dim be a multiple of 8 (or the full
+        # array dim), so a (1, hp) partial row per grid step is not a
+        # legal block — the stage-2 sum absorbs the zero rows
+        rows = jax.lax.broadcasted_iota(jnp.int32, dw_ref.shape, 0)
+        dw_ref[:] = jnp.where(rows == 0,
+                              jnp.sum(gm * xhat, axis=0, keepdims=True),
+                              0.0)
+        db_ref[:] = jnp.where(rows == 0,
+                              jnp.sum(gm, axis=0, keepdims=True), 0.0)
 
 
 def _ln_backward(g2, x2, weight, eps):
@@ -144,7 +152,7 @@ def _ln_backward(g2, x2, weight, eps):
 
     row_spec = pl.BlockSpec((r, hp), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
-    part_spec = pl.BlockSpec((1, hp), lambda i: (i, 0),
+    part_spec = pl.BlockSpec((8, hp), lambda i: (i, 0),
                              memory_space=pltpu.VMEM)
     in_specs = [row_spec, row_spec]
     args = [gp, xp]
@@ -155,7 +163,8 @@ def _ln_backward(g2, x2, weight, eps):
                                      memory_space=pltpu.VMEM))
         args.append(_pad2(weight.reshape(1, h), 1, hp))
         out_specs += [part_spec, part_spec]
-        out_shapes += [jax.ShapeDtypeStruct((nblocks, hp), jnp.float32)] * 2
+        out_shapes += [jax.ShapeDtypeStruct((nblocks * 8, hp),
+                                            jnp.float32)] * 2
 
     res = pl.pallas_call(
         functools.partial(_ln_bwd_kernel, h, eps, affine),

@@ -7,9 +7,9 @@ apex_tpu.ops.attention) vs ``impl='default'`` (pure-jnp reference path),
 optional pre-LayerNorm + residual add (``include_norm_add``, the
 ``*_norm_add`` CUDA variants), additive masks, and softmax/output dropout.
 
-With softmax dropout active (training), the fast path falls back to the
-default impl — the fused kernel is deterministic; see ops.attention
-docstring.
+Softmax dropout on the fast path runs *inside* the fused kernel (the
+reference fuses Philox dropout into its softmax kernel, `dropout.h`);
+the per-step seed is drawn from the module's ``'dropout'`` rng stream.
 """
 
 from __future__ import annotations
@@ -23,6 +23,17 @@ import flax.linen as nn
 
 from apex_tpu.ops import attention as A
 from apex_tpu.ops.layer_norm import fused_layer_norm_affine
+
+
+def _softmax_dropout(mod, rate, deterministic):
+    """(rate, seed) for the fused kernel: 0-rate when not training, else
+    a fresh int32 seed folded out of the module's 'dropout' rng stream."""
+    if rate <= 0 or deterministic:
+        return 0.0, None
+    rng = mod.make_rng("dropout")
+    seed = jax.lax.bitcast_convert_type(
+        jax.random.bits(rng, dtype=jnp.uint32), jnp.int32)
+    return rate, seed
 
 
 def _dropout_attention(mod, q, k, v, bias, causal, rate, deterministic):
@@ -87,10 +98,11 @@ class SelfMultiheadAttn(nn.Module):
         shape4 = lambda t: t.reshape(B, S, nh, d)
         q, k, v = map(shape4, (q, k, v))
 
-        use_fast = (self.impl == "fast"
-                    and not (self.dropout > 0 and not deterministic))
-        if use_fast:
-            ctx = A.flash_attention(q, k, v, bias=attn_bias, causal=causal)
+        if self.impl == "fast":
+            rate, seed = _softmax_dropout(self, self.dropout,
+                                          deterministic)
+            ctx = A.flash_attention(q, k, v, bias=attn_bias, causal=causal,
+                                    dropout_rate=rate, dropout_seed=seed)
         else:
             ctx = _dropout_attention(
                 self, q, k, v, attn_bias, causal, self.dropout,
@@ -142,10 +154,11 @@ class EncdecMultiheadAttn(nn.Module):
         k = k.reshape(B, Sk, nh, d)
         v = v.reshape(B, Sk, nh, d)
 
-        use_fast = (self.impl == "fast"
-                    and not (self.dropout > 0 and not deterministic))
-        if use_fast:
-            ctx = A.flash_attention(q, k, v, bias=attn_bias)
+        if self.impl == "fast":
+            rate, seed = _softmax_dropout(self, self.dropout,
+                                          deterministic)
+            ctx = A.flash_attention(q, k, v, bias=attn_bias,
+                                    dropout_rate=rate, dropout_seed=seed)
         else:
             ctx = _dropout_attention(self, q, k, v, attn_bias, False,
                                      self.dropout, deterministic)

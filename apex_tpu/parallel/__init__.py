@@ -14,6 +14,7 @@ from apex_tpu.parallel.mesh import (
 )
 from apex_tpu.parallel.distributed import (
     DistributedDataParallel, Reducer, sync_gradients, flat_all_reduce,
+    flat_tree_all_reduce,
     replicate,
 )
 from apex_tpu.parallel.larc import LARC, larc_rewrite_grads
@@ -32,7 +33,7 @@ __all__ = [
     "make_mesh", "data_parallel_mesh", "hierarchical_data_mesh",
     "replicated", "batch_sharding", "axis_size", "local_batch",
     "DistributedDataParallel", "Reducer", "sync_gradients",
-    "flat_all_reduce", "replicate",
+    "flat_all_reduce", "flat_tree_all_reduce", "replicate",
     "LARC", "larc_rewrite_grads",
     "distributed_init", "is_distributed", "process_index", "process_count",
     "maybe_print",

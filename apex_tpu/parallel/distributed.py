@@ -14,13 +14,19 @@ compiler's job. What survives as API are the *semantic* knobs:
   (`distributed.py:144-148,442-451`): pre/post division around the reduce.
 - ``allreduce_always_fp32`` (`distributed.py:140-143,455-459`): reduce half
   grads in fp32.
-- ``delay_allreduce`` (`distributed.py:168`): sync once at the end instead
-  of overlapped — on TPU both compile to the same collectives, kept for API
-  parity (it disables XLA's combining hint).
+- ``delay_allreduce`` (`distributed.py:168,491-510`): the reference's
+  ``allreduce_fallback`` — skip overlapped per-bucket reduction, sync
+  everything in one flat fused all-reduce per dtype at the end. Here it
+  switches ``sync`` to :func:`flat_tree_all_reduce` (one ``psum`` of a
+  concatenated buffer per dtype instead of per-tensor ``psum``s).
 - ``no_sync`` / ``_disable_allreduce`` (`distributed.py:566-570`): gradient
   accumulation without communication.
 - ``message_size`` (`distributed.py:165`): the bucket-combine threshold,
-  forwarded to XLA's allreduce combiner.
+  forwarded to XLA's collective-combiner via jit ``compiler_options``
+  (``xla_gpu_all_reduce_combine_threshold_bytes`` — the DebugOptions field
+  is shared across backends). Backends whose compile service rejects
+  option overrides (e.g. the axon tunnel) fall back to default combining
+  with a one-time warning.
 
 ``Reducer`` (`distributed.py:89-126`) survives as the manual-trigger
 average; ``flat_dist_call`` (`distributed.py:26-49`) as ``flat_all_reduce``
@@ -30,6 +36,7 @@ over an arena buffer.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Callable, Optional
 
 import jax
@@ -87,6 +94,45 @@ def flat_all_reduce(buf: jax.Array, axis_name: str = DATA_AXIS, *,
     return out
 
 
+def flat_tree_all_reduce(grads, axis_name: str = DATA_AXIS, *,
+                         gradient_average: bool = True,
+                         gradient_predivide_factor: float = 1.0,
+                         allreduce_always_fp32: bool = False):
+    """``allreduce_fallback`` (`apex/parallel/distributed.py:491-510`):
+    concatenate all floating gradients into one flat buffer *per dtype*
+    (the reference's type-bucketed ``flat_dist_call``), one ``psum`` per
+    buffer, then split back. Same arithmetic knobs as
+    :func:`sync_gradients`."""
+    world = jax.lax.axis_size(axis_name)
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+
+    groups = {}
+    for i, leaf in enumerate(leaves):
+        if _is_float(leaf):
+            groups.setdefault(jnp.asarray(leaf).dtype, []).append(i)
+
+    out = list(leaves)
+    for dtype, idxs in groups.items():
+        flat = jnp.concatenate(
+            [jnp.ravel(leaves[i]) for i in idxs])
+        if allreduce_always_fp32:
+            flat = flat.astype(jnp.float32)
+        if gradient_predivide_factor != 1.0:
+            flat = flat / gradient_predivide_factor
+        flat = jax.lax.psum(flat, axis_name)
+        if gradient_average:
+            post = world / gradient_predivide_factor
+            if post != 1.0:
+                flat = flat / post
+        flat = flat.astype(dtype)
+        off = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = flat[off:off + n].reshape(leaves[i].shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 class Reducer:
     """Manual-trigger parameter/gradient averaging
     (`apex/parallel/distributed.py:89-126`): construction-time broadcast is
@@ -131,7 +177,7 @@ class DistributedDataParallel:
                  gradient_predivide_factor: float = 1.0,
                  allreduce_always_fp32: bool = False,
                  delay_allreduce: bool = False,
-                 message_size: int = 10_000_000):
+                 message_size: Optional[int] = None):
         if axis_name not in mesh.axis_names:
             raise ValueError(f"axis {axis_name!r} not in mesh "
                              f"{mesh.axis_names}")
@@ -153,10 +199,13 @@ class DistributedDataParallel:
     def sync(self, grads):
         """Sync a gradient pytree (call inside the wrapped step). Honors
         ``no_sync`` — the `_disable_allreduce` flag
-        (`apex/parallel/distributed.py:566-570`)."""
+        (`apex/parallel/distributed.py:566-570`) — and ``delay_allreduce``
+        (one flat fused reduce per dtype, the `allreduce_fallback` path)."""
         if not self._sync_enabled:
             return grads
-        return sync_gradients(
+        fn = flat_tree_all_reduce if self.delay_allreduce else \
+            sync_gradients
+        return fn(
             grads, self.axis_name,
             gradient_average=self.gradient_average,
             gradient_predivide_factor=self.gradient_predivide_factor,
@@ -217,6 +266,10 @@ class DistributedDataParallel:
                 in_specs=(state_specs, batch_specs),
                 out_specs=out_specs,
                 check_vma=False)
+            opts = self._compiler_options()
+            if opts:
+                return jax.jit(mapped, compiler_options=opts,
+                               **jit_kwargs)
             return jax.jit(mapped, **jit_kwargs)
 
         programs = {}
@@ -229,6 +282,42 @@ class DistributedDataParallel:
             return programs[key](*args, **kwargs)
 
         return dispatch
+
+    # None = not probed yet; set process-wide by the first probe
+    _options_supported: Optional[bool] = None
+
+    @staticmethod
+    def _probe_compiler_options() -> bool:
+        """Whether this backend's compile service accepts DebugOptions
+        overrides (the axon remote-compile tunnel rejects them all).
+        Probed once per process with a trivial program so a user step
+        failure can never be misattributed to option rejection."""
+        cls = DistributedDataParallel
+        if cls._options_supported is None:
+            try:
+                jax.jit(lambda x: x + 1, compiler_options={
+                    "xla_gpu_all_reduce_combine_threshold_bytes":
+                    "10485760"})(jnp.zeros(1))
+                cls._options_supported = True
+            except Exception:
+                cls._options_supported = False
+                warnings.warn(
+                    "backend rejects compiler options; the message_size "
+                    "combine hint will be ignored", RuntimeWarning)
+        return cls._options_supported
+
+    def _compiler_options(self) -> Optional[dict]:
+        """``message_size`` (elements; the reference default is 1e7 ≈
+        40 MB of fp32, `apex/parallel/distributed.py:165`) → the XLA
+        collective-combiner threshold. ``None`` lets XLA choose. The
+        DebugOptions field is shared across backends despite the gpu
+        prefix; TPU's combiner reads the same proto field."""
+        if self.message_size is None:
+            return None
+        if not self._probe_compiler_options():
+            return None
+        return {"xla_gpu_all_reduce_combine_threshold_bytes":
+                str(int(self.message_size) * 4)}
 
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Wrap ``grad_fn(*a, **k) -> (value, grads)`` so grads come back

@@ -1,14 +1,14 @@
 """apex_tpu.sparsity — 2:4 structured sparsity (ASP, SURVEY.md §2.8)."""
 
 from apex_tpu.sparsity.masklib import (
-    create_mask, m4n2_1d, m4n2_2d_greedy, density,
+    create_mask, m4n2_1d, m4n2_2d_greedy, m4n2_2d_best, density,
 )
 from apex_tpu.sparsity.asp import (
     ASP, ASPState, compute_sparse_masks, prune, default_whitelist,
 )
 
 __all__ = [
-    "create_mask", "m4n2_1d", "m4n2_2d_greedy", "density",
+    "create_mask", "m4n2_1d", "m4n2_2d_greedy", "m4n2_2d_best", "density",
     "ASP", "ASPState", "compute_sparse_masks", "prune",
     "default_whitelist",
 ]

@@ -3,16 +3,24 @@
 Rebuild of `apex/contrib/sparsity/sparse_masklib.py:25-160`: for every
 contiguous group of 4 elements along the last (reduction) dimension keep
 the 2 with the pattern maximizing preserved magnitude. ``m4n2_1d`` is the
-exhaustive 6-pattern search (`create_mask`'s "1d best"); ``m4n2_2d_greedy``
-approximates the 4x4 block variant by row-wise 1d on permuted layouts.
+exhaustive 6-pattern search (`create_mask`'s "1d best"). The 2d variants
+operate per 4x4 block so the mask is 2:4 along BOTH rows and columns
+(the transposed weight used by dgrad is then also structured-sparse,
+`sparse_masklib.py:54-66`): ``m4n2_2d_greedy`` is the reference's greedy
+descending-magnitude fill with row/column counters (`mn_2d_greedy`,
+`sparse_masklib.py:69-97`) vectorized over all blocks at once;
+``m4n2_2d_best`` is the exhaustive search over the 90 doubly-2:4 4x4
+patterns (`mn_2d_best`, `sparse_masklib.py:123-139`).
 
 Everything is pure tensor math (the reference computes masks in torch on
-device, `sparse_masklib.py:145-160`) — jit/vmap friendly, no host loops
-over elements.
+device for 1d/2d-best but drops to a per-block numpy loop for greedy,
+`sparse_masklib.py:71-97`) — here all patterns are jit/vmap friendly with
+no host loops over elements.
 """
 
 from __future__ import annotations
 
+import functools
 import itertools
 
 import jax
@@ -23,6 +31,19 @@ import numpy as np
 _PATTERNS_4C2 = np.array(
     [p for p in itertools.product((0, 1), repeat=4) if sum(p) == 2],
     np.float32)                                    # (6, 4)
+
+
+@functools.lru_cache(maxsize=None)
+def _patterns_4x4_2d() -> np.ndarray:
+    """The 90 4x4 binary patterns whose every row AND column keeps
+    exactly 2 of 4 (`compute_valid_2d_patterns`,
+    `sparse_masklib.py:102-120`), flattened to (90, 16)."""
+    rows = _PATTERNS_4C2                           # (6, 4)
+    combos = np.stack(np.meshgrid(*([np.arange(6)] * 4),
+                                  indexing="ij"), -1).reshape(-1, 4)
+    pats = rows[combos]                            # (1296, 4, 4)
+    valid = (pats.sum(axis=1) == 2).all(axis=1)    # column sums == 2
+    return pats[valid].reshape(-1, 16).astype(np.float32)
 
 
 def m4n2_1d(w) -> jax.Array:
@@ -48,23 +69,81 @@ def m4n2_1d(w) -> jax.Array:
     return mask_body
 
 
+def _to_blocks(w):
+    """(..., R, C) -> (N, 16) of 4x4 blocks covering the divisible body,
+    plus the bookkeeping to undo it. Tail rows/cols (R%4, C%4) stay dense
+    (`mn_2d_greedy` only iterates rowCount/colCount multiples of m,
+    `sparse_masklib.py:74-76`)."""
+    *lead, r, c = w.shape
+    rb, cb = (r // 4) * 4, (c // 4) * 4
+    body = w[..., :rb, :cb].astype(jnp.float32)
+    nlead = int(np.prod(lead)) if lead else 1
+    blocks = body.reshape(nlead, rb // 4, 4, cb // 4, 4)
+    blocks = jnp.swapaxes(blocks, 2, 3).reshape(-1, 16)
+    return blocks, (lead, r, c, rb, cb, nlead)
+
+
+def _from_blocks(mask_flat, meta, w_shape):
+    lead, r, c, rb, cb, nlead = meta
+    m = mask_flat.reshape(nlead, rb // 4, cb // 4, 4, 4)
+    m = jnp.swapaxes(m, 2, 3).reshape(*lead, rb, cb)
+    if rb < r:
+        m = jnp.concatenate(
+            [m, jnp.ones((*lead, r - rb, cb), m.dtype)], axis=-2)
+    if cb < c:
+        m = jnp.concatenate(
+            [m, jnp.ones((*lead, r, c - cb), m.dtype)], axis=-1)
+    return m
+
+
 def m4n2_2d_greedy(w) -> jax.Array:
-    """Greedy 4x4-block variant (`sparse_masklib.py` "2d greedy"): 2:4
-    along the last dim computed on the transposed view as well; keep the
-    better-scoring orientation per tensor."""
+    """Per-4x4-block greedy doubly-2:4 mask — the algorithm of
+    ``mn_2d_greedy`` (`sparse_masklib.py:69-97`): visit block entries in
+    descending |magnitude|, keep an entry unless its row or column
+    already holds 2 kept entries. The reference runs this as a numpy
+    loop per block; here all blocks step together through the 16
+    magnitude ranks (vectorized one-hot scatters), so it jits and runs
+    on device."""
     if w.ndim < 2:
         return m4n2_1d(w)
-    m_row = m4n2_1d(w)
-    wt = jnp.swapaxes(w, -1, -2)
-    m_col = jnp.swapaxes(m4n2_1d(wt), -1, -2)
-    w32 = jnp.abs(w.astype(jnp.float32))
-    keep = (jnp.sum(w32 * m_row) >= jnp.sum(w32 * m_col))
-    return jnp.where(keep, m_row, m_col)
+    blocks, meta = _to_blocks(w)
+    n = blocks.shape[0]
+    order = jnp.argsort(-jnp.abs(blocks), axis=-1)   # (N, 16) descending
+    rowcnt = jnp.zeros((n, 4), jnp.int32)
+    colcnt = jnp.zeros((n, 4), jnp.int32)
+    mask = jnp.zeros((n, 16), bool)
+    for t in range(16):
+        idx = order[:, t]                            # (N,)
+        rr, cc = idx // 4, idx % 4
+        r1 = jax.nn.one_hot(rr, 4, dtype=jnp.int32)  # (N, 4)
+        c1 = jax.nn.one_hot(cc, 4, dtype=jnp.int32)
+        can = ((jnp.sum(rowcnt * r1, axis=1) < 2)
+               & (jnp.sum(colcnt * c1, axis=1) < 2))  # (N,)
+        take = can[:, None]
+        rowcnt = rowcnt + r1 * take
+        colcnt = colcnt + c1 * take
+        mask = mask | (jax.nn.one_hot(idx, 16, dtype=jnp.int32)
+                       * take).astype(bool)
+    return _from_blocks(mask, meta, w.shape)
+
+
+def m4n2_2d_best(w) -> jax.Array:
+    """Exhaustive per-4x4-block doubly-2:4 mask (``mn_2d_best``,
+    `sparse_masklib.py:123-139`): argmax of preserved |magnitude| over
+    the 90 valid patterns — one batched matmul over all blocks."""
+    if w.ndim < 2:
+        return m4n2_1d(w)
+    blocks, meta = _to_blocks(w)
+    pats = jnp.asarray(_patterns_4x4_2d())           # (90, 16)
+    scores = jnp.abs(blocks) @ pats.T                # (N, 90)
+    mask = pats[jnp.argmax(scores, axis=-1)] > 0.5   # (N, 16)
+    return _from_blocks(mask, meta, w.shape)
 
 
 _PATTERNS = {
     "m4n2_1d": m4n2_1d,
     "m4n2_2d_greedy": m4n2_2d_greedy,
+    "m4n2_2d_best": m4n2_2d_best,
 }
 
 

@@ -204,3 +204,169 @@ class TestDecorators:
             assert p(x16, x32) == (jnp.float32, jnp.float32)
         # outside the scope: no casting
         assert h(x32) == jnp.float32
+
+
+class TestInterceptorCoverage:
+    def test_dtype_restored_after_auto_cast(self):
+        """A module instance reused outside auto_cast must be unaffected —
+        the dtype retarget is scoped to the intercepted call (the reference
+        restores patched functions on handle exit, `handle.py:170-252`)."""
+        import flax.linen as nn
+        model = nn.Dense(4)
+        x = jnp.ones((2, 8))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        policy = amp.Policy.from_opt_level("O1")
+
+        with amp.auto_cast(policy):
+            y_in = model.apply(variables, x)
+        assert model.dtype is None, "dtype retarget leaked out of the call"
+        y_out = model.apply(variables, x)
+        assert y_in.dtype == jnp.bfloat16
+        assert y_out.dtype == jnp.float32, \
+            "module reused outside auto_cast must compute fp32"
+
+    def test_embed_covered(self):
+        """nn.Embed is whitelisted: the lookup result comes out half so the
+        downstream matmuls run on the MXU."""
+        import flax.linen as nn
+        emb = nn.Embed(16, 8)
+        ids = jnp.arange(4)
+        variables = emb.init(jax.random.PRNGKey(0), ids)
+        policy = amp.Policy.from_opt_level("O1")
+        with amp.auto_cast(policy):
+            out = emb.apply(variables, ids)
+        assert out.dtype == jnp.bfloat16
+        assert variables["params"]["embedding"].dtype == jnp.float32
+
+    def test_user_registration_precedence(self):
+        """A user-registered module class out-prioritises the built-in
+        tables (`apex/amp/amp.py:94-114` semantics) — here a LayerNorm
+        subclass forced to half."""
+        import flax.linen as nn
+
+        class HalfNorm(nn.LayerNorm):
+            pass
+
+        amp.register_half_module(HalfNorm)
+        try:
+            m = HalfNorm()
+            x = jnp.ones((2, 8))
+            variables = m.init(jax.random.PRNGKey(0), x)
+            policy = amp.Policy.from_opt_level("O1")
+            with amp.auto_cast(policy):
+                y = m.apply(variables, x)
+            assert y.dtype == jnp.bfloat16, \
+                "user half registration must beat the builtin blacklist"
+        finally:
+            from apex_tpu.amp import lists
+            lists._EXTRA_HALF_MODULES.remove(HalfNorm)
+
+    def test_explicit_user_dtype_wins(self):
+        """A module constructed with an explicit dtype is never retargeted."""
+        import flax.linen as nn
+        model = nn.Dense(4, dtype=jnp.float32)
+        x = jnp.ones((2, 8))
+        variables = model.init(jax.random.PRNGKey(0), x)
+        policy = amp.Policy.from_opt_level("O1")
+        with amp.auto_cast(policy):
+            y = model.apply(variables, x)
+        assert y.dtype == jnp.float32
+
+
+class TestGradAccumulation:
+    """Microbatch accumulation across backwards — `apex/amp/scaler.py:152-190`
+    (unscale_with_stashed) + `_process_optimizer.py:142-158` semantics."""
+
+    def _setup(self, opt_level="O2", **overrides):
+        from apex_tpu.optim import FusedSGD
+        policy = amp.Policy.from_opt_level(opt_level, **overrides)
+        amp_opt = amp.Amp(policy, FusedSGD(lr=0.1))
+        params = {"w": jnp.arange(8.0) / 8.0}
+        return amp_opt, amp_opt.init(params)
+
+    @staticmethod
+    def _loss(mp, xb):
+        return jnp.sum(jnp.square(xb * mp["w"].astype(jnp.float32)))
+
+    @pytest.mark.parametrize("opt_level,rtol", [("O0", 1e-6), ("O2", 1e-2)])
+    def test_accumulated_equals_full_batch(self, opt_level, rtol):
+        """O0 is exact; O2 matches to bf16 grad precision (each microbatch
+        grad rounds through the model-dtype cast, like the reference's
+        fp16 model grads)."""
+        amp_opt, state = self._setup(opt_level)
+        x = jnp.arange(32.0).reshape(4, 8) / 32.0
+
+        # 4 microbatches accumulated
+        acc, fin, st = None, True, state
+        for i in range(4):
+            _, acc, st, fin = amp_opt.backward_accumulate(
+                st, self._loss, x[i], stashed=acc, finite=fin)
+        st_acc = amp_opt.apply_gradients(st, acc, fin)
+
+        # one backward of the summed loss
+        def full(mp):
+            return sum(self._loss(mp, x[i]) for i in range(4))
+        _, g, st2, f2 = amp_opt.backward(state, full)
+        st_full = amp_opt.apply_gradients(st2, g, f2)
+
+        np.testing.assert_allclose(np.asarray(st_acc.params["w"]),
+                                   np.asarray(st_full.params["w"]),
+                                   rtol=rtol, atol=rtol)
+
+    def test_overflow_in_one_microbatch_skips_step(self):
+        # fp16 half dtype => dynamic scaler => overflow machinery active
+        amp_opt, state = self._setup("O2", half_dtype=jnp.float16)
+        x = jnp.ones((2, 8))
+        bad = jnp.full((8,), jnp.inf)
+
+        acc, fin, st = None, True, state
+        _, acc, st, fin = amp_opt.backward_accumulate(
+            st, self._loss, x[0], stashed=acc, finite=fin)
+        _, acc, st, fin = amp_opt.backward_accumulate(
+            st, self._loss, bad, stashed=acc, finite=fin)
+        assert not bool(fin)
+        stepped = amp_opt.apply_gradients(st, acc, fin)
+        np.testing.assert_array_equal(np.asarray(stepped.params["w"]),
+                                      np.asarray(state.params["w"]))
+        assert int(stepped.step) == 0
+
+    def test_scale_advances_between_microbatches(self):
+        """The dynamic-scale schedule ticks per backward (the reference
+        updates per unscale); accumulation must stay correct across the
+        scale change."""
+        amp_opt, state = self._setup("O2", half_dtype=jnp.float16)
+        assert amp_opt.scale_cfg is not None
+        # force growth every backward so microbatches see different scales
+        from apex_tpu.amp.scaler import LossScaleConfig
+        amp_opt.scale_cfg = LossScaleConfig(
+            dynamic=True, init_scale=2.0**4, growth_interval=1)
+        state = amp_opt.init({"w": jnp.arange(8.0) / 8.0})
+        x = jnp.arange(16.0).reshape(2, 8) / 16.0
+
+        acc, fin, st = None, True, state
+        for i in range(2):
+            _, acc, st, fin = amp_opt.backward_accumulate(
+                st, self._loss, x[i], stashed=acc, finite=fin)
+        assert float(st.scalers[0].loss_scale) > 2.0**4  # scale moved
+        ref = jax.grad(lambda mp: self._loss(mp, x[0])
+                       + self._loss(mp, x[1]))({"w": jnp.arange(8.0) / 8.0})
+        np.testing.assert_allclose(np.asarray(acc["w"]),
+                                   np.asarray(ref["w"]), rtol=1e-5)
+
+    def test_under_scan(self):
+        """The accumulation loop must be lax.scan-able (static structure)."""
+        amp_opt, state = self._setup()
+        x = jnp.arange(32.0).reshape(4, 8) / 32.0
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, jnp.float32), state.params)
+
+        def body(carry, xb):
+            st, acc, fin = carry
+            _, acc, st, fin = amp_opt.backward_accumulate(
+                st, self._loss, xb, stashed=acc, finite=fin)
+            return (st, acc, fin), ()
+
+        (st, acc, fin), _ = jax.lax.scan(
+            body, (state, zeros, jnp.bool_(True)), x)
+        stepped = amp_opt.apply_gradients(st, acc, fin)
+        assert int(stepped.step) == 1

@@ -245,7 +245,10 @@ class TestBiasGradient:
     def test_broadcast_bias_not_materialized(self):
         """The (1,1,S,S) bias must flow to the kernel ungrown — assert the
         jaxpr contains no (B*H, S, S)-sized broadcast of it."""
-        b, s, h, d = 4, 128, 4, 32
+        # s must differ from the padded head dim (128) or the q/k/v
+        # d-padding pad op's (B*H, S, 128) shape collides with the
+        # (B*H, S, S) pattern this test greps for
+        b, s, h, d = 4, 256, 4, 32
         rng = np.random.RandomState(9)
         q, k, v = rand_qkv(rng, b, s, h, d)
         bias = jnp.zeros((1, 1, s, s), jnp.float32)
@@ -255,3 +258,115 @@ class TestBiasGradient:
         blown_up = f"{b * h},{s},{s}"
         assert blown_up not in str(jaxpr).replace(" ", ""), \
             "bias was broadcast to B*H copies before the kernel"
+
+
+class TestFusedDropout:
+    """In-kernel softmax dropout (the reference's fused Philox dropout,
+    `apex/contrib/csrc/multihead_attn/dropout.h:1-308`). The mask is
+    counter-based, so a dense jnp replica (`_keep_mask_dense`) lets us
+    compare the kernel against an exact oracle — forward AND gradients."""
+
+    def _oracle(self, q, k, v, seed, rate, bias=None, causal=False):
+        """Reference attention applying the *same* mask the kernel
+        generates, via the dense mask replica."""
+        b, sq, h, d = q.shape
+        sk = k.shape[1]
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / np.sqrt(d)
+        if bias is not None:
+            s = s + bias.astype(jnp.float32)
+        if causal:
+            cm = np.tril(np.ones((sq, sk), bool), k=sk - sq)
+            s = jnp.where(cm, s, A.NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        bq = min(A.DEFAULT_BLOCK_Q, max(16, sq))
+        bk = min(A.DEFAULT_BLOCK_K, max(16, sk))
+        keep = A._keep_mask_dense(jnp.asarray(seed, jnp.int32), b, h,
+                                  sq, sk, bq, bk, rate)
+        keep = keep.reshape(b, h, sq, sk)
+        pt = jnp.where(keep, p / (1.0 - rate), 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", pt,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    def test_forward_matches_masked_oracle(self):
+        rng = np.random.RandomState(3)
+        q, k, v = rand_qkv(rng, 2, 192, 2, 32)
+        got = A.flash_attention(q, k, v, dropout_rate=0.25,
+                                dropout_seed=7)
+        ref = self._oracle(q, k, v, 7, 0.25)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_grads_match_masked_oracle(self):
+        rng = np.random.RandomState(4)
+        q, k, v = rand_qkv(rng, 1, 128, 2, 32)
+
+        def loss_fused(q_, k_, v_):
+            o = A.flash_attention(q_, k_, v_, dropout_rate=0.3,
+                                  dropout_seed=11)
+            return jnp.sum(o * o)
+
+        def loss_ref(q_, k_, v_):
+            o = self._oracle(q_, k_, v_, 11, 0.3)
+            return jnp.sum(o * o)
+
+        gf = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-5)
+
+    def test_dbias_with_dropout(self):
+        rng = np.random.RandomState(5)
+        q, k, v = rand_qkv(rng, 1, 64, 2, 32)
+        bias = jnp.asarray(rng.randn(1, 2, 64, 64).astype(np.float32))
+        gf = jax.grad(lambda b_: jnp.sum(A.flash_attention(
+            q, k, v, bias=b_, dropout_rate=0.2, dropout_seed=13)))(bias)
+        gr = jax.grad(lambda b_: jnp.sum(self._oracle(
+            q, k, v, 13, 0.2, bias=b_)))(bias)
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=5e-5)
+
+    def test_keep_rate_statistics(self):
+        """Uniform scores (q=0) make every prob 1/S, so with v=1 the
+        output row-sum directly reads off the kept fraction."""
+        b, s, h, d = 2, 256, 2, 32
+        rate = 0.3
+        q = jnp.zeros((b, s, h, d), jnp.float32)
+        k = jnp.zeros((b, s, h, d), jnp.float32)
+        v = jnp.ones((b, s, h, d), jnp.float32)
+        out = A.flash_attention(q, k, v, dropout_rate=rate,
+                                dropout_seed=99)
+        # out = kept_count / (S * keep_prob); recover mean keep fraction
+        keep_frac = float(jnp.mean(out)) * (1.0 - rate)
+        n = b * h * s * s
+        sigma = np.sqrt(rate * (1 - rate) / n)
+        assert abs(keep_frac - (1.0 - rate)) < 5 * sigma, \
+            f"keep fraction {keep_frac} vs expected {1 - rate}"
+
+    def test_seed_determinism(self):
+        rng = np.random.RandomState(6)
+        q, k, v = rand_qkv(rng, 1, 64, 2, 32)
+        a1 = A.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=1)
+        a2 = A.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=1)
+        b2 = A.flash_attention(q, k, v, dropout_rate=0.5, dropout_seed=2)
+        assert bool(jnp.all(a1 == a2)), "same seed must be bitwise equal"
+        assert not bool(jnp.all(a1 == b2)), "different seeds must differ"
+
+    def test_module_keeps_fused_path_under_dropout(self):
+        """Training with dropout>0 must NOT fall back to the O(S²) jnp
+        path — the jaxpr of the training forward contains the kernel."""
+        x = jnp.zeros((2, 64, 64), jnp.float32)
+        m = ops.SelfMultiheadAttn(64, 4, dropout=0.1, impl="fast")
+        variables = m.init(jax.random.PRNGKey(0), x)
+        jaxpr = jax.make_jaxpr(lambda v_, x_: m.apply(
+            v_, x_, deterministic=False,
+            rngs={"dropout": jax.random.PRNGKey(1)}))(variables, x)
+        assert "pallas_call" in str(jaxpr), \
+            "fused kernel not used in training forward with dropout"
+
+    def test_missing_seed_raises(self):
+        rng = np.random.RandomState(7)
+        q, k, v = rand_qkv(rng, 1, 32, 1, 32)
+        with pytest.raises(ValueError, match="dropout_seed"):
+            A.flash_attention(q, k, v, dropout_rate=0.5)
