@@ -52,6 +52,60 @@ class _BN(nn.Module):
         return bn(x)
 
 
+class _StemConv(nn.Module):
+    """The 7x7/2 stem conv, optionally via 2x2 space-to-depth.
+
+    A C=3 input maps pathologically onto the MXU: 3 of 128 lanes carry
+    data in the contracting dimension, so the stem's forward and weight
+    gradient run far below roofline. The space-to-depth transform packs
+    each 2x2 pixel cell into channels — (B, H, W, 3) → (B, H/2, W/2, 12)
+    — and runs the arithmetically identical (4, 4, 12, K) stride-1 conv
+    (the kernel zero-padded 7→8 taps so the stride-2 window aligns with
+    whole cells). Parameters keep the canonical (7, 7, 3, K) shape, so
+    checkpoints interchange with the plain stem; the kernel re-layout is
+    37K params of in-graph reshuffling and gradients flow through it.
+    """
+    features: int
+    space_to_depth: bool = True
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, x):
+        k = self.param("kernel", nn.initializers.lecun_normal(),
+                       (7, 7, x.shape[-1], self.features), jnp.float32)
+        # same dtype semantics as nn.Conv: explicit dtype wins, otherwise
+        # promote input/param dtypes to a common compute dtype
+        x, k = nn.dtypes.promote_dtype(x, k, dtype=self.dtype)
+        b, h, w, c = x.shape
+        if not self.space_to_depth or h % 2 or w % 2 or c != 3:
+            return jax.lax.conv_general_dilated(
+                x, k, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        # input: pack 2x2 cells into channels, sub-order (r, s, c)
+        xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2,
+                                                    4 * c)
+        # kernel: zero-pad the window to 8x8 at the leading edge (the
+        # stride-2 window [2i-3, 2i+3] becomes the cell-aligned
+        # [2i-4, 2i+3]), then split taps p=2ρ+r into (cell ρ, sub r)
+        k8 = jnp.pad(k, ((1, 0), (1, 0), (0, 0), (0, 0)))
+        ks = k8.reshape(4, 2, 4, 2, c, self.features)
+        ks = ks.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c,
+                                                    self.features)
+        # cells [i-2, i+1] feed output i → padding (2, 1), stride 1
+        return jax.lax.conv_general_dilated(
+            xs, ks, window_strides=(1, 1), padding=[(2, 1), (2, 1)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+# the stem is MXU-bound like any conv: under auto_cast(O1) it must cast
+# to the half dtype with the rest of the whitelist (nn.Conv matches by
+# isinstance; a custom module needs registering)
+from apex_tpu.amp.lists import register_half_module as _reg_half
+_reg_half(_StemConv)
+del _reg_half
+
+
 class BottleneckBlock(nn.Module):
     features: int
     strides: Tuple[int, int] = (1, 1)
@@ -112,13 +166,16 @@ class ResNet(nn.Module):
     #: precision (the O2 model-cast; params stay ``param_dtype`` fp32 and
     #: are cast per-op by flax, masters live in AmpState).
     dtype: Optional[Any] = None
+    #: run the stem via 2x2 space-to-depth (MXU-friendly C=12 layout);
+    #: automatically falls back to the plain 7x7/2 conv for odd sizes
+    space_to_depth: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         if self.dtype is not None:
             x = x.astype(self.dtype)  # patched-forward input cast
-        y = nn.Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                    use_bias=False, dtype=self.dtype)(x)
+        y = _StemConv(self.width, space_to_depth=self.space_to_depth,
+                      dtype=self.dtype, name="stem_conv")(x)
         y = _BN(self.width, self.bn_axis_name, dtype=self.dtype)(y, train)
         y = nn.relu(y)
         y = nn.max_pool(y, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])

@@ -132,9 +132,56 @@ def multi_tensor_maxnorm(buf):
 def per_tensor_l2norm(buf, segment_ids, num_tensors):
     """Per-tensor L2 norms over the arena in one pass (`multi_tensor_l2norm`
     with ``per_tensor=True``). ``segment_ids`` maps arena position → tensor
-    index (-1 padding); returns (num_tensors,) f32 norms."""
+    index (-1 padding); returns (num_tensors,) f32 norms.
+
+    NOTE: ``segment_sum`` lowers to scatter-add, which TPU serializes
+    (hundreds of ms on large arenas). When the segment layout is static —
+    it always is for the arena, whose offsets are Python ints — use
+    :func:`per_tensor_l2norm_ranges` instead; this traced-ids version
+    remains for callers whose boundaries are genuinely dynamic (e.g.
+    ZeRO shard-local spans that depend on ``axis_index``)."""
     sq = jnp.square(buf.astype(jnp.float32))
     sums = jax.ops.segment_sum(sq, jnp.maximum(segment_ids, 0),
                                num_segments=num_tensors)
     # padding contributes zeros (buf padding is 0), so no correction needed
     return jnp.sqrt(sums)
+
+
+def per_tensor_l2norm_ranges(buf, offsets, sizes):
+    """Per-tensor L2 norms from STATIC arena ranges — no scatter.
+
+    ``offsets``/``sizes`` are the arena partition's Python-int tuples, so
+    each tensor becomes one contiguous slice-reduce; XLA fuses the lot
+    into a single pass over the buffer. This is the TPU-idiomatic form
+    of ``multi_tensor_l2norm(per_tensor=True)``
+    (`multi_tensor_l2norm_kernel.cu:28-113`)."""
+    b32 = buf.astype(jnp.float32)
+    sums = [jnp.sum(jnp.square(jax.lax.slice_in_dim(b32, off, off + sz)))
+            for off, sz in zip(offsets, sizes)]
+    return jnp.sqrt(jnp.stack(sums))
+
+
+def per_tensor_maxnorm_ranges(buf, offsets, sizes):
+    """Per-tensor max-abs (Linf) norms from static arena ranges — the
+    per-tensor ``MaxNormFunctor`` without scatter."""
+    b32 = jnp.abs(buf.astype(jnp.float32))
+    maxs = [jnp.max(jax.lax.slice_in_dim(b32, off, off + sz))
+            for off, sz in zip(offsets, sizes)]
+    return jnp.stack(maxs)
+
+
+def spread_per_tensor(values, offsets, padded, total, fill=0.0):
+    """Broadcast a (num_tensors,) vector back over the arena layout —
+    the inverse gather ``values[segment_ids]`` without the 100M-index
+    gather: static concatenation of broadcasts (``fill`` in alignment
+    gaps and tail padding)."""
+    pieces = []
+    pos = 0
+    for j, (off, sz_pad) in enumerate(zip(offsets, padded)):
+        if off > pos:
+            pieces.append(jnp.full((off - pos,), fill, values.dtype))
+        pieces.append(jnp.broadcast_to(values[j], (sz_pad,)))
+        pos = off + sz_pad
+    if pos < total:
+        pieces.append(jnp.full((total - pos,), fill, values.dtype))
+    return jnp.concatenate(pieces)

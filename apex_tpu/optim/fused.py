@@ -222,17 +222,20 @@ class FusedLAMB(FusedOptimizer):
             adam_w_mode=self.adam_w_mode, clip_scale=clip)
 
         part = spec.partition(dt)
-        seg = arena.segment_ids_device(spec, dt)
-        n = len(part.sizes)
-        p_norms = MT.per_tensor_l2norm(p, seg, n)
-        u_norms = MT.per_tensor_l2norm(u, seg, n)
+        # static arena ranges → per-tensor norms as fused slice-reduces and
+        # the trust-ratio spread as concatenated broadcasts; the traced
+        # segment_ids alternative lowers to scatter/gather over the whole
+        # arena, which TPU serializes (~500 ms on a BERT-Large buffer)
+        p_norms = MT.per_tensor_l2norm_ranges(p, part.offsets, part.sizes)
+        u_norms = MT.per_tensor_l2norm_ranges(u, part.offsets, part.sizes)
         # trust ratio per tensor; NVLAMB applies it even where wd==0 — with
         # a single group, plain LAMB and NVLAMB agree unless wd==0 globally
         ratio = jnp.where((p_norms > 0) & (u_norms > 0),
                           p_norms / u_norms, 1.0)
         if not self.use_nvlamb and self.weight_decay == 0.0:
             ratio = jnp.ones_like(ratio)
-        ratio_pos = jnp.where(seg >= 0, ratio[jnp.maximum(seg, 0)], 0.0)
+        ratio_pos = MT.spread_per_tensor(ratio, part.offsets, part.padded,
+                                         len(p))
         p2 = K.lamb_stage2(p, u, ratio_pos, lr=lr)
         return p2, {"m": m2, "v": v2}
 
@@ -274,11 +277,10 @@ class FusedNovoGrad(FusedOptimizer):
             for p in spec.partitions}
         return FusedOptState(count=jnp.int32(0), slots=slots)
 
-    def _per_tensor_norm(self, g, seg, n):
+    def _per_tensor_norm(self, g, part):
         if self.norm_type == 2:
-            return MT.per_tensor_l2norm(g, seg, n)
-        absg = jnp.abs(g.astype(jnp.float32))
-        return jax.ops.segment_max(absg, jnp.maximum(seg, 0), num_segments=n)
+            return MT.per_tensor_l2norm_ranges(g, part.offsets, part.sizes)
+        return MT.per_tensor_maxnorm_ranges(g, part.offsets, part.sizes)
 
     # custom step: vnorm slot has non-buffer shape
     def step(self, grads, state, params):
@@ -293,9 +295,7 @@ class FusedNovoGrad(FusedOptimizer):
         for part in spec.partitions:
             dt = part.dtype
             p, g = p_bufs[dt], g_bufs[dt]
-            seg = arena.segment_ids_device(spec, dt)
-            n = len(part.sizes)
-            norms = self._per_tensor_norm(g, seg, n)
+            norms = self._per_tensor_norm(g, part)
             v_prev = state.slots["vnorm"][dt]
             blended = self.beta2 * v_prev + (1.0 - self.beta2) * norms
             if self.init_zero:
@@ -304,7 +304,8 @@ class FusedNovoGrad(FusedOptimizer):
                 # init with first-step norm so the first blend is a no-op
                 # (`fused_novograd.py:163-174`)
                 v_new = jnp.where(count == 1, norms, blended)
-            vpos = jnp.where(seg >= 0, v_new[jnp.maximum(seg, 0)], 1.0)
+            vpos = MT.spread_per_tensor(v_new, part.offsets, part.padded,
+                                        len(p), fill=1.0)
             p2, m2 = K.novograd_update(
                 p, g, state.slots["m"][dt], vpos, lr=lr, beta1=self.beta1,
                 beta2=self.beta2, eps=self.eps,

@@ -1,0 +1,57 @@
+"""CLI: parse a profiler logdir into a per-op device-time table.
+
+The command-line mirror of the reference's offline analyzers
+(`python -m apex.pyprof.parse` over nvprof SQLite →
+`apex/pyprof/parse/parse.py:1-30`, and the analyzed table of
+`python -m apex.pyprof.prof` → `apex/pyprof/prof/prof.py:1-256`). Here
+the artifact is a ``jax.profiler`` trace directory (written by
+``apex_tpu.prof.trace`` or any jax trace capture) and the analysis is
+per-HLO-op device timing plus category rollups.
+
+Usage::
+
+    python -m apex_tpu.prof /tmp/trace            # top-30 op table
+    python -m apex_tpu.prof /tmp/trace --top 100
+    python -m apex_tpu.prof /tmp/trace --csv      # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m apex_tpu.prof",
+        description="Per-op device-time analysis of a jax.profiler trace")
+    p.add_argument("logdir", help="trace directory (contains *.xplane.pb)")
+    p.add_argument("--top", type=int, default=30,
+                   help="rows in the op table (default 30)")
+    p.add_argument("--csv", action="store_true",
+                   help="emit name,category,count,total_us rows")
+    args = p.parse_args(argv)
+
+    from apex_tpu.prof.xplane import parse_trace
+
+    tp = parse_trace(args.logdir)
+    if not tp.ops:
+        print("no device ops found in trace (CPU-only run, or no "
+              "*.xplane.pb under the logdir)", file=sys.stderr)
+        return 1
+    if args.csv:
+        print("name,category,occurrences,total_us")
+        for r in tp.ops:
+            print(f"{r.name},{r.category},{r.occurrences},"
+                  f"{r.total_us:.1f}")
+    else:
+        print(tp.table(top=args.top))
+        print()
+        for cat, us in sorted(tp.by_category().items(),
+                              key=lambda kv: -kv[1]):
+            print(f"{cat:<16} {us:12.0f}us")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -4,11 +4,16 @@ The BASELINE.json metric — images/sec/chip + MFU on ResNet-50, amp O2
 (bf16 compute, fp32 masters) + fused SGD — measured on whatever single
 accelerator is present. Prints ONE JSON line.
 
+``python bench.py --all`` additionally measures the full BASELINE.md
+config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
+and writes BENCH_TABLE.md.
+
 See PERF.md for the profiling breakdown behind the current number
 (captured with apex_tpu.prof).
 """
 
 import json
+import sys
 import time
 
 import jax
@@ -16,11 +21,11 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _measure(batch: int, size: int, iters: int):
+def _measure(batch: int, size: int, iters: int, opt_level: str = "O2"):
     from apex_tpu import amp, models, ops
     from apex_tpu.optim import FusedSGD
 
-    policy = amp.Policy.from_opt_level("O2")  # bf16 compute, fp32 masters
+    policy = amp.Policy.from_opt_level(opt_level)
     model = models.ResNet50(num_classes=1000, dtype=policy.compute_dtype)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, size, size, 3).astype(np.float32))
@@ -63,6 +68,270 @@ def _measure(batch: int, size: int, iters: int):
     return batch * iters / dt, loss_val
 
 
+# --- BASELINE.md config table (`python bench.py --all`) ----------------------
+
+def _timeit(jstep, args, iters, warmup=3, rebind=None):
+    """Time a donated-state step; ``rebind(out, args) -> args`` threads the
+    new state back in. Syncs via host fetch (see note in _measure)."""
+    out = None
+    for _ in range(warmup):
+        out = jstep(*args)
+        if rebind:
+            args = rebind(out, args)
+    jax.tree_util.tree_map(
+        lambda l: np.asarray(l),
+        [l for l in jax.tree_util.tree_leaves(out)][:1])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jstep(*args)
+        if rebind:
+            args = rebind(out, args)
+    np.asarray(jax.tree_util.tree_leaves(out)[0].ravel()[0:1])
+    return (time.perf_counter() - t0) / iters
+
+
+def _bench_resnet(opt_level, batch, size, iters, sync_bn=False):
+    """Configs 1-3: ResNet-50 under a preset, optionally with SyncBN over
+    a (1-device here, N on a pod) data mesh."""
+    from apex_tpu import amp, models, ops, parallel
+    from apex_tpu.optim import FusedSGD
+
+    policy = amp.Policy.from_opt_level(opt_level)
+    bn_axis = "data" if sync_bn else None
+    model = models.ResNet50(num_classes=1000, dtype=policy.compute_dtype,
+                            bn_axis_name=bn_axis)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, size, size, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
+
+    def build(xb, yb):
+        variables = model.init(jax.random.PRNGKey(0), xb[:2], train=True)
+        amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9))
+        return amp_opt, amp_opt.init(variables["params"]), \
+            variables["batch_stats"]
+
+    def step(amp_opt, state, batch_stats, xb, yb):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": batch_stats}, xb,
+                train=True, mutable=["batch_stats"])
+            return jnp.mean(ops.softmax_cross_entropy_loss(logits, yb)), \
+                mut["batch_stats"]
+        (loss, bs), grads, state, finite = amp_opt.backward(
+            state, loss_fn, has_aux=True)
+        if sync_bn:
+            grads = parallel.sync_gradients(grads, "data")
+        return amp_opt.apply_gradients(state, grads, finite), bs, loss
+
+    if sync_bn:
+        mesh = parallel.data_parallel_mesh()
+        amp_opt, state, bs = build(x, y)
+        from jax.sharding import PartitionSpec as P
+        mapped = jax.shard_map(
+            lambda s, b, xb, yb: step(amp_opt, s, b, xb, yb),
+            mesh=mesh, in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P()), check_vma=False)
+        jstep = jax.jit(mapped, donate_argnums=(0, 1))
+    else:
+        amp_opt, state, bs = build(x, y)
+        jstep = jax.jit(
+            lambda s, b, xb, yb: step(amp_opt, s, b, xb, yb),
+            donate_argnums=(0, 1))
+
+    def rebind(out, args):
+        return (out[0], out[1], args[2], args[3])
+
+    dt = _timeit(jstep, (state, bs, x, y), iters, rebind=rebind)
+    return batch / dt, dt
+
+
+def _bench_dcgan(batch, iters):
+    """Config 4: DCGAN multi-model/multi-loss — two Amp bundles, three
+    scaled backwards per iteration (`examples/dcgan/main_amp.py:215-253`
+    pattern)."""
+    from apex_tpu import amp, models
+    from apex_tpu.optim import FusedAdam
+
+    # unmodified flax models driven through the auto_cast interceptor —
+    # the O1 ergonomics path (bf16 compute without touching the model)
+    policy = amp.Policy.from_opt_level("O1")
+    G = models.Generator()
+    D = models.Discriminator()
+    rng = np.random.RandomState(0)
+    z = jnp.asarray(rng.randn(batch, 1, 1, 100).astype(np.float32))
+    real = jnp.asarray(rng.rand(batch, 64, 64, 3).astype(np.float32))
+
+    gv = G.init(jax.random.PRNGKey(0), z, train=True)
+    dv = D.init(jax.random.PRNGKey(1), real, train=True)
+    ampG = amp.Amp(policy, FusedAdam(lr=2e-4, betas=(0.5, 0.999)))
+    ampD = amp.Amp(policy, FusedAdam(lr=2e-4, betas=(0.5, 0.999)),
+                   num_losses=2)
+    gstate, dstate = ampG.init(gv["params"]), ampD.init(dv["params"])
+
+    def bce(logit, target):
+        return jnp.mean(jnp.maximum(logit, 0) - logit * target
+                        + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    def step(gstate, dstate, g_bs, d_bs, z, real):
+        with amp.auto_cast(policy):
+            fake, g_mut = G.apply({"params": ampG.model_params(gstate),
+                                   "batch_stats": g_bs}, z, train=True,
+                                  mutable=["batch_stats"])
+        g_bs = g_mut["batch_stats"]
+
+        def d_real(mp):
+            with amp.auto_cast(policy):
+                out, mut = D.apply({"params": mp, "batch_stats": d_bs},
+                                   real, train=True,
+                                   mutable=["batch_stats"])
+            return bce(out, 1.0), mut["batch_stats"]
+
+        (lr_, d_bs2), gr, dstate, f1 = ampD.backward(
+            dstate, d_real, loss_id=0, has_aux=True)
+        dstate = ampD.apply_gradients(dstate, gr, f1)
+
+        def d_fake(mp):
+            with amp.auto_cast(policy):
+                out, mut = D.apply({"params": mp, "batch_stats": d_bs2},
+                                   jax.lax.stop_gradient(fake), train=True,
+                                   mutable=["batch_stats"])
+            return bce(out, 0.0), mut["batch_stats"]
+
+        (lf, d_bs3), gf, dstate, f2 = ampD.backward(
+            dstate, d_fake, loss_id=1, has_aux=True)
+        dstate = ampD.apply_gradients(dstate, gf, f2)
+
+        def g_loss(mp):
+            with amp.auto_cast(policy):
+                fake2, mut = G.apply({"params": mp, "batch_stats": g_bs},
+                                     z, train=True,
+                                     mutable=["batch_stats"])
+                out = D.apply({"params": ampD.model_params(dstate),
+                               "batch_stats": d_bs3}, fake2, train=True,
+                              mutable=["batch_stats"])[0]
+            return bce(out.astype(jnp.float32), 1.0), mut["batch_stats"]
+
+        (lg, g_bs4), gg, gstate, f3 = ampG.backward(
+            gstate, g_loss, has_aux=True)
+        gstate = ampG.apply_gradients(gstate, gg, f3)
+        return gstate, dstate, g_bs4, d_bs3, lg
+
+    # the generator/discriminator step is sub-ms on device; scan K
+    # iterations per dispatch so tunnel/host dispatch overhead (hundreds
+    # of ms through the axon remote runtime) doesn't swamp the number
+    K = 20
+
+    def scanned(gstate, dstate, g_bs, d_bs, z, real):
+        def body(carry, _):
+            gs, ds, gb, db = carry
+            gs, ds, gb, db, l = step(gs, ds, gb, db, z, real)
+            return (gs, ds, gb, db), l
+        (gs, ds, gb, db), ls = jax.lax.scan(
+            body, (gstate, dstate, g_bs, d_bs), None, length=K)
+        return gs, ds, gb, db, ls[-1]
+
+    jstep = jax.jit(scanned, donate_argnums=(0, 1, 2, 3))
+
+    def rebind(out, args):
+        return (out[0], out[1], out[2], out[3], args[4], args[5])
+
+    dt = _timeit(jstep, (gstate, dstate, gv["batch_stats"],
+                         dv["batch_stats"], z, real), iters, rebind=rebind)
+    return batch * K / dt, dt / K
+
+
+def _bench_bert(batch, seq, iters):
+    """Config 5: BERT-Large MLM step with FusedLAMB + fused LayerNorm +
+    flash attention."""
+    from apex_tpu import amp, models
+    from apex_tpu.optim import FusedLAMB
+
+    policy = amp.Policy.from_opt_level("O1")
+    enc = models.BertLarge()
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
+    variables = enc.init(jax.random.PRNGKey(0), toks[:1])
+    amp_opt = amp.Amp(policy, FusedLAMB(lr=1e-3))
+    state = amp_opt.init(variables["params"])
+
+    def step(state, toks, labels):
+        def loss_fn(mp):
+            with amp.auto_cast(policy):
+                return models.mlm_loss(enc, {"params": mp}, toks, labels)
+        loss, grads, state, finite = amp_opt.backward(state, loss_fn)
+        return amp_opt.apply_gradients(state, grads, finite), loss
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+
+    def rebind(out, args):
+        return (out[0], args[1], args[2])
+
+    dt = _timeit(jstep, (state, toks, labels), iters, rebind=rebind)
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    flops = 6.0 * n_params * batch * seq    # fwd+bwd transformer rule
+    return batch / dt, dt, flops / dt
+
+
+def run_all():
+    from apex_tpu import models, prof
+
+    on_tpu = jax.default_backend() == "tpu"
+    size = 224 if on_tpu else 64
+    iters = 10 if on_tpu else 2
+    peak = prof.device_peak_flops() or float("inf")
+    rows = []
+
+    def resnet_row(name, opt_level, batch, sync_bn=False):
+        try:
+            img_s, dt = _bench_resnet(opt_level, batch, size, iters,
+                                      sync_bn=sync_bn)
+        except Exception as e:
+            rows.append((name, "failed", "-", f"{type(e).__name__}"))
+            return
+        flops_img = models.RESNET50_FLOPS_PER_IMAGE * 3 * (size / 224) ** 2
+        mfu = img_s * flops_img / peak
+        rows.append((name, f"{img_s:.0f} img/s", f"{mfu:.1%}",
+                     f"batch {batch}"))
+
+    resnet_row("ResNet-50 fp32 (O0)", "O0", 64 if on_tpu else 8)
+    resnet_row("ResNet-50 amp O2 + FusedSGD", "O2", 256 if on_tpu else 8)
+    resnet_row("ResNet-50 DP + SyncBN (per chip)", "O2",
+               256 if on_tpu else 8, sync_bn=True)
+    try:
+        img_s, dt = _bench_dcgan(128 if on_tpu else 8, iters)
+        rows.append(("DCGAN multi-loss (G+2xD steps)",
+                     f"{img_s:.0f} img/s", "-", "batch 128"))
+    except Exception as e:
+        rows.append(("DCGAN multi-loss", "failed", "-",
+                     f"{type(e).__name__}"))
+    try:
+        b, s = (16, 512) if on_tpu else (2, 128)
+        seq_s, dt, flops_s = _bench_bert(b, s, max(iters // 2, 2))
+        rows.append((f"BERT-Large LAMB (seq {s})",
+                     f"{seq_s:.1f} seq/s", f"{flops_s / peak:.1%}",
+                     f"batch {b}"))
+    except Exception as e:
+        rows.append(("BERT-Large LAMB", "failed", "-",
+                     f"{type(e).__name__}"))
+
+    dev = getattr(jax.devices()[0], "device_kind", "?")
+    lines = [
+        "# BENCH_TABLE — BASELINE.md config table",
+        "",
+        f"Device: {dev} (single chip). MFU vs {peak/1e12:.0f} TFLOP/s "
+        f"bf16 peak.",
+        "",
+        "| Config | Throughput | MFU | Notes |",
+        "|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append("| " + " | ".join(r) + " |")
+    open("BENCH_TABLE.md", "w").write("\n".join(lines) + "\n")
+    print("\n".join(lines))
+
+
 def main():
     from apex_tpu import models, prof
 
@@ -103,4 +372,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--all" in sys.argv:
+        run_all()
+    else:
+        main()

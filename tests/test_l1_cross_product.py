@@ -1,0 +1,281 @@
+"""L1-grade trajectory cross-product harness.
+
+Mirrors the reference's strongest correctness statement
+(`tests/L1/common/run_test.sh:1-120` + `compare.py:34-46`): the SAME
+multi-step training run executed on two independent implementations must
+produce the same loss trajectory across the full option grid
+{O0,O1,O2,O3} x {loss_scale: dynamic, static-128, none} x
+{keep_batchnorm_fp32: on, off}, including runs with mid-trajectory
+overflow injections.
+
+The reference compares {CUDA-extension, python-only} builds bitwise. The
+analogue here is {fused path: Pallas kernels + FusedSGD arena kernel}
+vs {oracle path: pure-jnp reference ops + a jnp SGD replica}. Floating
+trajectories compare at dtype-appropriate tolerance (reduction orders
+legitimately differ between a padded Pallas block and a plain jnp
+reduction); everything *decision-shaped* — step counts, skip decisions,
+loss-scale schedule values — must agree BITWISE. Determinism and
+checkpoint/resume of a single path are asserted bitwise.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import flax.linen as nn
+
+from apex_tpu import amp, ops
+from apex_tpu.optim import FusedSGD
+from apex_tpu.ops.layer_norm import layer_norm_reference
+from apex_tpu.ops.xentropy import softmax_cross_entropy_reference
+
+BATCH, HW, CH, HIDDEN, CLASSES = 8, 8, 8, 32, 10
+STEPS = 6
+LR, MOMENTUM = 0.05, 0.9
+
+
+# --- the two implementations -------------------------------------------------
+
+class Net(nn.Module):
+    """Conv + BN + Dense + LayerNorm + Dense — every knob in the grid has
+    something to act on (BN for keep_batchnorm_fp32, LayerNorm + CE for
+    the fused-op surface)."""
+    fused: bool
+    dtype: object = None
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        x = nn.Conv(CH, (3, 3), dtype=self.dtype, name="conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="bn")(x)
+        x = nn.relu(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(HIDDEN, dtype=self.dtype, name="fc1")(x)
+        w = self.param("ln_scale", nn.initializers.ones, (HIDDEN,),
+                       jnp.float32)
+        b = self.param("ln_bias", nn.initializers.zeros, (HIDDEN,),
+                       jnp.float32)
+        if self.fused:
+            x = ops.fused_layer_norm_affine(x, w, b, 1e-5)
+        else:
+            x = layer_norm_reference(x, w, b, 1e-5)
+        x = nn.Dense(CLASSES, dtype=self.dtype, name="fc2")(x)
+        return x
+
+
+class RefSGD:
+    """Plain-jnp replica of the FusedSGD math (momentum buffer
+    initialized to the raw first gradient, optional wd placement —
+    `multi_tensor_sgd_kernel.cu:30-180` semantics) with the fused
+    optimizer's (init/step) protocol."""
+
+    def __init__(self, lr, momentum):
+        self.lr, self.momentum = lr, momentum
+
+    def init(self, params):
+        return {"count": jnp.int32(0),
+                "m": jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)}
+
+    def step(self, grads, state, params):
+        count = state["count"] + 1
+        first = count == 1
+
+        def upd(p, g, m):
+            g32 = g.astype(jnp.float32)
+            m2 = jnp.where(first, g32,
+                           self.momentum * m + g32)
+            p2 = p.astype(jnp.float32) - self.lr * m2
+            return p2.astype(p.dtype), m2
+
+        flat = jax.tree_util.tree_map(upd, params, grads, state["m"])
+        new_p = jax.tree_util.tree_map(lambda t: t[0], flat,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree_util.tree_map(lambda t: t[1], flat,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        return new_p, {"count": count, "m": new_m}
+
+
+def _data(poison_steps=()):
+    rng = np.random.RandomState(0)
+    xs = rng.randn(STEPS, BATCH, HW, HW, 3).astype(np.float32)
+    for s in poison_steps:
+        xs[s, 0, 0, 0, 0] = np.inf
+    ys = rng.randint(0, CLASSES, (STEPS, BATCH))
+    return jnp.asarray(xs), jnp.asarray(ys, jnp.int32)
+
+
+def _run(policy, fused: bool, poison_steps=()):
+    """Train STEPS steps; return (losses, final params, scaler history,
+    step count)."""
+    model = Net(fused=fused, dtype=policy.compute_dtype)
+    xs, ys = _data(poison_steps)
+    variables = model.init(jax.random.PRNGKey(0), xs[0], train=True)
+    params, batch_stats = variables["params"], variables.get(
+        "batch_stats", {})
+
+    tx = FusedSGD(lr=LR, momentum=MOMENTUM) if fused else \
+        RefSGD(LR, MOMENTUM)
+    amp_opt = amp.Amp(policy, tx)
+    state = amp_opt.init(params)
+
+    ce = (ops.softmax_cross_entropy_loss if fused
+          else softmax_cross_entropy_reference)
+
+    def step(state, batch_stats, xb, yb):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": batch_stats}, xb,
+                train=True, mutable=["batch_stats"])
+            return jnp.mean(ce(logits, yb)), mut["batch_stats"]
+
+        (loss, new_bs), grads, state2, finite = amp_opt.backward(
+            state, loss_fn, has_aux=True)
+        state2 = amp_opt.apply_gradients(state2, grads, finite)
+        return state2, new_bs, loss, finite
+
+    jstep = jax.jit(step)
+    losses, scales, finites = [], [], []
+    for i in range(STEPS):
+        state, batch_stats, loss, finite = jstep(
+            state, batch_stats, xs[i], ys[i])
+        losses.append(float(loss))
+        finites.append(bool(finite) if isinstance(finite, bool)
+                       else bool(np.asarray(finite)))
+        s = state.scalers[0]
+        scales.append(None if s is None else float(s.loss_scale))
+    return losses, state, scales, finites
+
+
+# --- the grid ----------------------------------------------------------------
+
+OPT_LEVELS = ["O0", "O1", "O2", "O3"]
+LOSS_SCALES = [("dynamic", "dynamic"), ("static128", 128.0), ("none", None)]
+KEEP_BN = [True, False]
+
+
+def _make_policy(opt_level, loss_scale, keep_bn):
+    try:
+        return amp.Policy.from_opt_level(
+            opt_level, loss_scale=loss_scale,
+            keep_batchnorm_fp32=keep_bn)
+    except ValueError:
+        return None   # combination rejected by validation (like the
+                      # reference skipping inapplicable combos)
+
+
+GRID = [(ol, sn, sv, kb)
+        for (ol, (sn, sv), kb) in itertools.product(
+            OPT_LEVELS, LOSS_SCALES, KEEP_BN)]
+
+
+class TestCrossProduct:
+    @pytest.mark.parametrize(
+        "opt_level,scale_name,scale_val,keep_bn", GRID,
+        ids=[f"{ol}-{sn}-bn{int(kb)}" for ol, sn, sv, kb in GRID])
+    def test_fused_matches_oracle_trajectory(self, opt_level, scale_name,
+                                             scale_val, keep_bn):
+        policy = _make_policy(opt_level, scale_val, keep_bn)
+        if policy is None:
+            pytest.skip("combination rejected by Policy validation")
+
+        l_fused, st_fused, sc_fused, f_fused = _run(policy, fused=True)
+        l_ref, st_ref, sc_ref, f_ref = _run(policy, fused=False)
+
+        # decision-shaped state: BITWISE
+        assert f_fused == f_ref, "skip decisions diverged"
+        assert sc_fused == sc_ref, "loss-scale schedule diverged"
+        assert int(st_fused.step) == int(st_ref.step)
+
+        # float trajectories: dtype-appropriate tolerance
+        tol = 1e-5 if policy.compute_dtype is None else 2e-2
+        np.testing.assert_allclose(l_fused, l_ref, rtol=tol, atol=tol,
+                                   err_msg="loss trajectories diverged")
+        fa = jax.tree_util.tree_leaves_with_path(st_fused.params)
+        fb = {jax.tree_util.keystr(p): l for p, l in
+              jax.tree_util.tree_leaves_with_path(st_ref.params)}
+        for path, a in fa:
+            key = jax.tree_util.keystr(path)
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(fb[key], np.float32),
+                rtol=tol, atol=tol, err_msg=f"params diverged at {key}")
+
+    def test_deterministic_rerun_bitwise(self):
+        """The same path run twice is bitwise identical — the property
+        that makes the reference's build-to-build compare meaningful."""
+        policy = amp.Policy.from_opt_level("O2")
+        l1, st1, _, _ = _run(policy, fused=True)
+        l2, st2, _, _ = _run(policy, fused=True)
+        assert l1 == l2
+        for a, b in zip(jax.tree_util.tree_leaves(st1.params),
+                        jax.tree_util.tree_leaves(st2.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestOverflowInjection:
+    """`tests/L0/run_amp/test_fused_sgd.py` overflow pattern: poison
+    chosen iterations, assert skip semantics and post-recovery agreement."""
+
+    def test_fp16_dynamic_overflow_skip_both_paths(self):
+        policy = amp.Policy.from_opt_level("O2", half_dtype=jnp.float16,
+                                           loss_scale="dynamic")
+        poison = (2, 4)
+        l_f, st_f, sc_f, fin_f = _run(policy, fused=True,
+                                      poison_steps=poison)
+        l_r, st_r, sc_r, fin_r = _run(policy, fused=False,
+                                      poison_steps=poison)
+
+        # both paths must skip exactly the poisoned steps
+        assert fin_f == fin_r
+        assert [i for i, f in enumerate(fin_f) if not f] == list(poison)
+        # step counter advanced only on clean steps — bitwise
+        assert int(st_f.step) == int(st_r.step) == STEPS - len(poison)
+        # scale halved at each overflow, schedule identical — bitwise
+        assert sc_f == sc_r
+        assert sc_f[2] == sc_f[1] / 2 and sc_f[4] == sc_f[3] / 2
+        # params agree after recovery
+        for a, b in zip(jax.tree_util.tree_leaves(st_f.params),
+                        jax.tree_util.tree_leaves(st_r.params)):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=2e-2, atol=2e-2)
+
+    def test_checkpoint_resume_bitwise(self):
+        """Mid-trajectory save/restore continues bitwise identically to
+        the uninterrupted run (README 'Checkpointing' contract)."""
+        policy = amp.Policy.from_opt_level("O2", half_dtype=jnp.float16)
+        model = Net(fused=True, dtype=policy.compute_dtype)
+        xs, ys = _data()
+        variables = model.init(jax.random.PRNGKey(0), xs[0], train=True)
+        amp_opt = amp.Amp(policy, FusedSGD(lr=LR, momentum=MOMENTUM))
+        state = amp_opt.init(variables["params"])
+        bs = variables.get("batch_stats", {})
+
+        def step(state, bs, xb, yb):
+            def loss_fn(mp):
+                logits, mut = model.apply(
+                    {"params": mp, "batch_stats": bs}, xb, train=True,
+                    mutable=["batch_stats"])
+                return jnp.mean(ops.softmax_cross_entropy_loss(
+                    logits, yb)), mut["batch_stats"]
+            (loss, bs2), grads, st, fin = amp_opt.backward(
+                state, loss_fn, has_aux=True)
+            return amp_opt.apply_gradients(st, grads, fin), bs2
+
+        jstep = jax.jit(step)
+        for i in range(3):
+            state, bs = jstep(state, bs, xs[i], ys[i])
+        # round-trip through host numpy (what a checkpointer does)
+        saved = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(np.asarray(x)), (state, bs),
+            is_leaf=lambda x: x is None)
+        restored_state, restored_bs = saved
+        for i in range(3, STEPS):
+            state, bs = jstep(state, bs, xs[i], ys[i])
+            restored_state, restored_bs = jstep(
+                restored_state, restored_bs, xs[i], ys[i])
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(restored_state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

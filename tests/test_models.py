@@ -90,3 +90,35 @@ class TestGraftEntry:
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         mod.dryrun_multichip(8)
+
+
+class TestStemConv:
+    def test_s2d_matches_plain_conv(self):
+        from apex_tpu.models.resnet import _StemConv
+        rng = np.random.RandomState(20)
+        x = jnp.asarray(rng.randn(2, 32, 32, 3).astype(np.float32))
+        s2d = _StemConv(16, space_to_depth=True)
+        ref = _StemConv(16, space_to_depth=False)
+        v = s2d.init(jax.random.PRNGKey(0), x)
+        np.testing.assert_allclose(
+            np.asarray(s2d.apply(v, x)), np.asarray(ref.apply(v, x)),
+            atol=2e-5)
+        g1 = jax.grad(lambda v_: jnp.sum(jnp.sin(s2d.apply(v_, x))))(v)
+        g0 = jax.grad(lambda v_: jnp.sum(jnp.sin(ref.apply(v_, x))))(v)
+        np.testing.assert_allclose(
+            np.asarray(g1["params"]["kernel"]),
+            np.asarray(g0["params"]["kernel"]), atol=2e-4)
+
+    def test_stem_half_under_auto_cast(self):
+        """The custom stem must be on the O1 whitelist like nn.Conv —
+        auto_cast runs it in the half dtype."""
+        from apex_tpu import amp
+        from apex_tpu.models.resnet import _StemConv
+        x = jnp.ones((1, 8, 8, 3), jnp.float32)
+        m = _StemConv(4)
+        v = m.init(jax.random.PRNGKey(0), x)
+        policy = amp.Policy.from_opt_level("O1")
+        with amp.auto_cast(policy):
+            y = m.apply(v, x)
+        assert y.dtype == jnp.bfloat16
+        assert m.apply(v, x).dtype == jnp.float32  # outside: fp32

@@ -184,3 +184,32 @@ def test_profile_step_cpu():
     assert isinstance(rep.table(), str)
     # CPU: no device plane → mfu computes to 0 (peak unknown)
     assert rep.mfu() == 0.0
+
+
+_REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parents[1])
+
+
+def test_cli_on_synthetic_trace(tmp_path):
+    """`python -m apex_tpu.prof <logdir>` — the pyprof.parse/prof CLI
+    equivalent — renders the op table from a trace dir."""
+    pytest.importorskip("tensorflow.tsl.profiler.protobuf.xplane_pb2")
+    import subprocess, sys
+    path = _build_xspace(tmp_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.prof", str(tmp_path)],
+        capture_output=True, text=True, cwd=_REPO_ROOT)
+    assert r.returncode == 0, r.stderr
+    assert "convolution" in r.stdout
+    r2 = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.prof", str(tmp_path), "--csv"],
+        capture_output=True, text=True, cwd=_REPO_ROOT)
+    assert r2.returncode == 0
+    assert r2.stdout.startswith("name,category,occurrences,total_us")
+
+
+def test_cli_empty_dir(tmp_path):
+    import subprocess, sys
+    r = subprocess.run(
+        [sys.executable, "-m", "apex_tpu.prof", str(tmp_path)],
+        capture_output=True, text=True, cwd=_REPO_ROOT)
+    assert r.returncode == 1
