@@ -28,6 +28,7 @@ from apex_tpu.amp.api import (
     promote_function,
 )
 from apex_tpu.amp.interceptor import auto_cast, make_interceptor
+from apex_tpu.amp.opt import OptimWrapper
 from apex_tpu.amp.lists import (
     register_half_op,
     register_float_op,
@@ -43,7 +44,7 @@ __all__ = [
     "unscale_grads_with_stashed", "value_and_scaled_grad",
     "Amp", "AmpState", "initialize",
     "half_function", "float_function", "promote_function",
-    "auto_cast", "make_interceptor",
+    "auto_cast", "make_interceptor", "OptimWrapper",
     "register_half_op", "register_float_op", "register_promote_op",
     "register_half_module", "register_float_module",
 ]

@@ -1,0 +1,104 @@
+"""Legacy multi-loss optimizer wrapper — ``apex.amp.opt.OptimWrapper``.
+
+The reference's old-API wrapper (`apex/amp/opt.py:9-103`) gives one
+optimizer N independent dynamic loss scalers, a ``scale_loss`` context
+per loss, and skip bookkeeping; grads for earlier losses are stashed so
+each loss unscales at its own scale (`opt.py:25-52`). Functionally that
+is exactly :class:`apex_tpu.amp.Amp` with ``num_losses=N`` — this shim
+keeps the legacy *shape* of the API for users porting old scripts: a
+wrapper object owning per-loss scaler states and an explicit
+accumulate/step cycle.
+
+Deprecated in the reference too; prefer ``Amp``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import (
+    LossScaleConfig, loss_scale_init, loss_scale_update, scale_loss,
+    unscale_grads, unscale_grads_with_stashed,
+)
+from apex_tpu.utils import tree_select
+
+
+class OptimWrapper:
+    """Per-loss dynamic scalers around one optimizer (legacy API).
+
+    One iteration with two losses::
+
+        wrapper = OptimWrapper(tx, num_loss=2)
+        wstate = wrapper.init(params)
+        out0, acc, wstate = wrapper.backward(wstate, params, loss0, 0,
+                                             None)
+        out1, acc, wstate = wrapper.backward(wstate, params, loss1, 1,
+                                             acc)
+        params, wstate = wrapper.step(wstate, acc, params)
+
+    Each loss unscales at its own (independent, dynamic) scale and
+    accumulates into the fp32 stash (`opt.py:25-52`); ``step`` skips the
+    update if ANY loss of the round overflowed (`opt.py:58-77`).
+    """
+
+    def __init__(self, optimizer, num_loss: int = 1,
+                 cfg: LossScaleConfig = None):
+        self.tx = optimizer
+        self.num_loss = num_loss
+        self.cfg = cfg or LossScaleConfig(dynamic=True)
+
+    def init(self, params):
+        return {
+            "scalers": tuple(loss_scale_init(self.cfg)
+                             for _ in range(self.num_loss)),
+            "finite": jnp.bool_(True),
+            "inner": self.tx.init(params),
+        }
+
+    def loss_scale(self, wstate):
+        """Current per-loss scales (`opt.py:95-103`)."""
+        return [float(s.loss_scale) for s in wstate["scalers"]]
+
+    def backward(self, wstate, params, loss_fn: Callable, loss_idx: int,
+                 stashed, *args, **kwargs):
+        """Scaled backward for ``loss_idx``: grads of
+        ``loss_fn(params, ...)`` unscaled at this loss's scale,
+        accumulated onto ``stashed`` fp32 grads (None for the first
+        loss of the round). Returns (out, acc_grads, wstate')."""
+        sstate = wstate["scalers"][loss_idx]
+
+        def scaled(p):
+            out = loss_fn(p, *args, **kwargs)
+            loss = out[0] if isinstance(out, tuple) else out
+            return scale_loss(loss, sstate), out
+
+        grads, out = jax.grad(scaled, has_aux=True)(params)
+        if stashed is None:
+            acc, finite = unscale_grads(grads, sstate)
+        else:
+            acc, finite = unscale_grads_with_stashed(grads, stashed,
+                                                     sstate)
+        scalers = tuple(
+            loss_scale_update(s, finite, self.cfg) if i == loss_idx else s
+            for i, s in enumerate(wstate["scalers"]))
+        wstate = dict(wstate, scalers=scalers,
+                      finite=jnp.logical_and(wstate["finite"], finite))
+        return out, acc, wstate
+
+    def step(self, wstate, grads, params):
+        """Inner optimizer step, skipped entirely if any loss overflowed
+        this round; the skip flag resets for the next round."""
+        if hasattr(self.tx, "step") and callable(self.tx.step):
+            new_p, inner = self.tx.step(grads, wstate["inner"], params)
+        else:
+            updates, inner = self.tx.update(grads, wstate["inner"],
+                                            params)
+            new_p = jax.tree_util.tree_map(
+                lambda p, u: p + u.astype(p.dtype), params, updates)
+        fin = wstate["finite"]
+        new_p = tree_select(fin, new_p, params)
+        inner = tree_select(fin, inner, wstate["inner"])
+        return new_p, dict(wstate, inner=inner, finite=jnp.bool_(True))

@@ -170,6 +170,81 @@ def per_tensor_maxnorm_ranges(buf, offsets, sizes):
     return jnp.stack(maxs)
 
 
+def per_tensor_sq_shard(buf, offsets, sizes, shard_start,
+                        block: int = 64 * 1024):
+    """Per-tensor sums of squares over ONE shard of the arena — the
+    sharded-norm building block of DistributedFusedLAMB
+    (`distributed_fused_lamb.py:453-472`), scatter-free.
+
+    ``buf`` is this device's contiguous shard; ``shard_start`` its
+    (traced) global offset; tensor ``offsets``/``sizes`` are the static
+    arena layout. Each tensor's shard-local overlap decomposes into
+    whole blocks (summed from one per-block partial-sums vector) plus at
+    most two masked boundary blocks, read via ``dynamic_slice`` — no
+    scatter, no gather over the buffer, and no cumsum-difference
+    cancellation (every element is added exactly once in fp32).
+    Returns (num_tensors,) partial sq-sums; ``psum`` them across shards
+    for the exact global per-tensor norms.
+    """
+    s = buf.shape[0]
+    nb = -(-s // block)
+    sq = jnp.square(buf.astype(jnp.float32))
+    sqp = jnp.pad(sq, (0, nb * block - s))
+    bsums = jnp.sum(sqp.reshape(nb, block), axis=1)
+    ib = jax.lax.iota(jnp.int32, nb)
+    lane = jax.lax.iota(jnp.int32, block)
+    start = jnp.asarray(shard_start, jnp.int32)
+
+    def one(off, sz):
+        lo = jnp.clip(off - start, 0, s)
+        hi = jnp.clip(off + sz - start, 0, s)
+        bl = (lo + block - 1) // block      # first whole block
+        bh = hi // block                    # one past last whole block
+        interior = jnp.sum(jnp.where((ib >= bl) & (ib < bh), bsums, 0.0))
+        # left partial: [lo, left_end) inside block lo//block
+        left_end = jnp.minimum(bl * block, hi)
+        lblk = jax.lax.dynamic_slice_in_dim(sqp, (lo // block) * block,
+                                            block)
+        lpos = (lo // block) * block + lane
+        left = jnp.sum(jnp.where((lpos >= lo) & (lpos < left_end),
+                                 lblk, 0.0))
+        # right partial: [max(bh*block, left_end), hi)
+        rstart = jnp.maximum(bh * block, left_end)
+        rblk = jax.lax.dynamic_slice_in_dim(sqp, bh * block, block)
+        rpos = bh * block + lane
+        right = jnp.sum(jnp.where((rpos >= rstart) & (rpos < hi),
+                                  rblk, 0.0))
+        return interior + left + right
+
+    return jnp.stack([one(off, sz) for off, sz in zip(offsets, sizes)])
+
+
+def spread_per_tensor_shard(values, offsets, sizes, shard_start, per,
+                            fill=0.0):
+    """Shard-local inverse of :func:`per_tensor_sq_shard`: broadcast a
+    (num_tensors,) vector over this shard's slice of the arena layout —
+    ``values[segment_ids]`` without the serialized per-element gather.
+
+    Each (static-size) tensor writes its shard overlap with one
+    ``dynamic_update_slice`` of a windowed read-modify-write: the window
+    of length ``min(size, per)`` always covers the overlap, and the
+    ``where`` keeps existing content at window positions outside the
+    tensor's span, so clamping at shard edges cannot clobber neighbours.
+    One pass of reads+writes over the shard in total.
+    """
+    start = jnp.asarray(shard_start, jnp.int32)
+    out = jnp.full((per,), fill, values.dtype)
+    for j, (off, sz) in enumerate(zip(offsets, sizes)):
+        ln = min(sz, per)
+        cl = jnp.clip(off - start, 0, per - ln)
+        cur = jax.lax.dynamic_slice_in_dim(out, cl, ln)
+        gpos = cl + start + jax.lax.iota(jnp.int32, ln)
+        valid = (gpos >= off) & (gpos < off + sz)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, jnp.where(valid, values[j], cur), cl, axis=0)
+    return out
+
+
 def spread_per_tensor(values, offsets, padded, total, fill=0.0):
     """Broadcast a (num_tensors,) vector back over the arena layout —
     the inverse gather ``values[segment_ids]`` without the 100M-index

@@ -20,9 +20,12 @@ from apex_tpu.optim.distributed import (
     DistributedFusedLAMB,
     ShardedOptState,
 )
+# deprecated contrib surface (externally-scaled grads), kept for parity
+from apex_tpu.optim import legacy
 
 __all__ = [
     "FusedAdagrad", "FusedAdam", "FusedLAMB", "FusedNovoGrad",
     "FusedOptimizer", "FusedOptState", "FusedSGD",
     "DistributedFusedAdam", "DistributedFusedLAMB", "ShardedOptState",
+    "legacy",
 ]

@@ -147,17 +147,6 @@ class DistributedFusedAdam(FusedOptimizer):
                                                    world)
         return out
 
-    def _shard_segment_ids(self, spec, part, world: int):
-        """This shard's slice of the arena position→tensor map (-1 in
-        padding) — arena.segment_ids_device padded and sliced."""
-        ids = arena.segment_ids_device(spec, part.dtype)
-        total = _padded_len(part.buffer_len, world)
-        per = total // world
-        ids = jnp.pad(ids, (0, total - part.buffer_len),
-                      constant_values=-1)
-        rank = _my_rank(self.axis_name)
-        return jax.lax.dynamic_slice_in_dim(ids, rank * per, per)
-
     # -- state ---------------------------------------------------------------
 
     def init(self, params) -> ShardedOptState:
@@ -252,18 +241,19 @@ class DistributedFusedLAMB(DistributedFusedAdam):
                          param_gather_dtype=param_gather_dtype)
         self.use_nvlamb = use_nvlamb
 
-    def _per_tensor_sq(self, buf, seg, n):
-        sq = jnp.square(buf.astype(jnp.float32))
-        sq = jnp.where(seg >= 0, sq, 0.0)
-        out = jax.ops.segment_sum(sq, jnp.maximum(seg, 0), num_segments=n)
+    def _per_tensor_sq(self, buf, part, world):
+        """Exact global per-tensor sq-sums from this shard's slice: local
+        block-decomposed partials (scatter-free — TPU serializes
+        segment_sum's scatter) psum'd across the axis."""
+        per = _padded_len(part.buffer_len, world) // world
+        start = _my_rank(self.axis_name) * per
+        out = MT.per_tensor_sq_shard(buf, part.offsets, part.sizes, start)
         for a in _axes(self.axis_name):
             out = jax.lax.psum(out, a)
         return out
 
     def _shard_update(self, spec, part, g, slots, count, lr, clip, world):
         dt = part.dtype
-        n = len(part.sizes)
-        seg = self._shard_segment_ids(spec, part, world)
         master = slots["master"][dt]
 
         u, m2, v2 = K.lamb_stage1(
@@ -273,13 +263,19 @@ class DistributedFusedLAMB(DistributedFusedAdam):
             bias_correction=self.bias_correction,
             adam_w_mode=self.adam_w_mode, clip_scale=clip)
 
-        p_norms = jnp.sqrt(self._per_tensor_sq(master, seg, n))
-        u_norms = jnp.sqrt(self._per_tensor_sq(u, seg, n))
+        p_norms = jnp.sqrt(self._per_tensor_sq(master, part, world))
+        u_norms = jnp.sqrt(self._per_tensor_sq(u, part, world))
         ratio = jnp.where((p_norms > 0) & (u_norms > 0),
                           p_norms / u_norms, 1.0)
         if not self.use_nvlamb and self.weight_decay == 0.0:
             ratio = jnp.ones_like(ratio)
-        ratio_pos = jnp.where(seg >= 0, ratio[jnp.maximum(seg, 0)], 0.0)
+        # scatter-free spread over the shard (the traced segment-id
+        # gather this replaces serializes on TPU, like the segment_sum
+        # the norms above avoid)
+        per = _padded_len(part.buffer_len, world) // world
+        start = _my_rank(self.axis_name) * per
+        ratio_pos = MT.spread_per_tensor_shard(
+            ratio, part.offsets, part.sizes, start, per)
         p_shard = K.lamb_stage2(master, u, ratio_pos, lr=lr)
 
         if self.param_gather_dtype is not None:

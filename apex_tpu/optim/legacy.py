@@ -1,0 +1,129 @@
+"""Legacy contrib optimizer surface — externally-scaled gradients.
+
+The reference's deprecated ``apex.contrib.optimizers`` classes
+(`fused_adam.py:64-206`, `fused_sgd.py`, `fused_lamb.py`) take
+still-scaled gradients directly in ``step(grads=..., scale=...,
+output_params=...)`` and unscale INSIDE the kernel, optionally writing a
+reduced-precision copy of the updated params in the same pass — the API
+their ``FP16_Optimizer`` (`fp16_optimizer.py:4-243`) drives with
+flattened grads.
+
+Here the same capability rides the modern arena kernels, which already
+fuse ``grad_scale`` (the 1/scale) and ``param_copy_dtype`` (the
+``output_params`` copy-out): these classes only adapt the legacy call
+shape. Deprecated; prefer ``apex_tpu.optim.Fused*`` + ``amp.Amp``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import arena
+from apex_tpu.ops import optim_kernels as K
+from apex_tpu.optim.fused import FusedOptState, Scalar
+
+
+class _LegacyFused:
+    """Shared shape of the deprecated surface: ``step(grads, state,
+    params, scale=..., output_dtype=...)`` with in-kernel unscale."""
+
+    def init(self, params) -> FusedOptState:
+        spec = arena.plan(params)
+        slots = {name: arena.zeros(spec, dtype=jnp.float32)
+                 for name in self.slot_names}
+        return FusedOptState(count=jnp.int32(0), slots=slots)
+
+    def step(self, grads, state: FusedOptState, params, *,
+             scale: float = 1.0, output_dtype=None):
+        """One update from externally-scaled grads.
+
+        ``scale`` divides the gradients inside the kernel
+        (`fused_adam.py:76-78`: "factor to divide gradient tensor values
+        by before applying to weights"). With ``output_dtype`` set, a
+        reduced-precision copy of the new params is produced in the same
+        pass (``output_params``) and returned as a third element.
+        """
+        spec = arena.plan(params)
+        p_bufs = arena.flatten(params, spec)
+        g_bufs = arena.flatten(grads, spec, cast=jnp.float32)
+        count = state.count + 1
+        lr = self.lr(count) if callable(self.lr) else self.lr
+        inv = 1.0 / scale
+
+        new_p, new_slots = {}, {n: {} for n in self.slot_names}
+        copies = {}
+        for part in spec.partitions:
+            dt = part.dtype
+            slots = {n: state.slots[n][dt] for n in self.slot_names}
+            out = self._kernel(p_bufs[dt], g_bufs[dt], slots, count, lr,
+                               inv, output_dtype)
+            new_p[dt] = out[0]
+            for n, v in zip(self.slot_names, out[1:1 + len(
+                    self.slot_names)]):
+                new_slots[n][dt] = v
+            if output_dtype is not None:
+                copies[dt] = out[-1]
+        params_out = arena.unflatten(new_p, spec)
+        st = FusedOptState(count=count, slots=new_slots)
+        if output_dtype is None:
+            return params_out, st
+        # the copy buffers already carry output_dtype; unflatten only
+        # reshapes per-leaf
+        return params_out, st, arena.unflatten(copies, spec)
+
+
+class FusedAdam(_LegacyFused):
+    """Deprecated contrib FusedAdam (`contrib/optimizers/fused_adam.py:
+    64-206`): Adam/AdamW with in-kernel unscale + optional fp16 param
+    copy-out."""
+
+    slot_names = ("m", "v")
+
+    def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adam_w_mode=True,
+                 bias_correction=True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+
+    def _kernel(self, p, g, slots, count, lr, inv, output_dtype):
+        return K.adam_update(
+            p, g, slots["m"], slots["v"], lr=lr, beta1=self.beta1,
+            beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay, step=count,
+            adam_w_mode=self.adam_w_mode,
+            bias_correction=self.bias_correction, grad_scale=inv,
+            param_copy_dtype=output_dtype)
+
+
+class FusedSGD(_LegacyFused):
+    """Deprecated contrib FusedSGD (`contrib/optimizers/fused_sgd.py`):
+    momentum SGD whose kernel unscales and emits the model copy — the
+    ``materialize_master_grads`` interop path."""
+
+    slot_names = ("m",)
+
+    def __init__(self, lr: Scalar = 1e-3, momentum=0.0, dampening=0.0,
+                 weight_decay=0.0, nesterov=False,
+                 wd_after_momentum=False):
+        self.lr = lr
+        self.momentum = momentum
+        self.dampening = dampening
+        self.weight_decay = weight_decay
+        self.nesterov = nesterov
+        self.wd_after_momentum = wd_after_momentum
+
+    def _kernel(self, p, g, slots, count, lr, inv, output_dtype):
+        first = (count == 1) if self.momentum > 0 else False
+        return K.sgd_update(
+            p, g, slots["m"], lr=lr, momentum=self.momentum,
+            dampening=self.dampening, weight_decay=self.weight_decay,
+            nesterov=self.nesterov, first_run=first,
+            wd_after_momentum=self.wd_after_momentum, grad_scale=inv,
+            param_copy_dtype=output_dtype)

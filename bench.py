@@ -92,14 +92,19 @@ def _timeit(jstep, args, iters, warmup=3, rebind=None):
 
 def _bench_resnet(opt_level, batch, size, iters, sync_bn=False):
     """Configs 1-3: ResNet-50 under a preset, optionally with SyncBN over
-    a (1-device here, N on a pod) data mesh."""
+    a (1-device here, N on a pod) data mesh. The plain (non-SyncBN)
+    configs delegate to _measure — one implementation of the ResNet step
+    for both the headline metric and the table."""
     from apex_tpu import amp, models, ops, parallel
     from apex_tpu.optim import FusedSGD
 
+    if not sync_bn:
+        img_s, _loss = _measure(batch, size, iters, opt_level)
+        return img_s, batch / img_s
+
     policy = amp.Policy.from_opt_level(opt_level)
-    bn_axis = "data" if sync_bn else None
     model = models.ResNet50(num_classes=1000, dtype=policy.compute_dtype,
-                            bn_axis_name=bn_axis)
+                            bn_axis_name="data")
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, size, size, 3).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
@@ -119,24 +124,17 @@ def _bench_resnet(opt_level, batch, size, iters, sync_bn=False):
                 mut["batch_stats"]
         (loss, bs), grads, state, finite = amp_opt.backward(
             state, loss_fn, has_aux=True)
-        if sync_bn:
-            grads = parallel.sync_gradients(grads, "data")
+        grads = parallel.sync_gradients(grads, "data")
         return amp_opt.apply_gradients(state, grads, finite), bs, loss
 
-    if sync_bn:
-        mesh = parallel.data_parallel_mesh()
-        amp_opt, state, bs = build(x, y)
-        from jax.sharding import PartitionSpec as P
-        mapped = jax.shard_map(
-            lambda s, b, xb, yb: step(amp_opt, s, b, xb, yb),
-            mesh=mesh, in_specs=(P(), P(), P("data"), P("data")),
-            out_specs=(P(), P(), P()), check_vma=False)
-        jstep = jax.jit(mapped, donate_argnums=(0, 1))
-    else:
-        amp_opt, state, bs = build(x, y)
-        jstep = jax.jit(
-            lambda s, b, xb, yb: step(amp_opt, s, b, xb, yb),
-            donate_argnums=(0, 1))
+    mesh = parallel.data_parallel_mesh()
+    amp_opt, state, bs = build(x, y)
+    from jax.sharding import PartitionSpec as P
+    mapped = jax.shard_map(
+        lambda s, b, xb, yb: step(amp_opt, s, b, xb, yb),
+        mesh=mesh, in_specs=(P(), P(), P("data"), P("data")),
+        out_specs=(P(), P(), P()), check_vma=False)
+    jstep = jax.jit(mapped, donate_argnums=(0, 1))
 
     def rebind(out, args):
         return (out[0], out[1], args[2], args[3])
@@ -300,9 +298,10 @@ def run_all():
     resnet_row("ResNet-50 DP + SyncBN (per chip)", "O2",
                256 if on_tpu else 8, sync_bn=True)
     try:
-        img_s, dt = _bench_dcgan(128 if on_tpu else 8, iters)
+        dcgan_batch = 128 if on_tpu else 8
+        img_s, dt = _bench_dcgan(dcgan_batch, iters)
         rows.append(("DCGAN multi-loss (G+2xD steps)",
-                     f"{img_s:.0f} img/s", "-", "batch 128"))
+                     f"{img_s:.0f} img/s", "-", f"batch {dcgan_batch}"))
     except Exception as e:
         rows.append(("DCGAN multi-loss", "failed", "-",
                      f"{type(e).__name__}"))

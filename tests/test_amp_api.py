@@ -370,3 +370,88 @@ class TestGradAccumulation:
             body, (state, zeros, jnp.bool_(True)), x)
         stepped = amp_opt.apply_gradients(st, acc, fin)
         assert int(stepped.step) == 1
+
+
+class TestLegacySurfaces:
+    """Deprecated-API shims: amp.opt.OptimWrapper (`apex/amp/opt.py:9-103`)
+    and the contrib externally-scaled-grads optimizers
+    (`apex/contrib/optimizers/fused_adam.py:64-206`)."""
+
+    def test_optim_wrapper_two_losses(self):
+        from apex_tpu.optim import FusedSGD
+        w = amp.OptimWrapper(FusedSGD(lr=0.1), num_loss=2)
+        params = {"w": jnp.arange(8.0) / 8.0}
+        ws = w.init(params)
+        x = jnp.arange(8.0)
+
+        def l0(p):
+            return jnp.sum(jnp.square(p["w"] * x))
+
+        def l1(p):
+            return jnp.sum(jnp.abs(p["w"]))
+
+        out0, acc, ws = w.backward(ws, params, l0, 0, None)
+        out1, acc, ws = w.backward(ws, params, l1, 1, acc)
+        new_p, ws = w.step(ws, acc, params)
+
+        ref = jax.grad(lambda p: l0(p) + l1(p))(params)
+        np.testing.assert_allclose(
+            np.asarray(new_p["w"]),
+            np.asarray(params["w"] - 0.1 * ref["w"]), rtol=1e-5,
+            atol=1e-7)
+        assert len(w.loss_scale(ws)) == 2
+
+    def test_optim_wrapper_overflow_skips(self):
+        from apex_tpu.optim import FusedSGD
+        w = amp.OptimWrapper(FusedSGD(lr=0.1), num_loss=2)
+        params = {"w": jnp.ones(4)}
+        ws = w.init(params)
+
+        def good(p):
+            return jnp.sum(p["w"])
+
+        def bad(p):
+            return jnp.sum(p["w"]) * jnp.float32(jnp.inf)
+
+        _, acc, ws = w.backward(ws, params, good, 0, None)
+        s1_before = float(ws["scalers"][1].loss_scale)
+        _, acc, ws = w.backward(ws, params, bad, 1, acc)
+        new_p, ws = w.step(ws, acc, params)
+        np.testing.assert_array_equal(np.asarray(new_p["w"]),
+                                      np.asarray(params["w"]))
+        # only loss 1's scaler backed off; flag reset after step
+        assert float(ws["scalers"][1].loss_scale) == s1_before / 2
+        assert bool(ws["finite"])
+
+    def test_legacy_fused_adam_scale_and_copy(self):
+        """step(grads, scale=..., output_dtype=...) unscales in-kernel and
+        emits the reduced-precision copy in the same pass."""
+        from apex_tpu.optim import legacy, FusedAdam
+
+        params = {"w": jnp.arange(16.0) / 16.0}
+        g = {"w": jnp.ones(16) * 128.0}          # scaled by 128
+        lo = legacy.FusedAdam(lr=1e-2)
+        ls = lo.init(params)
+        p1, ls, copy = lo.step(g, ls, params, scale=128.0,
+                               output_dtype=jnp.bfloat16)
+        assert copy["w"].dtype == jnp.bfloat16
+
+        modern = FusedAdam(lr=1e-2)
+        ms = modern.init(params)
+        p2, _ = modern.step({"w": jnp.ones(16)}, ms, params)
+        np.testing.assert_allclose(np.asarray(p1["w"]),
+                                   np.asarray(p2["w"]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(copy["w"], np.float32),
+                                   np.asarray(p1["w"]), atol=4e-3)
+
+    def test_legacy_fused_sgd_scale(self):
+        from apex_tpu.optim import legacy, FusedSGD
+        params = {"w": jnp.arange(8.0)}
+        g = {"w": jnp.full(8, 64.0)}
+        lo = legacy.FusedSGD(lr=0.5, momentum=0.9)
+        ls = lo.init(params)
+        p1, ls = lo.step(g, ls, params, scale=64.0)
+        modern = FusedSGD(lr=0.5, momentum=0.9)
+        p2, _ = modern.step({"w": jnp.ones(8)}, modern.init(params), params)
+        np.testing.assert_allclose(np.asarray(p1["w"]),
+                                   np.asarray(p2["w"]), rtol=1e-6)

@@ -102,3 +102,83 @@ class TestNorms:
         np.testing.assert_allclose(
             np.asarray(norms),
             [np.sqrt(10 * 4.0), np.sqrt(7 * 9.0)], rtol=1e-6)
+
+
+class TestPerTensorShardSums:
+    """per_tensor_sq_shard vs a segment-sum oracle over every shard
+    offset alignment case (tensor fully inside / straddling / outside the
+    shard; boundaries on and off block edges)."""
+
+    def _oracle(self, full, offsets, sizes, lo, hi):
+        out = []
+        for off, sz in zip(offsets, sizes):
+            a, b = max(off, lo), min(off + sz, hi)
+            seg = full[a:b] if b > a else np.zeros(0, np.float32)
+            out.append(np.sum(np.square(seg.astype(np.float64))))
+        return np.array(out)
+
+    @pytest.mark.parametrize("shard_start,shard_len", [
+        (0, 700), (300, 700), (650, 700), (1024, 700), (0, 2048)])
+    def test_matches_oracle(self, shard_start, shard_len):
+        from apex_tpu.ops.multi_tensor import per_tensor_sq_shard
+        rng = np.random.RandomState(0)
+        offsets = (0, 140, 300, 1000, 1500)
+        sizes = (130, 150, 700, 500, 400)
+        full = rng.randn(2048).astype(np.float32)
+        shard = jnp.asarray(
+            full[shard_start:shard_start + shard_len]
+            if shard_start < 2048 else np.zeros(shard_len, np.float32))
+        got = per_tensor_sq_shard(shard, offsets, sizes,
+                                  jnp.int32(shard_start), block=256)
+        ref = self._oracle(full, offsets, sizes, shard_start,
+                           min(shard_start + shard_len, 2048))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_jittable_with_traced_start(self):
+        from apex_tpu.ops.multi_tensor import per_tensor_sq_shard
+        rng = np.random.RandomState(1)
+        buf = jnp.asarray(rng.randn(512).astype(np.float32))
+        f = jax.jit(lambda b, s: per_tensor_sq_shard(
+            b, (0, 200), (180, 300), s, block=128))
+        a = f(buf, jnp.int32(0))
+        b = f(buf, jnp.int32(128))
+        assert a.shape == (2,) and not np.allclose(a, b)
+
+    def test_no_scatter_in_jaxpr(self):
+        from apex_tpu.ops.multi_tensor import per_tensor_sq_shard
+        buf = jnp.ones(1024)
+        jaxpr = str(jax.make_jaxpr(lambda b, s: per_tensor_sq_shard(
+            b, (0, 512), (512, 512), s))(buf, jnp.int32(0)))
+        assert "scatter" not in jaxpr
+
+
+class TestSpreadPerTensorShard:
+    @pytest.mark.parametrize("shard_start", [0, 300, 650, 1024])
+    def test_matches_gather_oracle(self, shard_start):
+        from apex_tpu.ops.multi_tensor import spread_per_tensor_shard
+        offsets = (0, 140, 300, 1000, 1500)
+        sizes = (130, 150, 700, 500, 400)
+        per = 700
+        vals = jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0])
+        got = np.asarray(spread_per_tensor_shard(
+            vals, offsets, sizes, jnp.int32(shard_start), per))
+        ref = np.zeros(per, np.float32)
+        for j, (off, sz) in enumerate(zip(offsets, sizes)):
+            for pos in range(per):
+                gp = shard_start + pos
+                if off <= gp < off + sz:
+                    ref[pos] = float(vals[j])
+        np.testing.assert_array_equal(got, ref)
+
+    def test_big_tensor_spans_whole_shard(self):
+        from apex_tpu.ops.multi_tensor import spread_per_tensor_shard
+        got = np.asarray(spread_per_tensor_shard(
+            jnp.asarray([7.0]), (0,), (4096,), jnp.int32(1024), 512))
+        np.testing.assert_array_equal(got, np.full(512, 7.0, np.float32))
+
+    def test_no_gather_scatter_in_jaxpr(self):
+        from apex_tpu.ops.multi_tensor import spread_per_tensor_shard
+        jaxpr = str(jax.make_jaxpr(lambda v, s: spread_per_tensor_shard(
+            v, (0, 256), (256, 256), s, 256))(jnp.ones(2), jnp.int32(0)))
+        assert "scatter" not in jaxpr and "gather[" not in jaxpr
