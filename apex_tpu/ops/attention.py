@@ -38,9 +38,27 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu.ops._dispatch import use_interpret
 
 LANES = 128
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Grid-step overhead on TPU dwarfs the per-tile MXU work at 128-blocks
+# (a 128x128x64 tile is ~4 MFLOP ≈ 20 ns of MXU time); 512-blocks keep
+# the kernel VMEM-comfortable (a 512x512 fp32 score tile is 1 MiB) and
+# measured 13x faster backward at S=512. Long sequences still stream
+# blockwise — this only sets the tile, not the memory complexity.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
+
+
+def _choose_block(pref, s):
+    """Tile size for a sequence dim: clamp to the sequence, keep it
+    8-sublane aligned (the lse output block `(bq, LANES)` tiles a
+    `(B·H·nq·bq, LANES)` buffer, so bq must be a multiple of 8 whenever
+    there is more than one block — interpret mode does not check this),
+    and halve while padding waste exceeds half a tile (a 520-long
+    sequence should pad to 640, not 1024)."""
+    b = -(-min(pref, max(16, s)) // 8) * 8
+    while b > 128 and (-(-s // b)) * b - s > b // 2:
+        b //= 2
+    return b
 
 
 def _causal_mask(iq, ik, bq, bk, offset):
@@ -168,8 +186,8 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k,
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
-    bq = min(block_q, max(16, sq))
-    bk = min(block_k, max(16, sk))
+    bq = _choose_block(block_q, sq)
+    bk = _choose_block(block_k, sk)
     sqp = -(-sq // bq) * bq
     skp = -(-sk // bk) * bk
 
@@ -357,8 +375,8 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
-    bq = min(block_q, max(16, sq))
-    bk = min(block_k, max(16, sk))
+    bq = _choose_block(block_q, sq)
+    bk = _choose_block(block_k, sk)
     sqp = -(-sq // bq) * bq
     skp = -(-sk // bk) * bk
     nq, nk = sqp // bq, skp // bk
@@ -607,8 +625,8 @@ def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
     dp = jnp.einsum("bqhd,bkhd->bhqk", do.astype(jnp.float32),
                     v.astype(jnp.float32))
     if dropout_rate > 0.0:
-        bq = min(block_q, max(16, sq))
-        bk = min(block_k, max(16, sk))
+        bq = _choose_block(block_q, sq)
+        bk = _choose_block(block_k, sk)
         keep = _keep_mask_dense(seed[0], b, h, sq, sk, bq, bk,
                                 dropout_rate).reshape(b, h, sq, sk)
         dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)

@@ -279,8 +279,8 @@ class TestFusedDropout:
             cm = np.tril(np.ones((sq, sk), bool), k=sk - sq)
             s = jnp.where(cm, s, A.NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        bq = min(A.DEFAULT_BLOCK_Q, max(16, sq))
-        bk = min(A.DEFAULT_BLOCK_K, max(16, sk))
+        bq = A._choose_block(A.DEFAULT_BLOCK_Q, sq)
+        bk = A._choose_block(A.DEFAULT_BLOCK_K, sk)
         keep = A._keep_mask_dense(jnp.asarray(seed, jnp.int32), b, h,
                                   sq, sk, bq, bk, rate)
         keep = keep.reshape(b, h, sq, sk)
