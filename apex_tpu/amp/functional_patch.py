@@ -1,0 +1,150 @@
+"""Reversible jnp/lax entry-point patching — O1 coverage for raw ops.
+
+The reference's O1 wraps every function in the torch namespaces
+(`apex/amp/amp.py:68-177`, `apex/amp/wrap.py:10-113`), so user code
+calling ``torch.matmul`` directly — not through an ``nn.Module`` — still
+gets the cast policy. The flax interceptor (amp/interceptor.py) covers
+module calls only; this module covers the rest: inside ``auto_cast``,
+the *user-facing* MXU entry points (``jnp.einsum``/``matmul``/``dot``/
+``tensordot`` and the ``lax.conv*`` family) cast floating inputs to the
+policy half dtype, and the numerically-sensitive entry points
+(``jax.nn.softmax``/``log_softmax``) cast to fp32 — mirroring the
+whitelist/blacklist split of `lists/torch_overrides.py:7-117`.
+
+Precedence rules (the reference's "user wrapper wins" ordering):
+
+- ``lax.dot_general`` is NOT patched: it is the lowering target of
+  every dense op — flax modules and Pallas kernel bodies (whose fp32
+  accumulators must not be downcast) both route through it.
+- Calls *inside an interceptor-classified module* are exempt via
+  :func:`suspend`: once the interceptor has applied the policy to a
+  module call (including honoring an explicit user ``dtype=``), the
+  raw-op patch must not second-guess the dtypes its body computes in.
+  Library fp32 oracles (e.g. ``attention_reference``) use the same
+  escape hatch.
+- Nested ``auto_cast`` contexts push their policy on a stack; the
+  innermost policy's half dtype applies (patches are installed once,
+  reference-counted, and fully restored on the outermost exit — pinned
+  by tests/test_amp_api.py::test_functional_patch_restores).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils import tree_cast
+
+# (module, attr) pairs wrapped to the HALF policy — the O1 whitelist
+# surface for raw calls (`torch_overrides.py` MM_FNS/CONV_FNS analogue)
+_HALF_TARGETS = (
+    (jnp, "einsum"),
+    (jnp, "matmul"),
+    (jnp, "dot"),
+    (jnp, "vdot"),
+    (jnp, "inner"),
+    (jnp, "tensordot"),
+    (jax.lax, "conv"),
+    (jax.lax, "conv_general_dilated"),
+    (jax.lax, "conv_with_general_padding"),
+    (jax.lax, "conv_transpose"),
+)
+
+# wrapped to fp32 — blacklist surface (`functional_overrides.py:30-60`)
+_FLOAT_TARGETS = (
+    (jax.nn, "softmax"),
+    (jax.nn, "log_softmax"),
+)
+
+_lock = threading.Lock()
+_patch_count = 0             # processwide: are the setattr patches in?
+_originals: list = []
+_tls = threading.local()     # per-thread: suspend depth + policy stack
+# The policy stack is THREAD-local while the attribute patches are
+# process-global: a thread that never entered auto_cast sees an empty
+# stack and gets passthrough behavior, so a concurrent eval/checkpoint
+# thread is never downcast by another thread's context.
+
+
+def _stack() -> list:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def _suspended() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def suspend():
+    """Run with the raw-op patches inert (module bodies whose precision
+    the interceptor already decided; library fp32 oracles)."""
+    _tls.depth = getattr(_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _tls.depth -= 1
+
+
+def _wrap_half(fn):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        stack = _stack()
+        if _suspended() or not stack:
+            return fn(*args, **kwargs)
+        dt = stack[-1]
+        return fn(*tree_cast(args, dt), **tree_cast(kwargs, dt))
+    wrapped.__wrapped_by_apex_tpu__ = True
+    return wrapped
+
+
+def _wrap_float(fn):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        if _suspended() or not _stack():
+            return fn(*args, **kwargs)
+        return fn(*tree_cast(args, jnp.float32),
+                  **tree_cast(kwargs, jnp.float32))
+    wrapped.__wrapped_by_apex_tpu__ = True
+    return wrapped
+
+
+def patch_functional(policy) -> None:
+    """Install the raw-op casts for ``policy`` (nested contexts push the
+    policy; call :func:`unpatch_functional` symmetrically)."""
+    global _patch_count
+    _stack().append(jnp.dtype(policy.half_dtype))
+    with _lock:
+        _patch_count += 1
+        if _patch_count > 1:
+            return
+        for mod, name in _HALF_TARGETS:
+            orig = getattr(mod, name)
+            _originals.append((mod, name, orig))
+            setattr(mod, name, _wrap_half(orig))
+        for mod, name in _FLOAT_TARGETS:
+            orig = getattr(mod, name)
+            _originals.append((mod, name, orig))
+            setattr(mod, name, _wrap_float(orig))
+
+
+def unpatch_functional() -> None:
+    global _patch_count
+    s = _stack()
+    if s:
+        s.pop()
+    with _lock:
+        if _patch_count == 0:
+            return
+        _patch_count -= 1
+        if _patch_count:
+            return
+        while _originals:
+            mod, name, orig = _originals.pop()
+            setattr(mod, name, orig)

@@ -63,8 +63,13 @@ def make_interceptor(policy: Policy):
         args = tree_cast(args, target)
         kwargs = tree_cast(kwargs, target)
         retargeted = _retarget_dtype(mod, target)
+        # precision for this call is decided here (incl. an explicit
+        # user dtype=, which is never retargeted) — the O1 raw-op patch
+        # must not second-guess the module body's internal casts
+        from apex_tpu.amp import functional_patch
         try:
-            return next_fun(*args, **kwargs)
+            with functional_patch.suspend():
+                return next_fun(*args, **kwargs)
         finally:
             if retargeted:
                 object.__setattr__(mod, "dtype", None)
@@ -99,10 +104,24 @@ def auto_cast(policy: Policy):
         with amp.auto_cast(policy):
             logits = model.apply(variables, x)
 
-    Also binds ``policy`` as the ambient policy for ``apex_tpu.ops``.
+    Also binds ``policy`` as the ambient policy for ``apex_tpu.ops``, and
+    — when ``policy.patch_ops`` (O1) — reversibly patches the raw
+    ``jnp``/``lax`` MXU entry points so user code calling ``jnp.einsum``
+    etc. directly gets half-precision GEMMs too (the torch-namespace
+    analogue; see amp/functional_patch.py for the exact surface and the
+    deliberate ``lax.dot_general`` exclusion).
     """
     import flax.linen as nn
 
+    from apex_tpu.amp import functional_patch
+
+    do_patch = policy.enabled and policy.patch_ops
     with policy_scope(policy):
         with nn.intercept_methods(make_interceptor(policy)):
-            yield
+            if do_patch:
+                functional_patch.patch_functional(policy)
+            try:
+                yield
+            finally:
+                if do_patch:
+                    functional_patch.unpatch_functional()

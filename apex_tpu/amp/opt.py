@@ -3,11 +3,12 @@
 The reference's old-API wrapper (`apex/amp/opt.py:9-103`) gives one
 optimizer N independent dynamic loss scalers, a ``scale_loss`` context
 per loss, and skip bookkeeping; grads for earlier losses are stashed so
-each loss unscales at its own scale (`opt.py:25-52`). Functionally that
-is exactly :class:`apex_tpu.amp.Amp` with ``num_losses=N`` — this shim
-keeps the legacy *shape* of the API for users porting old scripts: a
-wrapper object owning per-loss scaler states and an explicit
-accumulate/step cycle.
+each loss unscales at its own scale (`opt.py:25-52`). The *scaling*
+semantics are exactly :class:`apex_tpu.amp.Amp` with ``num_losses=N``;
+precision casting is applied only when a ``policy`` is passed (see
+``__init__``) — this shim keeps the legacy *shape* of the API for users
+porting old scripts: a wrapper object owning per-loss scaler states and
+an explicit accumulate/step cycle.
 
 Deprecated in the reference too; prefer ``Amp``.
 """
@@ -45,10 +46,17 @@ class OptimWrapper:
     """
 
     def __init__(self, optimizer, num_loss: int = 1,
-                 cfg: LossScaleConfig = None):
+                 cfg: LossScaleConfig = None, policy=None):
+        """``policy``: an optional :class:`apex_tpu.amp.Policy`. When
+        given, each ``backward`` runs ``loss_fn`` under
+        ``auto_cast(policy)`` so O1-style casting applies — without it
+        this shim handles *scaling only* and casting is the caller's job
+        (wrap the forward in ``auto_cast`` yourself, or pass a model
+        already cast per O2)."""
         self.tx = optimizer
         self.num_loss = num_loss
         self.cfg = cfg or LossScaleConfig(dynamic=True)
+        self.policy = policy
 
     def init(self, params):
         return {
@@ -71,7 +79,12 @@ class OptimWrapper:
         sstate = wstate["scalers"][loss_idx]
 
         def scaled(p):
-            out = loss_fn(p, *args, **kwargs)
+            if self.policy is not None:
+                from apex_tpu.amp import auto_cast
+                with auto_cast(self.policy):
+                    out = loss_fn(p, *args, **kwargs)
+            else:
+                out = loss_fn(p, *args, **kwargs)
             loss = out[0] if isinstance(out, tuple) else out
             return scale_loss(loss, sstate), out
 

@@ -1,0 +1,22 @@
+"""apex_tpu.data — input pipeline (decode → augment → device prefetch).
+
+The reference trains from real data through either torch's DataLoader or
+DALI (`examples/imagenet/main_amp.py:28-57`, `:264-317` data_prefetcher).
+This package is the TPU-side equivalent: a threaded JPEG decode+augment
+source over an ImageFolder tree, a bounded device-put prefetcher that
+overlaps host→device transfer with compute, and measurement helpers that
+report whether a config is input-bound or compute-bound.
+"""
+
+from apex_tpu.data.pipeline import (
+    DevicePrefetcher,
+    ImageFolderSource,
+    make_fake_imagefolder,
+    measure_source,
+    synthetic_source,
+)
+
+__all__ = [
+    "DevicePrefetcher", "ImageFolderSource", "make_fake_imagefolder",
+    "measure_source", "synthetic_source",
+]

@@ -1,0 +1,38 @@
+"""``python -m apex_tpu.data`` — loader-only throughput probe.
+
+    python -m apex_tpu.data --bench DIR -b 128 --size 224 --workers 8
+    python -m apex_tpu.data --make-fake /tmp/fakeimagenet
+
+Prints images/sec of decode+augment+batch assembly alone; compare with
+the model's synthetic-data img/s to tell input-bound from compute-bound.
+"""
+
+import argparse
+
+from apex_tpu.data import (ImageFolderSource, make_fake_imagefolder,
+                           measure_source)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--bench", metavar="DIR")
+    p.add_argument("--make-fake", metavar="DIR")
+    p.add_argument("-b", "--batch", type=int, default=128)
+    p.add_argument("--size", type=int, default=224)
+    p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    args = p.parse_args()
+    if args.make_fake:
+        make_fake_imagefolder(args.make_fake)
+        print(f"wrote fake ImageFolder tree at {args.make_fake}")
+    if args.bench:
+        src = ImageFolderSource(args.bench, args.batch, args.size,
+                                workers=args.workers)
+        rate = measure_source(src.batches(args.steps + 1),
+                              steps=args.steps)
+        print(f"loader: {rate:.1f} img/s (batch {args.batch}, "
+              f"size {args.size}, workers {src.workers})")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,15 +18,24 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 from apex_tpu.parallel.sync_batchnorm import SyncBatchNorm
+from apex_tpu.ops.bn_act import FusedBNAct
 
 
 class _BN(nn.Module):
-    """BatchNorm selecting sync (mesh-axis stats) or local, NHWC.
+    """BatchNorm unit, optionally with fused residual-add and ReLU.
 
     ``dtype`` is the *activation* dtype (output in that dtype, stats and
     scale/offset always fp32) — keep_batchnorm_fp32 the TPU way: fp32
     parameters and statistics, half activations in and out, the cast
     fused into the normalize instead of materialized in HBM.
+
+    ``fused=True`` (default) routes through :class:`FusedBNAct`, whose
+    hand-written VJP saves only the conv output + per-channel stats and
+    recomputes x̂/the ReLU mask — the traffic-minimal backward (the role
+    of the reference's `nhwc_batch_norm_kernel.h` fused kernels). The
+    unfused path keeps the round-2 module structure (flax BatchNorm /
+    SyncBatchNorm submodule) as the autodiff oracle; note the param
+    trees differ between the two (documented in docs/models.md).
     """
     features: int
     axis_name: Optional[str] = None
@@ -34,22 +43,39 @@ class _BN(nn.Module):
     epsilon: float = 1e-5
     init_scale: float = 1.0
     dtype: Optional[Any] = None
+    relu: bool = False
+    fused: bool = True
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, residual=None, train: bool = True):
+        if self.fused:
+            z = FusedBNAct(
+                num_features=self.features, relu=self.relu,
+                momentum=self.momentum, epsilon=self.epsilon,
+                axis_name=self.axis_name, init_scale=self.init_scale,
+                dtype=self.dtype)(x, residual, train=train)
+            return z
         if self.dtype is not None:
             x = x.astype(self.dtype)
+            if residual is not None:
+                residual = residual.astype(self.dtype)
         if self.axis_name is not None:
             bn = SyncBatchNorm(
                 num_features=self.features, momentum=1 - self.momentum,
                 epsilon=self.epsilon, axis_name=self.axis_name,
                 scale_init=nn.initializers.constant(self.init_scale))
-            return bn(x, use_running_average=not train)
-        bn = nn.BatchNorm(
-            use_running_average=not train, momentum=self.momentum,
-            epsilon=self.epsilon, dtype=self.dtype,
-            scale_init=nn.initializers.constant(self.init_scale))
-        return bn(x)
+            y = bn(x, use_running_average=not train)
+        else:
+            bn = nn.BatchNorm(
+                use_running_average=not train, momentum=self.momentum,
+                epsilon=self.epsilon, dtype=self.dtype,
+                scale_init=nn.initializers.constant(self.init_scale))
+            y = bn(x)
+        if residual is not None:
+            y = y + residual
+        if self.relu:
+            y = nn.relu(y)
+        return y
 
 
 class _StemConv(nn.Module):
@@ -111,26 +137,27 @@ class BottleneckBlock(nn.Module):
     strides: Tuple[int, int] = (1, 1)
     bn_axis_name: Optional[str] = None
     dtype: Optional[Any] = None
+    fused_bn: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        bn = partial(_BN, axis_name=self.bn_axis_name, dtype=self.dtype)
+        bn = partial(_BN, axis_name=self.bn_axis_name, dtype=self.dtype,
+                     fused=self.fused_bn)
         residual = x
         y = conv(self.features, (1, 1))(x)
-        y = bn(self.features)(y, train)
-        y = nn.relu(y)
+        y = bn(self.features, relu=True)(y, train=train)
         y = conv(self.features, (3, 3), self.strides)(y)
-        y = bn(self.features)(y, train)
-        y = nn.relu(y)
+        y = bn(self.features, relu=True)(y, train=train)
         y = conv(self.features * 4, (1, 1))(y)
-        # zero-init the last BN scale: standard ResNet recipe (identity
-        # residual at init)
-        y = bn(self.features * 4, init_scale=0.0)(y, train)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.features * 4 \
+                or self.strides != (1, 1):
             residual = conv(self.features * 4, (1, 1), self.strides)(x)
-            residual = bn(self.features * 4)(residual, train)
-        return nn.relu(residual + y)
+            residual = bn(self.features * 4)(residual, train=train)
+        # zero-init the last BN scale: standard ResNet recipe (identity
+        # residual at init); the residual add + relu fuse into this unit
+        return bn(self.features * 4, init_scale=0.0, relu=True)(
+            y, residual, train=train)
 
 
 class BasicBlock(nn.Module):
@@ -138,21 +165,22 @@ class BasicBlock(nn.Module):
     strides: Tuple[int, int] = (1, 1)
     bn_axis_name: Optional[str] = None
     dtype: Optional[Any] = None
+    fused_bn: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        bn = partial(_BN, axis_name=self.bn_axis_name, dtype=self.dtype)
+        bn = partial(_BN, axis_name=self.bn_axis_name, dtype=self.dtype,
+                     fused=self.fused_bn)
         residual = x
         y = conv(self.features, (3, 3), self.strides)(x)
-        y = bn(self.features)(y, train)
-        y = nn.relu(y)
+        y = bn(self.features, relu=True)(y, train=train)
         y = conv(self.features, (3, 3))(y)
-        y = bn(self.features, init_scale=0.0)(y, train)
-        if residual.shape != y.shape:
+        if residual.shape[-1] != self.features or self.strides != (1, 1):
             residual = conv(self.features, (1, 1), self.strides)(x)
-            residual = bn(self.features)(residual, train)
-        return nn.relu(residual + y)
+            residual = bn(self.features)(residual, train=train)
+        return bn(self.features, init_scale=0.0, relu=True)(
+            y, residual, train=train)
 
 
 class ResNet(nn.Module):
@@ -169,6 +197,9 @@ class ResNet(nn.Module):
     #: run the stem via 2x2 space-to-depth (MXU-friendly C=12 layout);
     #: automatically falls back to the plain 7x7/2 conv for odd sizes
     space_to_depth: bool = True
+    #: minimal-residual fused BN(+add)(+relu) backward (see ops/bn_act.py);
+    #: False = plain flax BatchNorm autodiff (the numeric oracle)
+    fused_bn: bool = True
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -176,14 +207,15 @@ class ResNet(nn.Module):
             x = x.astype(self.dtype)  # patched-forward input cast
         y = _StemConv(self.width, space_to_depth=self.space_to_depth,
                       dtype=self.dtype, name="stem_conv")(x)
-        y = _BN(self.width, self.bn_axis_name, dtype=self.dtype)(y, train)
-        y = nn.relu(y)
+        y = _BN(self.width, self.bn_axis_name, dtype=self.dtype,
+                relu=True, fused=self.fused_bn)(y, train=train)
         y = nn.max_pool(y, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])
         for i, n_blocks in enumerate(self.stage_sizes):
             for j in range(n_blocks):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
                 y = self.block(self.width * 2 ** i, strides,
-                               self.bn_axis_name, self.dtype)(y, train)
+                               self.bn_axis_name, self.dtype,
+                               self.fused_bn)(y, train)
         y = jnp.mean(y, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype)(y)
 

@@ -26,6 +26,12 @@ from apex_tpu.ops.xentropy import (
     softmax_cross_entropy_reference,
 )
 from apex_tpu.ops.group_bn import BatchNorm2d_NHWC, bn_group_spec
+from apex_tpu.ops.bn_act import (
+    FusedBNAct,
+    bn_act_reference,
+    bn_act_train,
+    bn_add_act_train,
+)
 from apex_tpu.ops.attention import (
     flash_attention,
     attention_reference,
@@ -40,6 +46,7 @@ __all__ = [
     "layer_norm_reference", "MLP", "fused_mlp", "mlp_reference",
     "softmax_cross_entropy_loss", "softmax_cross_entropy_reference",
     "BatchNorm2d_NHWC", "bn_group_spec",
+    "FusedBNAct", "bn_act_reference", "bn_act_train", "bn_add_act_train",
     "flash_attention", "attention_reference", "mask_softmax_dropout",
     "SelfMultiheadAttn", "EncdecMultiheadAttn",
 ]

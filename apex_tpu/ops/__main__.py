@@ -1,0 +1,11 @@
+"""``python -m apex_tpu.ops`` — on-device kernel compile validation.
+
+Compiles and runs every Pallas kernel family on the attached accelerator
+and checks outputs against oracles. See ops/compile_check.py.
+"""
+
+import sys
+
+from apex_tpu.ops.compile_check import main
+
+sys.exit(main())

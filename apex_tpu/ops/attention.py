@@ -48,16 +48,24 @@ DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
-def _choose_block(pref, s):
+def _choose_block(pref, s, lane: bool = False):
     """Tile size for a sequence dim: clamp to the sequence, keep it
     8-sublane aligned (the lse output block `(bq, LANES)` tiles a
     `(B·H·nq·bq, LANES)` buffer, so bq must be a multiple of 8 whenever
     there is more than one block — interpret mode does not check this),
     and halve while padding waste exceeds half a tile (a 520-long
-    sequence should pad to 640, not 1024)."""
+    sequence should pad to 640, not 1024).
+
+    ``lane=True`` marks the key dimension, which lands in the *lane*
+    position of the bias block: with more than one block Mosaic requires
+    a multiple of 128 there, so halved/odd preferences (e.g. block_k=384
+    over Sk=400 halving to 96) are rounded back up to 128-multiples; a
+    single block covering the whole padded dim is always legal."""
     b = -(-min(pref, max(16, s)) // 8) * 8
     while b > 128 and (-(-s // b)) * b - s > b // 2:
         b //= 2
+    if lane and -(-s // b) > 1 and b % LANES:
+        b = -(-b // LANES) * LANES
     return b
 
 
@@ -187,7 +195,7 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k,
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
     bq = _choose_block(block_q, sq)
-    bk = _choose_block(block_k, sk)
+    bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
     skp = -(-sk // bk) * bk
 
@@ -376,7 +384,7 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
     bq = _choose_block(block_q, sq)
-    bk = _choose_block(block_k, sk)
+    bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
     skp = -(-sk // bk) * bk
     nq, nk = sqp // bq, skp // bk
@@ -626,7 +634,7 @@ def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
                     v.astype(jnp.float32))
     if dropout_rate > 0.0:
         bq = _choose_block(block_q, sq)
-        bk = _choose_block(block_k, sk)
+        bk = _choose_block(block_k, sk, lane=True)
         keep = _keep_mask_dense(seed[0], b, h, sq, sk, bq, bk,
                                 dropout_rate).reshape(b, h, sq, sk)
         dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -644,7 +652,14 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 def attention_reference(q, k, v, bias=None, scale=None, causal=False):
     """Pure-jnp oracle — the reference's ``impl='default'`` python path
-    (`self_multihead_attn_func.py:6-232`)."""
+    (`self_multihead_attn_func.py:6-232`). Runs with the O1 raw-op patch
+    suspended: its fp32 einsums are the point of the oracle."""
+    from apex_tpu.amp.functional_patch import suspend
+    with suspend():
+        return _attention_reference(q, k, v, bias, scale, causal)
+
+
+def _attention_reference(q, k, v, bias, scale, causal):
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),

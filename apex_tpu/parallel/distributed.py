@@ -177,7 +177,8 @@ class DistributedDataParallel:
                  gradient_predivide_factor: float = 1.0,
                  allreduce_always_fp32: bool = False,
                  delay_allreduce: bool = False,
-                 message_size: Optional[int] = None):
+                 message_size: Optional[int] = None,
+                 grad_dtype=None):
         if axis_name not in mesh.axis_names:
             raise ValueError(f"axis {axis_name!r} not in mesh "
                              f"{mesh.axis_names}")
@@ -188,6 +189,12 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.delay_allreduce = delay_allreduce
         self.message_size = message_size
+        #: dtype the gradients are reduced in — sizes the message_size →
+        #: combine-threshold conversion (bf16 grads halve the bytes).
+        #: Defaults to fp32 (the reference counts fp32 elements,
+        #: `apex/parallel/distributed.py:165`), or fp32 when
+        #: allreduce_always_fp32 regardless of this setting.
+        self.grad_dtype = grad_dtype
         self._sync_enabled = True
 
     @property
@@ -309,15 +316,25 @@ class DistributedDataParallel:
     def _compiler_options(self) -> Optional[dict]:
         """``message_size`` (elements; the reference default is 1e7 ≈
         40 MB of fp32, `apex/parallel/distributed.py:165`) → the XLA
-        collective-combiner threshold. ``None`` lets XLA choose. The
-        DebugOptions field is shared across backends despite the gpu
-        prefix; TPU's combiner reads the same proto field."""
+        collective-combiner threshold, scaled by the reduction dtype's
+        itemsize. ``None`` lets XLA choose.
+
+        Best-effort contract: the DebugOptions field is shared across
+        backends despite the gpu prefix and demonstrably reaches the
+        compiled executable where options are accepted (pinned by
+        tests/test_parallel.py), but whether a given TPU runtime's
+        combiner honors it is backend-version dependent — treat it as a
+        hint, exactly like the reference's bucketing heuristic."""
         if self.message_size is None:
             return None
         if not self._probe_compiler_options():
             return None
+        import jax.numpy as jnp
+        dt = jnp.float32 if (self.allreduce_always_fp32 or
+                             self.grad_dtype is None) else self.grad_dtype
+        itemsize = jnp.dtype(dt).itemsize
         return {"xla_gpu_all_reduce_combine_threshold_bytes":
-                str(int(self.message_size) * 4)}
+                str(int(self.message_size) * itemsize)}
 
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Wrap ``grad_fn(*a, **k) -> (value, grads)`` so grads come back

@@ -230,12 +230,20 @@ def _bench_dcgan(batch, iters):
 
     jstep = jax.jit(scanned, donate_argnums=(0, 1, 2, 3))
 
+    # model FLOPs of the whole K-step dispatch from XLA cost analysis —
+    # the DCGAN MFU denominator (VERDICT r2 item 9: no dash cells)
+    from apex_tpu.prof import hlo as _hlo
+    args0 = (gstate, dstate, gv["batch_stats"], dv["batch_stats"], z, real)
+    try:
+        flops_dispatch = _hlo.cost_analysis(jstep, *args0)["flops"]
+    except Exception:
+        flops_dispatch = 0.0
+
     def rebind(out, args):
         return (out[0], out[1], out[2], out[3], args[4], args[5])
 
-    dt = _timeit(jstep, (gstate, dstate, gv["batch_stats"],
-                         dv["batch_stats"], z, real), iters, rebind=rebind)
-    return batch * K / dt, dt / K
+    dt = _timeit(jstep, args0, iters, rebind=rebind)
+    return batch * K / dt, dt / K, flops_dispatch / dt
 
 
 def _bench_bert(batch, seq, iters):
@@ -293,15 +301,42 @@ def run_all():
         rows.append((name, f"{img_s:.0f} img/s", f"{mfu:.1%}",
                      f"batch {batch}"))
 
-    resnet_row("ResNet-50 fp32 (O0)", "O0", 64 if on_tpu else 8)
+    def resnet_row_sweep(name, opt_level, batches, sync_bn=False):
+        """Try each batch, keep the best throughput (the O0 fp32 row runs
+        its own sweep: its memory/roofline sweet spot differs from O2's
+        measured batch-256 — VERDICT r2 item 9)."""
+        best, last_err = None, None
+        for b in batches:
+            try:
+                img_s, dt = _bench_resnet(opt_level, b, size, iters,
+                                          sync_bn=sync_bn)
+            except Exception as e:
+                last_err = e
+                continue
+            if best is None or img_s > best[0]:
+                best = (img_s, b)
+        if best is None:
+            rows.append((name, "failed", "-",
+                         type(last_err).__name__ if last_err else "-"))
+            return
+        img_s, b = best
+        flops_img = models.RESNET50_FLOPS_PER_IMAGE * 3 * (size / 224) ** 2
+        mfu = img_s * flops_img / peak
+        rows.append((name, f"{img_s:.0f} img/s", f"{mfu:.1%}",
+                     f"batch {b} (swept {tuple(batches)})"))
+
+    resnet_row_sweep("ResNet-50 fp32 (O0)", "O0",
+                     (128, 64) if on_tpu else (8,))
     resnet_row("ResNet-50 amp O2 + FusedSGD", "O2", 256 if on_tpu else 8)
     resnet_row("ResNet-50 DP + SyncBN (per chip)", "O2",
                256 if on_tpu else 8, sync_bn=True)
     try:
         dcgan_batch = 128 if on_tpu else 8
-        img_s, dt = _bench_dcgan(dcgan_batch, iters)
+        img_s, dt, flops_s = _bench_dcgan(dcgan_batch, iters)
+        mfu_cell = f"{flops_s / peak:.1%}" if flops_s else "-"
         rows.append(("DCGAN multi-loss (G+2xD steps)",
-                     f"{img_s:.0f} img/s", "-", f"batch {dcgan_batch}"))
+                     f"{img_s:.0f} img/s", mfu_cell,
+                     f"batch {dcgan_batch}"))
     except Exception as e:
         rows.append(("DCGAN multi-loss", "failed", "-",
                      f"{type(e).__name__}"))
@@ -363,8 +398,14 @@ def main():
         "metric": "resnet50_amp_o2_images_per_sec",
         "value": round(best, 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(mfu / 0.60, 4),  # north star: 60% MFU
-        "extra": {"mfu": round(mfu, 4), "batch": best_batch, "size": size,
+        "vs_baseline": round(mfu / 0.60, 4),
+        "extra": {"mfu": round(mfu, 4),
+                  # vs_baseline IS the MFU ratio vs the 60% north star
+                  # (BASELINE.json publishes no reference throughput
+                  # numbers to ratio against) — named explicitly so the
+                  # driver JSON is unambiguous
+                  "mfu_ratio_vs_60pct_target": round(mfu / 0.60, 4),
+                  "batch": best_batch, "size": size,
                   "device": getattr(jax.devices()[0], "device_kind", "?"),
                   "loss": best_loss},
     }))

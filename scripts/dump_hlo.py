@@ -1,0 +1,60 @@
+"""Dump the optimized HLO of the ResNet-50 bench step to a file.
+
+Usage: python scripts/dump_hlo.py OUT.txt [--unfused] [--batch N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    out_path = sys.argv[1]
+    fused = "--unfused" not in sys.argv
+    batch = 256
+    if "--batch" in sys.argv:
+        batch = int(sys.argv[sys.argv.index("--batch") + 1])
+
+    from apex_tpu import amp, models, ops
+    from apex_tpu.optim import FusedSGD
+    from apex_tpu.prof import hlo as _hlo
+
+    policy = amp.Policy.from_opt_level("O2")
+    model = models.ResNet50(num_classes=1000, dtype=policy.compute_dtype,
+                            fused_bn=fused)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9))
+    state = amp_opt.init(params)
+
+    def step(state, batch_stats, xb, yb):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": batch_stats}, xb, train=True,
+                mutable=["batch_stats"])
+            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
+            return loss, mut["batch_stats"]
+
+        (loss, new_bs), grads, state, finite = amp_opt.backward(
+            state, loss_fn, has_aux=True)
+        state = amp_opt.apply_gradients(state, grads, finite)
+        return state, new_bs, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    text = _hlo.compiled_hlo(jstep, state, batch_stats, x, y)
+    with open(out_path, "w") as f:
+        f.write(text)
+    print(f"wrote {len(text)} chars to {out_path}")
+
+
+if __name__ == "__main__":
+    main()

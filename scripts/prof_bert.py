@@ -1,0 +1,89 @@
+"""Per-op profile of the BERT-Large LAMB bench step (VERDICT r2 item 3).
+
+Usage: python scripts/prof_bert.py [--batch N] [--seq N] [--top N]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    batch = 16
+    seq = 512
+    top = 30
+    argv = sys.argv
+    if "--batch" in argv:
+        batch = int(argv[argv.index("--batch") + 1])
+    if "--seq" in argv:
+        seq = int(argv[argv.index("--seq") + 1])
+    if "--top" in argv:
+        top = int(argv[argv.index("--top") + 1])
+
+    from apex_tpu import amp, models, prof
+    from apex_tpu.optim import FusedLAMB
+
+    policy = amp.Policy.from_opt_level("O1")
+    enc = models.BertLarge()
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
+    variables = enc.init(jax.random.PRNGKey(0), toks[:1])
+    amp_opt = amp.Amp(policy, FusedLAMB(lr=1e-3))
+    state = amp_opt.init(variables["params"])
+
+    def step(state, toks, labels):
+        def loss_fn(mp):
+            with amp.auto_cast(policy):
+                return models.mlm_loss(enc, {"params": mp}, toks, labels)
+        loss, grads, state, finite = amp_opt.backward(state, loss_fn)
+        return amp_opt.apply_gradients(state, grads, finite), loss
+
+    import tempfile
+    import time
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+    from apex_tpu.prof import hlo as _hlo
+    cost = _hlo.cost_analysis(jstep, state, toks, labels)
+    for _ in range(3):
+        state, loss = jstep(state, toks, labels)
+    float(loss)
+
+    iters = 5
+    logdir = tempfile.mkdtemp(prefix="apex_tpu_prof_bert_")
+    t0 = time.perf_counter()
+    with prof.trace(logdir):
+        for _ in range(iters):
+            state, loss = jstep(state, toks, labels)
+        float(loss)
+    wall = (time.perf_counter() - t0) / iters
+
+    from apex_tpu.prof import xplane as _xplane
+    profile = _xplane.parse_trace(logdir)
+    dev_us = (profile.module_total_us / profile.module_runs
+              if profile.module_runs else wall * 1e6)
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    model_flops = 6.0 * n_params * batch * seq
+    print(f"batch={batch} seq={seq} params={n_params/1e6:.1f}M")
+    print(f"wall/iter={wall*1e6:.0f}us device/iter={dev_us:.0f}us "
+          f"xla_flops={cost['flops']:.3g} "
+          f"model_flops={model_flops:.3g} "
+          f"bytes={cost['bytes_accessed']:.3g}")
+    cats = "  ".join(f"{k}={v:.0f}us"
+                     for k, v in list(profile.by_category().items())[:8])
+    print(cats)
+    print(profile.table(top=top))
+    peak = prof.device_peak_flops() or float("inf")
+    print("model-flops MFU:", model_flops / (dev_us * 1e-6) / peak)
+    print("seq/s:", batch / (dev_us * 1e-6))
+
+
+if __name__ == "__main__":
+    main()

@@ -455,3 +455,87 @@ class TestLegacySurfaces:
         p2, _ = modern.step({"w": jnp.ones(8)}, modern.init(params), params)
         np.testing.assert_allclose(np.asarray(p1["w"]),
                                    np.asarray(p2["w"]), rtol=1e-6)
+
+
+class TestFunctionalPatch:
+    """O1 raw-op coverage: jnp/lax entry points under auto_cast
+    (`apex/amp/amp.py:68-177` analogue, VERDICT round-2 item 6)."""
+
+    def test_raw_einsum_runs_half_under_o1(self):
+        policy = amp.Policy.from_opt_level("O1")
+        a = jnp.ones((8, 16), jnp.float32)
+        b = jnp.ones((16, 4), jnp.float32)
+        with amp.auto_cast(policy):
+            out_e = jnp.einsum("ij,jk->ik", a, b)
+            out_m = jnp.matmul(a, b)
+            out_c = jax.lax.conv_general_dilated(
+                jnp.ones((1, 8, 8, 3), jnp.float32),
+                jnp.ones((3, 3, 3, 4), jnp.float32),
+                window_strides=(1, 1), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        assert out_e.dtype == jnp.bfloat16
+        assert out_m.dtype == jnp.bfloat16
+        assert out_c.dtype == jnp.bfloat16
+        # blacklist entry points go fp32 even on half inputs
+        with amp.auto_cast(policy):
+            s = jax.nn.softmax(jnp.ones((4, 4), jnp.bfloat16))
+        assert s.dtype == jnp.float32
+
+    def test_functional_patch_restores(self):
+        policy = amp.Policy.from_opt_level("O1")
+        orig_einsum = jnp.einsum
+        orig_conv = jax.lax.conv_general_dilated
+        with amp.auto_cast(policy):
+            assert jnp.einsum is not orig_einsum
+            with amp.auto_cast(policy):   # nesting composes
+                assert getattr(jnp.einsum,
+                               "__wrapped_by_apex_tpu__", False)
+            assert jnp.einsum is not orig_einsum
+        assert jnp.einsum is orig_einsum
+        assert jax.lax.conv_general_dilated is orig_conv
+        # restore also on exception
+        try:
+            with amp.auto_cast(policy):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert jnp.einsum is orig_einsum
+
+    def test_o2_does_not_patch(self):
+        policy = amp.Policy.from_opt_level("O2")
+        orig = jnp.einsum
+        with amp.auto_cast(policy):
+            assert jnp.einsum is orig
+
+    def test_nested_policies_innermost_wins(self):
+        p_bf16 = amp.Policy.from_opt_level("O1", half_dtype=jnp.bfloat16)
+        p_fp16 = amp.Policy.from_opt_level("O1", half_dtype=jnp.float16)
+        a = jnp.ones((4, 4), jnp.float32)
+        with amp.auto_cast(p_bf16):
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+            with amp.auto_cast(p_fp16):
+                assert jnp.matmul(a, a).dtype == jnp.float16
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+
+    def test_explicit_module_dtype_not_overridden_by_patch(self):
+        """A flax module with explicit dtype=float32 keeps fp32 compute
+        under O1 even though its body calls the patched lax.conv entry
+        point (interceptor suspends the raw-op patch inside)."""
+        import flax.linen as nn
+
+        policy = amp.Policy.from_opt_level("O1")
+        conv = nn.Conv(4, (3, 3), dtype=jnp.float32)
+        x = jnp.ones((1, 8, 8, 3), jnp.float32)
+        variables = conv.init(jax.random.PRNGKey(0), x)
+        with amp.auto_cast(policy):
+            out = conv.apply(variables, x)
+        assert out.dtype == jnp.float32
+
+    def test_fp32_oracle_unaffected_by_patch(self):
+        from apex_tpu import ops
+
+        policy = amp.Policy.from_opt_level("O1")
+        q = jnp.ones((1, 8, 2, 16), jnp.float32)
+        with amp.auto_cast(policy):
+            out = ops.attention_reference(q, q, q)
+        assert out.dtype == jnp.float32

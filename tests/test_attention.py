@@ -280,7 +280,7 @@ class TestFusedDropout:
             s = jnp.where(cm, s, A.NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
         bq = A._choose_block(A.DEFAULT_BLOCK_Q, sq)
-        bk = A._choose_block(A.DEFAULT_BLOCK_K, sk)
+        bk = A._choose_block(A.DEFAULT_BLOCK_K, sk, lane=True)
         keep = A._keep_mask_dense(jnp.asarray(seed, jnp.int32), b, h,
                                   sq, sk, bq, bk, rate)
         keep = keep.reshape(b, h, sq, sk)
