@@ -1,0 +1,44 @@
+"""The on-device validation harness itself must not rot: run a fast
+subset of its cases in interpret mode on the CI mesh, and check the
+driver/JSON plumbing."""
+
+import json
+
+from apex_tpu.ops import compile_check as cc
+
+
+def test_case_registry_nonempty_and_named():
+    names = [n for n, _ in cc.CASES]
+    assert len(names) >= 20
+    assert len(set(names)) == len(names)
+    for family in ("attention", "layer_norm", "mlp", "xentropy",
+                   "multi_tensor", "optim", "bn_act"):
+        assert any(n.startswith(family + "/") for n in names), family
+
+
+def test_fast_subset_runs_green(tmp_path):
+    out = tmp_path / "cc.json"
+    ok = cc.run(pattern="layer_norm", json_path=str(out))
+    assert ok
+    data = json.loads(out.read_text())
+    assert data["ok"] and data["n_failed"] == 0
+    assert data["backend"] == "cpu" and data["compiled"] is False
+    assert all(r["ok"] for r in data["results"])
+
+
+def test_multi_tensor_case_runs_green():
+    ok = cc.run(pattern="multi_tensor")
+    assert ok
+
+
+def test_failure_is_reported(tmp_path, monkeypatch):
+    def boom():
+        raise AssertionError("intentional")
+
+    monkeypatch.setattr(cc, "CASES", [("fake/boom", boom)])
+    out = tmp_path / "cc.json"
+    ok = cc.run(json_path=str(out))
+    assert not ok
+    data = json.loads(out.read_text())
+    assert data["n_failed"] == 1
+    assert "intentional" in data["results"][0]["error"]
