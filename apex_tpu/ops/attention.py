@@ -39,12 +39,15 @@ from apex_tpu.ops._dispatch import use_interpret
 
 LANES = 128
 # Grid-step overhead on TPU dwarfs the per-tile MXU work at 128-blocks
-# (a 128x128x64 tile is ~4 MFLOP ≈ 20 ns of MXU time); 512-blocks keep
-# the kernel VMEM-comfortable (a 512x512 fp32 score tile is 1 MiB) and
-# measured 13x faster backward at S=512. Long sequences still stream
+# (a 128x128x64 tile is ~4 MFLOP ≈ 20 ns of MXU time). Sequences at or
+# below the default clamp to a single block (so S=512 behaves exactly
+# as the round-2 512-tile default, measured 13x faster backward than
+# 128); longer sequences run 1024-tiles — measured at S=8192/d=64:
+# fwd 27.6 → 54.3 TFLOP/s, fwd+bwd 1.39x vs 512-tiles (a 1024² fp32
+# score tile is 4 MiB, still VMEM-comfortable). Long sequences stream
 # blockwise — this only sets the tile, not the memory complexity.
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
 
 
@@ -225,6 +228,12 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k,
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
+    if bias_g is not None:
+        # a (1, bq, bk) fp32 bias block at 1024-tiles is 4 MiB and,
+        # double-buffered next to the f32 score temporaries, overflows
+        # the 16 MiB scoped VMEM on long sequences (the ring causal-hop
+        # shape) — cap the bias path at the 512 tile that measured fine
+        block_q, block_k = min(block_q, 512), min(block_k, 512)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
@@ -415,6 +424,12 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
+    if bias_g is not None:
+        # a (1, bq, bk) fp32 bias block at 1024-tiles is 4 MiB and,
+        # double-buffered next to the f32 score temporaries, overflows
+        # the 16 MiB scoped VMEM on long sequences (the ring causal-hop
+        # shape) — cap the bias path at the 512 tile that measured fine
+        block_q, block_k = min(block_q, 512), min(block_k, 512)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
@@ -668,8 +683,11 @@ def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
     dp = jnp.einsum("bqhd,bkhd->bhqk", do.astype(jnp.float32),
                     v.astype(jnp.float32))
     if dropout_rate > 0.0:
-        bq = _choose_block(block_q, sq)
-        bk = _choose_block(block_k, sk, lane=True)
+        # mirror the kernels' block choice exactly: the bias path caps
+        # tiles at 512 (see _flash_fwd), and the mask hash is a function
+        # of block coordinates — a different bq/bk is a different mask
+        bq = _choose_block(min(block_q, 512), sq)
+        bk = _choose_block(min(block_k, 512), sk, lane=True)
         keep = _keep_mask_dense(seed[0], b, h, sq, sk, bq, bk,
                                 dropout_rate).reshape(b, h, sq, sk)
         dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)

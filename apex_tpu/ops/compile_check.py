@@ -148,6 +148,23 @@ def _():
     _attn_case(1, 512, 512, 4, 64, dtype=jnp.bfloat16, atol=5e-2)
 
 
+@case("attention/long-2048-1024tiles")
+def _():
+    # multi-block grids at the 1024-tile default (the long-sequence
+    # fast path; also the causal multi-block masking)
+    _attn_case(1, 2048, 2048, 2, 64, causal=True, dtype=jnp.bfloat16,
+               atol=5e-2)
+
+
+@case("attention/long-bias-2048")
+def _():
+    # the ring causal-hop shape: long sequence WITH an additive bias —
+    # the path the 512-tile bias cap protects (a 1024-tile fp32 bias
+    # block would blow the scoped VMEM); grads included
+    _attn_case(1, 2048, 2048, 1, 64, with_bias=True,
+               dtype=jnp.bfloat16, atol=5e-2)
+
+
 @case("attention/dropout-runs-finite")
 def _():
     from apex_tpu.ops.attention import flash_attention
