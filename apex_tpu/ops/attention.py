@@ -42,13 +42,32 @@ LANES = 128
 # (a 128x128x64 tile is ~4 MFLOP ≈ 20 ns of MXU time). Sequences at or
 # below the default clamp to a single block (so S=512 behaves exactly
 # as the round-2 512-tile default, measured 13x faster backward than
-# 128); longer sequences run 1024-tiles — measured at S=8192/d=64:
-# fwd 27.6 → 54.3 TFLOP/s, fwd+bwd 1.39x vs 512-tiles (a 1024² fp32
-# score tile is 4 MiB, still VMEM-comfortable). Long sequences stream
-# blockwise — this only sets the tile, not the memory complexity.
+# 128); longer sequences run 1024-tiles — the PERF.md sweep measured
+# S=8192/d=64 fwd 27.3 → 51.6 TFLOP/s and fwd+bwd 1.35x vs 512-tiles
+# (a 1024² fp32 score tile is 4 MiB, still VMEM-comfortable on the
+# plain path). Long sequences stream blockwise — this only sets the
+# tile, not the memory complexity.
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+
+
+def _block_cap(block_q, block_k, has_bias, dropout_rate):
+    """Tile cap for kernel paths that hold extra full-tile temporaries.
+
+    A (bq, bk) fp32 bias block at 1024-tiles is 4 MiB (double-buffered:
+    8), and dropout adds keep-mask/hash uint32 temporaries of the same
+    footprint — either pushes the kernels past the 16 MiB scoped VMEM
+    on long sequences, so both paths stay on the proven 512 tile.
+
+    ONE definition, used by the forward wrapper, the backward wrapper
+    AND the dense dropout-mask replica (`_bias_grad`): the counter-based
+    mask is a function of block coordinates, so any divergence in the
+    cap silently changes the dropout mask between kernels and the dense
+    replica."""
+    if has_bias or dropout_rate > 0.0:
+        return min(block_q, 512), min(block_k, 512)
+    return block_q, block_k
 
 
 def _choose_block(pref, s, lane: bool = False):
@@ -228,12 +247,8 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k,
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
-    if bias_g is not None:
-        # a (1, bq, bk) fp32 bias block at 1024-tiles is 4 MiB and,
-        # double-buffered next to the f32 score temporaries, overflows
-        # the 16 MiB scoped VMEM on long sequences (the ring causal-hop
-        # shape) — cap the bias path at the 512 tile that measured fine
-        block_q, block_k = min(block_q, 512), min(block_k, 512)
+    block_q, block_k = _block_cap(block_q, block_k, bias_g is not None,
+                                  dropout_rate)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
@@ -424,12 +439,8 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     dp = -(-d // LANES) * LANES
-    if bias_g is not None:
-        # a (1, bq, bk) fp32 bias block at 1024-tiles is 4 MiB and,
-        # double-buffered next to the f32 score temporaries, overflows
-        # the 16 MiB scoped VMEM on long sequences (the ring causal-hop
-        # shape) — cap the bias path at the 512 tile that measured fine
-        block_q, block_k = min(block_q, 512), min(block_k, 512)
+    block_q, block_k = _block_cap(block_q, block_k, bias_g is not None,
+                                  dropout_rate)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
@@ -683,11 +694,12 @@ def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
     dp = jnp.einsum("bqhd,bkhd->bhqk", do.astype(jnp.float32),
                     v.astype(jnp.float32))
     if dropout_rate > 0.0:
-        # mirror the kernels' block choice exactly: the bias path caps
-        # tiles at 512 (see _flash_fwd), and the mask hash is a function
-        # of block coordinates — a different bq/bk is a different mask
-        bq = _choose_block(min(block_q, 512), sq)
-        bk = _choose_block(min(block_k, 512), sk, lane=True)
+        # mirror the kernels' block choice exactly via the SHARED cap
+        # (the mask hash is a function of block coordinates — a
+        # different bq/bk is a different mask)
+        cq, ck = _block_cap(block_q, block_k, True, dropout_rate)
+        bq = _choose_block(cq, sq)
+        bk = _choose_block(ck, sk, lane=True)
         keep = _keep_mask_dense(seed[0], b, h, sq, sk, bq, bk,
                                 dropout_rate).reshape(b, h, sq, sk)
         dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)

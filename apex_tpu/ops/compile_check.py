@@ -168,19 +168,23 @@ def _():
 @case("attention/dropout-runs-finite")
 def _():
     from apex_tpu.ops.attention import flash_attention
-    q = _rand((2, 256, 4, 64), 0)
-    k = _rand((2, 256, 4, 64), 1)
-    v = _rand((2, 256, 4, 64), 2)
+    # S=256 (single block) and S=2048 (multi-block at the capped 512
+    # dropout tile — the VMEM-sensitive combination)
+    for s in (256, 2048):
+        q = _rand((1, s, 2, 64), 0)
+        k = _rand((1, s, 2, 64), 1)
+        v = _rand((1, s, 2, 64), 2)
 
-    def loss(q, k, v):
-        o = flash_attention(q, k, v, dropout_rate=0.1, dropout_seed=7)
-        return jnp.sum(o * o)
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, dropout_rate=0.1,
+                                dropout_seed=7)
+            return jnp.sum(o * o)
 
-    val, grads = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(
-        q, k, v)
-    assert np.isfinite(float(val))
-    for g in grads:
-        assert np.all(np.isfinite(np.asarray(g, np.float32)))
+        val, grads = jax.jit(jax.value_and_grad(
+            loss, argnums=(0, 1, 2)))(q, k, v)
+        assert np.isfinite(float(val))
+        for g in grads:
+            assert np.all(np.isfinite(np.asarray(g, np.float32)))
 
 
 # --- layer norm --------------------------------------------------------------

@@ -279,8 +279,12 @@ class TestFusedDropout:
             cm = np.tril(np.ones((sq, sk), bool), k=sk - sq)
             s = jnp.where(cm, s, A.NEG_INF)
         p = jax.nn.softmax(s, axis=-1)
-        bq = A._choose_block(A.DEFAULT_BLOCK_Q, sq)
-        bk = A._choose_block(A.DEFAULT_BLOCK_K, sk, lane=True)
+        # apply the same tile cap the kernels use (shared definition —
+        # the dropout mask is a function of block coordinates)
+        cq, ck = A._block_cap(A.DEFAULT_BLOCK_Q, A.DEFAULT_BLOCK_K,
+                              False, rate)
+        bq = A._choose_block(cq, sq)
+        bk = A._choose_block(ck, sk, lane=True)
         keep = A._keep_mask_dense(jnp.asarray(seed, jnp.int32), b, h,
                                   sq, sk, bq, bk, rate)
         keep = keep.reshape(b, h, sq, sk)

@@ -50,3 +50,20 @@ def test_dcgan(devices):
     _run_example("dcgan/main_amp.py",
                  ["--niter", "2", "--batchSize", "8", "--ngf", "16",
                   "--ndf", "16", "--print-freq", "2"])
+
+
+def test_imagenet_real_data(devices, tmp_path, capsys):
+    """--data: train from an actual JPEG ImageFolder tree through the
+    apex_tpu.data pipeline (loader probe + prefetch + sharded step)."""
+    pytest.importorskip("PIL")
+    from apex_tpu.data import make_fake_imagefolder
+
+    make_fake_imagefolder(str(tmp_path), n_classes=2, per_class=10,
+                          size=48)
+    _run_example("imagenet/main_amp.py",
+                 ["--data", str(tmp_path), "-b", "16",
+                  "--steps-per-epoch", "2", "--image-size", "32",
+                  "--arch", "resnet18", "--print-freq", "2",
+                  "--loader-workers", "2"])
+    out = capsys.readouterr().out
+    assert "loader:" in out and "img/s" in out
