@@ -1,0 +1,319 @@
+"""Conv → BN (+residual) (+ReLU) unit with a *distributed-dgrad* VJP.
+
+The round-3 PERF.md sketch (executed here, per VERDICT r3 item 1): BN's
+input gradient is linear in three per-channel-scaled fields,
+
+    dx = A⊙g + B⊙x + C⊙1,   A = γi,  B = −γi²k₂,  C = A·(ik₂μ − k₁)
+
+(g = masked upstream cotangent, i = invstd, k₁ = Σg/n, k₂ = Σg·x̂/n), so
+the producing convolution's input/weight gradients distribute over the
+three terms and ``dx`` itself never has to materialize:
+
+    da = dgrad(g, A⊙W) + dgrad(x, B⊙W) + dgrad-const
+    dW = A⊙wgrad(a, g) + B⊙wgrad(a, x) + C⊙wgrad(a, 1)
+
+Two TPU-specific observations shape (and bound) the design:
+
+1. **Per-channel scales fold into the weights, never the operands.** XLA
+   materializes convolution operands — ``dgrad(A⊙g, W)`` would write and
+   re-read a full activation-sized scaled copy. But A acts on the
+   contracting (output-channel) axis, so ``dgrad(A⊙g, W) ≡ dgrad(g, A⊙W)``
+   and scaling W is free. Likewise wgrad's scale lands on its (tiny)
+   output. The constant term C⊙1 is batch-independent: its dgrad runs on
+   an N=1 ones-field and broadcasts; its wgrad reduces to box-sums of the
+   batch-summed input.
+2. **The masked gradient is still a conv operand.** The ReLU mask is
+   elementwise, so g must materialize before feeding dgrad — exactly the
+   write the old dx pass performed. For plain/ReLU units the C-term folds
+   into that same materialization (g′ = mask⊙dz + (ik₂μ−k₁)); for
+   residual joins ``dr`` (= mask⊙dz) is an obligatory output anyway and
+   feeds the convs raw.
+
+Byte ledger (per unit, T = |x| = |g|, I = |a|): the restructure trades
+the dx chain (write T + dgrad read T + wgrad read T) for a second full
+dgrad (read T, write I) and a second full wgrad (read I + T), i.e. it
+*removes* 3T but *adds* 2T + 3I — strictly negative for plain/ReLU units
+and break-even only when I < T/3 with the cotangent already materialized
+(the 1×1 expansion joins, I = T/4). Measured on the chip in PERF.md
+round 4; this module is the experiment, kept behind
+``ResNet(dx_distribute=...)`` / ``APEX_TPU_DX_DISTRIBUTE``.
+
+This is the TPU analysis of the role the reference's fused NHWC BN
+backward kernels play (`apex/contrib/csrc/groupbn/nhwc_batch_norm_kernel.h`,
+`csrc/welford.cu:259-903`): those fuse the dx pass into hand-written
+kernels because CUDA kernels stream operands; XLA convs cannot, which is
+where the accounting diverges.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import flax.linen as nn
+
+from apex_tpu.ops.bn_act import (
+    _Cfg, _fwd_common, _normalize_groups, _reduce_axes, make_cfg,
+)
+
+__all__ = ["conv_bn_act_train", "conv_bn_add_act_train", "ConvBNAct",
+           "make_conv_cfg"]
+
+
+class _ConvCfg(NamedTuple):
+    """Static conv + BN configuration (hashable custom_vjp nondiff arg)."""
+    strides: Tuple[int, int]
+    padding: Any            # "SAME" | "VALID" | ((lo,hi),(lo,hi))
+    relu: bool
+    eps: float
+    axis_name: Optional[str]
+    groups: Optional[Tuple[Tuple[int, ...], ...]]
+
+    def bn(self) -> _Cfg:
+        return _Cfg(relu=self.relu, eps=self.eps, axis_name=self.axis_name,
+                    groups=self.groups)
+
+
+def make_conv_cfg(*, strides=(1, 1), padding="SAME", relu: bool,
+                  eps: float = 1e-5, axis_name: Optional[str] = None,
+                  axis_index_groups=None) -> _ConvCfg:
+    if not isinstance(padding, str):
+        padding = tuple(tuple(int(p) for p in pair) for pair in padding)
+    return _ConvCfg(strides=tuple(int(s) for s in strides),
+                    padding=padding, relu=bool(relu), eps=float(eps),
+                    axis_name=axis_name,
+                    groups=_normalize_groups(axis_index_groups))
+
+
+def _conv(a, w, cfg: _ConvCfg):
+    return jax.lax.conv_general_dilated(
+        a, w, window_strides=cfg.strides, padding=cfg.padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _channel_terms(cfg, dz_masked32, x, scale, mean, invstd, count):
+    """Channel sums (psum'd over the stats group) and the per-channel
+    distribution coefficients A, B and c′ = C/A (γ-free, safe at γ=0)."""
+    axes = _reduce_axes(x)
+    cshape = (1,) * len(axes) + (-1,)
+    xhat = (x.astype(jnp.float32) - mean.reshape(cshape)) \
+        * invstd.reshape(cshape)
+    sum_dy = jnp.sum(dz_masked32, axis=axes)
+    sum_dy_xhat = jnp.sum(dz_masked32 * xhat, axis=axes)
+    if cfg.axis_name is not None:
+        sum_dy, sum_dy_xhat = jax.lax.psum(
+            (sum_dy, sum_dy_xhat), cfg.axis_name,
+            axis_index_groups=cfg.groups)
+    k1 = sum_dy / count
+    k2 = sum_dy_xhat / count
+    gam = scale.astype(jnp.float32)
+    A = gam * invstd
+    B = -gam * invstd * invstd * k2
+    cprime = invstd * k2 * mean - k1
+    return sum_dy, sum_dy_xhat, A, B, cprime
+
+
+def _fold(w, s):
+    """Scale the conv kernel along its output-channel (HWIO: last) axis."""
+    return (w.astype(jnp.float32) * s).astype(w.dtype)
+
+
+def _distributed_grads(cfg, a, w, x, gp, A, B, cprime_in_gp: bool,
+                       C=None):
+    """da and dW via term-distributed conv transposes.
+
+    ``gp`` is the materialized masked-gradient operand (with c′ folded in
+    when ``cprime_in_gp``); when it is not folded, ``C`` carries the
+    constant term, handled batch-independently (N=1 dgrad broadcast +
+    batch-summed wgrad).
+    """
+    # input gradients: scales folded into the weights
+    _, vjp_a1 = jax.vjp(lambda a_: _conv(a_, _fold(w, A), cfg), a)
+    (da,) = vjp_a1(gp.astype(a.dtype))
+    _, vjp_a2 = jax.vjp(lambda a_: _conv(a_, _fold(w, B), cfg), a)
+    (da2,) = vjp_a2(x)
+    da = da + da2
+
+    # weight gradients: scales land on the (weight-shaped) outputs
+    _, vjp_w = jax.vjp(lambda w_: _conv(a, w_, cfg), w)
+    (dw1,) = vjp_w(gp.astype(x.dtype))
+    (dw2,) = vjp_w(x)
+    dw = A * dw1.astype(jnp.float32) + B * dw2.astype(jnp.float32)
+
+    if not cprime_in_gp:
+        # constant term C⊙1: batch-independent, so dgrad runs once on an
+        # N=1 ones-field (C folded into W) and broadcasts over batch
+        ones1 = jnp.ones((1,) + x.shape[1:], x.dtype)
+        _, vjp_a3 = jax.vjp(lambda a_: _conv(a_, _fold(w, C), cfg), a[:1])
+        (da3,) = vjp_a3(ones1)
+        da = da + da3  # broadcasts over N
+        # wgrad(a, C⊙1) = C ⊙ wgrad(Σ_n a, 1): linear in a, cotangent
+        # constant over batch — one channel/box reduce of a, tiny conv
+        asum = jnp.sum(a.astype(jnp.float32), axis=0,
+                       keepdims=True).astype(a.dtype)
+        _, vjp_w3 = jax.vjp(lambda w_: _conv(asum, w_, cfg), w)
+        (dw3,) = vjp_w3(ones1)
+        dw = dw + C * dw3.astype(jnp.float32)
+    return da, dw.astype(jnp.float32)
+
+
+# --- conv → BN (+ReLU), no residual -----------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def conv_bn_act_train(a, w, scale, bias, cfg: _ConvCfg):
+    """Training-mode ``relu?(bn(conv(a, w)))`` with the distributed-dgrad
+    backward. Returns ``(z, mean, biased_var, count)`` like
+    :func:`apex_tpu.ops.bn_act.bn_act_train`."""
+    x = _conv(a, w, cfg)
+    z, mean, var, count, _ = _fwd_common(x, None, scale, bias, cfg.bn())
+    return z, mean, var, count
+
+
+def _cba_fwd(a, w, scale, bias, cfg):
+    x = _conv(a, w, cfg)
+    z, mean, var, count, invstd = _fwd_common(x, None, scale, bias,
+                                              cfg.bn())
+    return (z, mean, var, count), (a, w, x, scale, bias, mean, invstd,
+                                   count)
+
+
+def _cba_bwd(cfg, res, cts):
+    dz = cts[0]
+    a, w, x, scale, bias, mean, invstd, count = res
+    axes = _reduce_axes(x)
+    cshape = (1,) * len(axes) + (-1,)
+
+    g32 = dz.astype(jnp.float32)
+    if cfg.relu:
+        xhat = (x.astype(jnp.float32) - mean.reshape(cshape)) \
+            * invstd.reshape(cshape)
+        pre = xhat * scale.astype(jnp.float32).reshape(cshape) \
+            + bias.astype(jnp.float32).reshape(cshape)
+        g32 = jnp.where(pre > 0, g32, 0.0)
+
+    sum_dy, sum_dy_xhat, A, B, cprime = _channel_terms(
+        cfg, g32, x, scale, mean, invstd, count)
+
+    # g′ = mask⊙dz + c′ — the one materialized operand (replaces the old
+    # dx pass write byte-for-byte)
+    gp = g32 + cprime.reshape(cshape)
+    da, dw = _distributed_grads(cfg, a, w, x, gp, A, B,
+                                cprime_in_gp=True)
+    return da, dw.astype(jnp.float32), sum_dy_xhat.astype(scale.dtype), \
+        sum_dy.astype(bias.dtype)
+
+
+conv_bn_act_train.defvjp(_cba_fwd, _cba_bwd)
+
+
+# --- conv → BN + residual (+ReLU) -------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def conv_bn_add_act_train(a, w, r, scale, bias, cfg: _ConvCfg):
+    """Training-mode ``relu?(bn(conv(a, w)) + r)`` — the residual-join
+    unit with the distributed backward. ``dr`` materializes once (it is a
+    returned cotangent) and feeds the dgrad/wgrad terms raw; the constant
+    term is handled batch-independently."""
+    x = _conv(a, w, cfg)
+    z, mean, var, count, _ = _fwd_common(x, r, scale, bias, cfg.bn())
+    return z, mean, var, count
+
+
+def _cbaa_fwd(a, w, r, scale, bias, cfg):
+    x = _conv(a, w, cfg)
+    z, mean, var, count, invstd = _fwd_common(x, r, scale, bias, cfg.bn())
+    zres = z if cfg.relu else None
+    rtok = jnp.zeros((), r.dtype)
+    return (z, mean, var, count), (a, w, x, scale, bias, mean, invstd,
+                                   count, zres, rtok)
+
+
+def _cbaa_bwd(cfg, res, cts):
+    dz = cts[0]
+    a, w, x, scale, bias, mean, invstd, count, z, rtok = res
+
+    if cfg.relu:
+        dr = jnp.where(z > 0, dz, jnp.zeros((), dz.dtype)) \
+            .astype(rtok.dtype)
+    else:
+        dr = dz.astype(rtok.dtype)
+
+    sum_dy, sum_dy_xhat, A, B, cprime = _channel_terms(
+        cfg, dr.astype(jnp.float32), x, scale, mean, invstd, count)
+    C = A * cprime
+    da, dw = _distributed_grads(cfg, a, w, x, dr, A, B,
+                                cprime_in_gp=False, C=C)
+    return da, dw.astype(jnp.float32), dr, \
+        sum_dy_xhat.astype(scale.dtype), sum_dy.astype(bias.dtype)
+
+
+conv_bn_add_act_train.defvjp(_cbaa_fwd, _cbaa_bwd)
+
+
+# --- flax module -------------------------------------------------------------
+
+class ConvBNAct(nn.Module):
+    """Conv (no bias) → BN (+residual) (+ReLU) as one VJP unit with the
+    distributed-dgrad backward. Parameter layout: ``kernel`` (HWIO, fp32)
+    + ``scale``/``bias`` + ``batch_stats`` — note this differs from the
+    separate ``nn.Conv`` + ``FusedBNAct`` tree (experiment module; see
+    docs/models.md).
+    """
+    features: int
+    kernel_size: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    relu: bool = True
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    axis_name: Optional[str] = None
+    init_scale: float = 1.0
+    dtype: Optional[Any] = None
+
+    @nn.compact
+    def __call__(self, a, residual=None, train: bool = True):
+        c = self.features
+        kshape = tuple(self.kernel_size) + (a.shape[-1], c)
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            kshape, jnp.float32)
+        scale = self.param("scale",
+                           nn.initializers.constant(self.init_scale),
+                           (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda *_: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda *_: jnp.ones((c,), jnp.float32))
+
+        if self.dtype is not None:
+            a = a.astype(self.dtype)
+            if residual is not None:
+                residual = residual.astype(self.dtype)
+        w = kernel.astype(a.dtype)
+
+        axis = None if self.is_initializing() else self.axis_name
+        cfg = make_conv_cfg(strides=self.strides, relu=self.relu,
+                            eps=self.epsilon, axis_name=axis)
+
+        if not train:
+            x = _conv(a, w, cfg)
+            inv = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            from apex_tpu.ops.bn_act import _apply
+            y = _apply(x.astype(jnp.float32), residual, scale, bias,
+                       ra_mean.value, inv, self.relu)
+            return y.astype(a.dtype)
+
+        if residual is None:
+            z, mean, var, count = conv_bn_act_train(a, w, scale, bias,
+                                                    cfg)
+        else:
+            z, mean, var, count = conv_bn_add_act_train(
+                a, w, residual, scale, bias, cfg)
+
+        if not self.is_initializing():
+            unbiased = var * count / jnp.maximum(count - 1.0, 1.0)
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1 - m) * mean
+            ra_var.value = m * ra_var.value + (1 - m) * unbiased
+        return z

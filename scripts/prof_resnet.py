@@ -28,8 +28,9 @@ def main():
     from apex_tpu.optim import FusedSGD
 
     policy = amp.Policy.from_opt_level("O2")
+    dx_dist = os.environ.get("APEX_TPU_DX_DISTRIBUTE") or None
     model = models.ResNet50(num_classes=1000, dtype=policy.compute_dtype,
-                            fused_bn=fused)
+                            fused_bn=fused, dx_distribute=dx_dist)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
     y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)

@@ -1,0 +1,232 @@
+"""Distributed-dgrad conv+BN unit (ops/conv_bn.py) vs the autodiff oracle.
+
+The unit's backward distributes the conv transposes over the three
+linear terms of BN's dx (weight-folded scales, batch-independent
+constant term); these tests pin it bitwise-close to plain autodiff of
+``relu?(bn(conv(a, w)) [+ r])`` in fp32, across kernel sizes, strides,
+residual joins, and the zero-init-γ corner (the ResNet recipe).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.ops.conv_bn import (
+    ConvBNAct, conv_bn_act_train, conv_bn_add_act_train, make_conv_cfg,
+)
+
+
+def _oracle(a, w, scale, bias, r, *, strides, relu, eps=1e-5):
+    x = jax.lax.conv_general_dilated(
+        a, w, window_strides=strides, padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    mean = jnp.mean(x, axis=(0, 1, 2))
+    var = jnp.mean(jnp.square(x - mean), axis=(0, 1, 2))
+    y = (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    if r is not None:
+        y = y + r
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y
+
+
+def _rand(rng, shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+@pytest.mark.parametrize("ksize,strides", [
+    ((1, 1), (1, 1)), ((3, 3), (1, 1)), ((3, 3), (2, 2)),
+    ((1, 1), (2, 2)),
+])
+@pytest.mark.parametrize("relu", [True, False])
+def test_conv_bn_act_grads_match_autodiff(ksize, strides, relu):
+    rng = np.random.default_rng(0)
+    a = _rand(rng, (4, 8, 8, 6))
+    w = _rand(rng, ksize + (6, 5)) * 0.3
+    scale = _rand(rng, (5,)) * 0.5 + 1.0
+    bias = _rand(rng, (5,)) * 0.2
+    cfg = make_conv_cfg(strides=strides, relu=relu)
+    t_shape = _oracle(a, w, scale, bias, None, strides=strides,
+                      relu=relu).shape
+    t = _rand(rng, t_shape)
+
+    def loss_unit(a, w, scale, bias):
+        z, *_ = conv_bn_act_train(a, w, scale, bias, cfg)
+        return jnp.sum(z * t)
+
+    def loss_ref(a, w, scale, bias):
+        return jnp.sum(_oracle(a, w, scale, bias, None, strides=strides,
+                               relu=relu) * t)
+
+    got = jax.grad(loss_unit, argnums=(0, 1, 2, 3))(a, w, scale, bias)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(a, w, scale, bias)
+    for g, wnt, name in zip(got, want, ["da", "dw", "dscale", "dbias"]):
+        np.testing.assert_allclose(g, wnt, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+
+
+@pytest.mark.parametrize("relu", [True, False])
+@pytest.mark.parametrize("zero_gamma", [False, True])
+def test_conv_bn_add_act_grads_match_autodiff(relu, zero_gamma):
+    rng = np.random.default_rng(1)
+    a = _rand(rng, (4, 8, 8, 4))
+    w = _rand(rng, (1, 1, 4, 8)) * 0.3
+    scale = (jnp.zeros((8,)) if zero_gamma
+             else _rand(rng, (8,)) * 0.5 + 1.0)
+    bias = _rand(rng, (8,)) * 0.2
+    r = _rand(rng, (4, 8, 8, 8))
+    cfg = make_conv_cfg(strides=(1, 1), relu=relu)
+    t = _rand(rng, (4, 8, 8, 8))
+
+    def loss_unit(a, w, r, scale, bias):
+        z, *_ = conv_bn_add_act_train(a, w, r, scale, bias, cfg)
+        return jnp.sum(z * t)
+
+    def loss_ref(a, w, r, scale, bias):
+        return jnp.sum(_oracle(a, w, scale, bias, r, strides=(1, 1),
+                               relu=relu) * t)
+
+    got = jax.grad(loss_unit, argnums=(0, 1, 2, 3, 4))(a, w, r, scale,
+                                                       bias)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(a, w, r, scale,
+                                                       bias)
+    for g, wnt, name in zip(got, want,
+                            ["da", "dw", "dr", "dscale", "dbias"]):
+        np.testing.assert_allclose(g, wnt, rtol=2e-4, atol=2e-4,
+                                   err_msg=name)
+
+
+def test_conv_bn_forward_stats():
+    rng = np.random.default_rng(2)
+    a = _rand(rng, (2, 6, 6, 3))
+    w = _rand(rng, (3, 3, 3, 4)) * 0.3
+    scale = jnp.ones((4,))
+    bias = jnp.zeros((4,))
+    cfg = make_conv_cfg(strides=(1, 1), relu=True)
+    z, mean, var, count = conv_bn_act_train(a, w, scale, bias, cfg)
+    x = jax.lax.conv_general_dilated(
+        a, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(mean, jnp.mean(x, (0, 1, 2)), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(
+        var, jnp.mean(jnp.square(x - jnp.mean(x, (0, 1, 2))), (0, 1, 2)),
+        rtol=1e-4, atol=1e-5)
+    assert count == float(x.size // x.shape[-1])
+    assert z.shape == x.shape
+
+
+# baseline (separate Conv_i + _BN_i) → dist-"all" (ConvBNAct_i) block
+# leaf mapping. Creation order: Conv_2 = final 1x1 (→ join unit CBA_3),
+# Conv_3 = projection (→ CBA_2) — the checkpoint-layout ordering.
+_BLOCK_MAP = {
+    ("Conv_0", "kernel"): ("ConvBNAct_0", "kernel"),
+    ("Conv_1", "kernel"): ("ConvBNAct_1", "kernel"),
+    ("Conv_3", "kernel"): ("ConvBNAct_2", "kernel"),
+    ("Conv_2", "kernel"): ("ConvBNAct_3", "kernel"),
+    ("_BN_0",): ("ConvBNAct_0",),
+    ("_BN_1",): ("ConvBNAct_1",),
+    ("_BN_2",): ("ConvBNAct_2",),
+    ("_BN_3",): ("ConvBNAct_3",),
+}
+
+
+def _map_block_params(bp, *, params):
+    """Re-key one baseline BottleneckBlock subtree into the dist-'all'
+    ConvBNAct layout (params=True) or batch_stats (params=False)."""
+    out = {}
+    for (src, *rest), (dst, *_) in _BLOCK_MAP.items():
+        if src.startswith("Conv"):
+            if params:
+                out.setdefault(dst, {})["kernel"] = bp[src]["kernel"]
+        else:
+            leaf = bp[src]["FusedBNAct_0"]
+            for k, v in leaf.items():
+                out.setdefault(_BLOCK_MAP[(src,)][0], {})[k] = v
+    return out
+
+
+def test_resnet_dx_distribute_matches_baseline_grads():
+    """Full-model integration: with parameters copied leaf-for-leaf into
+    the fused tree, dist-'all' must reproduce the baseline's loss AND
+    every parameter gradient (mapped back) to fp32 tolerance — this
+    exercises the cfg wiring, residual paths and stat plumbing of the
+    ConvBNAct units inside the real BottleneckBlock."""
+    from apex_tpu.models import ResNet
+    from apex_tpu.models.resnet import BottleneckBlock
+    from apex_tpu.ops import softmax_cross_entropy_loss
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((4, 32, 32, 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 7, 4), jnp.int32)
+
+    def build(mode):
+        return ResNet(stage_sizes=[1], block=BottleneckBlock,
+                      num_classes=7, width=8, dx_distribute=mode)
+
+    base, dist = build(None), build("all")
+    vb = base.init(jax.random.PRNGKey(0), x, train=True)
+    nb = sum(t.size for t in jax.tree_util.tree_leaves(vb["params"]))
+    vd_shape = dist.init(jax.random.PRNGKey(0), x, train=True)
+    nd = sum(t.size for t in
+             jax.tree_util.tree_leaves(vd_shape["params"]))
+    assert nb == nd, "fused units must not change the parameter count"
+
+    # copy baseline params/stats into the dist tree
+    def remap(tree, params):
+        out = {}
+        for k, v in tree.items():
+            if k.startswith("BottleneckBlock"):
+                out[k] = _map_block_params(v, params=params)
+            else:
+                out[k] = v
+        return out
+
+    pd = remap(vb["params"], params=True)
+    sd = remap(vb["batch_stats"], params=False)
+    chex_leaves_b = jax.tree_util.tree_structure(vd_shape["params"])
+    assert jax.tree_util.tree_structure(pd) == chex_leaves_b
+
+    def loss_fn(model, params, stats):
+        logits, mut = model.apply(
+            {"params": params, "batch_stats": stats}, x, train=True,
+            mutable=["batch_stats"])
+        return jnp.mean(softmax_cross_entropy_loss(logits, y)), \
+            mut["batch_stats"]
+
+    (lb, bsb), gb = jax.value_and_grad(
+        lambda p: loss_fn(base, p, vb["batch_stats"]),
+        has_aux=True)(vb["params"])
+    (ld, bsd), gd = jax.value_and_grad(
+        lambda p: loss_fn(dist, p, sd), has_aux=True)(pd)
+
+    np.testing.assert_allclose(float(lb), float(ld), rtol=1e-5)
+    # gradients: map the dist grads back and compare every leaf
+    for k, v in gb.items():
+        vd_g = gd[k]
+        if k.startswith("BottleneckBlock"):
+            vd_g_mapped = _map_block_params(v, params=True)  # structure
+            for (src, *rest), (dst, *_) in _BLOCK_MAP.items():
+                if src.startswith("Conv"):
+                    np.testing.assert_allclose(
+                        v[src]["kernel"], gd[k][dst]["kernel"],
+                        rtol=5e-4, atol=5e-5, err_msg=f"{k}/{src}")
+                else:
+                    for leaf in ("scale", "bias"):
+                        np.testing.assert_allclose(
+                            v[src]["FusedBNAct_0"][leaf],
+                            gd[k][dst][leaf], rtol=5e-4, atol=5e-5,
+                            err_msg=f"{k}/{src}/{leaf}")
+        else:
+            for (pa, ga), (pb_, gb_) in zip(
+                    jax.tree_util.tree_leaves_with_path(v),
+                    jax.tree_util.tree_leaves_with_path(vd_g)):
+                np.testing.assert_allclose(ga, gb_, rtol=5e-4,
+                                           atol=5e-5, err_msg=str(k))
+    # updated running stats must agree too (stat plumbing)
+    for (pa, a), (pb_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(remap(bsb, params=False)),
+            jax.tree_util.tree_leaves_with_path(bsd)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5,
+                                   err_msg=jax.tree_util.keystr(pa))
