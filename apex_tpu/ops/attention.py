@@ -91,7 +91,7 @@ def _choose_block(pref, s, lane: bool = False):
     return b
 
 
-def _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp):
+def _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp, itemsize=2):
     """Batch·head rows per grid step for the flash kernels.
 
     One-row steps leave the core waiting on per-step DMA setup (~2.3us
@@ -100,13 +100,15 @@ def _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp):
     dropout hash's program_id coordinates assume one row per step, and
     the lse block layout needs a single q-block — so bias/dropout/nq>1
     keep g=1. Bounded by a ~9 MiB VMEM estimate (in-blocks double-
-    buffered + f32 accumulators)."""
+    buffered + f32 accumulators); ``itemsize`` is the q/k/v element
+    size — the kernels keep inputs in their native dtype, so fp32
+    inputs halve the attainable packing (ADVICE r3 item 1)."""
     if has_bias or dropout_rate > 0.0 or nq != 1:
         return 1
     for g in (4, 2):
         if bh % g:
             continue
-        half_bufs = g * (bq + 2 * bk) * dp * 2 * 2
+        half_bufs = g * (bq + 2 * bk) * dp * 2 * itemsize
         scratch = g * bq * (2 * LANES + 2 * dp) * 4
         if half_bufs + scratch <= 9 * 2 ** 20:
             return g
@@ -260,7 +262,8 @@ def _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q, block_k,
     nq, nk = sqp // bq, skp // bk
 
     has_bias = bias_g is not None
-    g = _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp)
+    g = _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp,
+                q3.dtype.itemsize)
     in_specs = [
         pl.BlockSpec((g, bq, dp), lambda b, i, j: (b, i, 0),
                      memory_space=pltpu.VMEM),
@@ -471,7 +474,8 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
     if has_bias:
         bias_p = jnp.pad(bias_g, ((0, 0), (0, sqp - sq), (0, skp - sk)))
 
-    g = _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp)
+    g = _g_pack(bh, nq, has_bias, dropout_rate, bq, bk, dp,
+                q3.dtype.itemsize)
     q_spec_q = pl.BlockSpec((g, bq, dp), lambda b, i, j: (b, i, 0),
                             memory_space=pltpu.VMEM)
     k_spec_q = pl.BlockSpec((g, bk, dp), lambda b, i, j: (b, j, 0),
