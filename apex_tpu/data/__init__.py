@@ -15,8 +15,10 @@ from apex_tpu.data.pipeline import (
     measure_source,
     synthetic_source,
 )
+from apex_tpu.data.packed import PackedSource, build_cache
 
 __all__ = [
     "DevicePrefetcher", "ImageFolderSource", "make_fake_imagefolder",
     "measure_source", "synthetic_source",
+    "PackedSource", "build_cache",
 ]

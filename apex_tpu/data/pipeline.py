@@ -128,6 +128,18 @@ class ImageFolderSource:
             n += 1
         return n
 
+    def close(self) -> None:
+        """Shut the decode pool down (idempotent). Sources used for a
+        one-off probe should be closed so their worker threads don't
+        outlive the measurement."""
+        self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
         rng = np.random.RandomState(self.seed + self._epoch)
         order = rng.permutation(len(self.paths))

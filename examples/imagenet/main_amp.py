@@ -77,6 +77,10 @@ def parse_args():
     parser.add_argument("--opt-level", type=str, default="O2")
     parser.add_argument("--keep-batchnorm-fp32", type=str, default=None)
     parser.add_argument("--loss-scale", type=str, default=None)
+    parser.add_argument("--cache", metavar="CACHEDIR", default=None,
+                        help="packed pre-decoded uint8 shard cache "
+                             "(built from --data on first use) — the "
+                             "DALI-class input path")
     parser.add_argument("--prefetch", default=2, type=int)
     parser.add_argument("--loader-workers", default=None, type=int,
                         help="decode threads for --data (default: cores)")
@@ -129,6 +133,13 @@ def main():
     state = amp_opt.init(params)
 
     def step(state, batch_stats, xb, yb):
+        if xb.dtype == jnp.uint8:
+            # packed-cache raw mode: normalize on-device (the DALI
+            # GPU-side normalize — quarters host->device bytes and
+            # keeps the single host core off the float convert)
+            xb = xb.astype(policy.compute_dtype or jnp.float32) \
+                * (1.0 / 255.0)
+
         def loss_fn(mp):
             logits, mut = model.apply(
                 {"params": mp, "batch_stats": batch_stats}, xb, train=True,
@@ -152,17 +163,40 @@ def main():
 
     batch_sharding = parallel.batch_sharding(mesh)
     folder = None
-    if args.data:
+    if args.data and args.cache:
+        from apex_tpu.data import PackedSource, build_cache
+        build_cache(args.data, args.cache)
+        # raw uint8 out: augmented crops ship as-is and normalize
+        # on-device in the step (see the uint8 branch there)
+        folder = PackedSource(args.cache, args.batch_size,
+                              args.image_size, dtype=np.uint8,
+                              workers=args.loader_workers)
+    elif args.data:
         folder = ImageFolderSource(
             args.data, args.batch_size, args.image_size,
             workers=args.loader_workers)
+    if folder is not None:
         # loader-only throughput probe: input-bound configs announced up
-        # front instead of silently capping the training numbers
-        probe = measure_source(
-            folder.batches(min(6, args.steps_per_epoch) + 1),
-            steps=min(5, args.steps_per_epoch))
-        print(f"loader: {probe:.0f} img/s with {folder.workers} decode "
-              f"threads (training is input-bound below this rate)")
+        # front instead of silently capping the training numbers. Runs
+        # on its OWN source instance — probing the training source would
+        # advance its epoch/shuffle state and make seeded runs
+        # non-reproducible (ADVICE r3 item 3).
+        if args.cache:
+            from apex_tpu.data import PackedSource
+            probe_ctx = PackedSource(args.cache, args.batch_size,
+                                     args.image_size, dtype=np.uint8,
+                                     workers=args.loader_workers)
+        else:
+            probe_ctx = ImageFolderSource(args.data, args.batch_size,
+                                          args.image_size,
+                                          workers=args.loader_workers)
+        with probe_ctx as probe_src:
+            probe = measure_source(
+                probe_src.batches(min(6, args.steps_per_epoch) + 1),
+                steps=min(5, args.steps_per_epoch))
+        print(f"loader: {probe:.0f} img/s with {folder.workers} "
+              f"{'cache-read' if args.cache else 'decode'} threads "
+              f"(training is input-bound below this rate)")
     for epoch in range(args.epochs):
         src = (folder.batches(args.steps_per_epoch)
                if folder is not None else
@@ -170,8 +204,14 @@ def main():
                                  args.steps_per_epoch, seed=epoch))
         # transfer inputs pre-cast to the compute dtype — the reference
         # prefetcher's side-stream half cast (`main_amp.py:264-317`);
-        # halves host->device bytes under O2/O3
-        cast = (policy.compute_dtype if policy.cast_model_type is not None
+        # halves host->device bytes under O2/O3. Packed-cache batches
+        # ship raw uint8 (already the smallest wire format; the step
+        # normalizes on-device), so no host cast for THAT source —
+        # keyed on the actual source kind, not the flag (synthetic
+        # runs that happen to pass --cache still want the half cast).
+        uint8_src = folder is not None and args.cache is not None
+        cast = (policy.compute_dtype
+                if policy.cast_model_type is not None and not uint8_src
                 else None)
         pre = Prefetcher(src, sharding=batch_sharding, cast_dtype=cast,
                          depth=args.prefetch)
