@@ -60,6 +60,48 @@ _FLOAT_TARGETS = (
     (jax.nn, "log_softmax"),
 )
 
+# user-registered raw targets (the reference lets users register *any*
+# function for O1 treatment, `apex/amp/amp.py:30-64`; the built-in
+# tuples above are the fixed surface, these extend it at runtime via
+# ``amp.register_half_op((module, attr))`` — see lists.register_half_op)
+_USER_HALF_TARGETS: list = []
+_USER_FLOAT_TARGETS: list = []
+
+
+def register_raw_target(module, attr: str, kind: str) -> None:
+    """Register a user-owned ``module.attr`` callable for the raw-op O1
+    treatment ('half' or 'float'). Takes effect immediately if an
+    ``auto_cast`` scope is active, and on every subsequent scope.
+    Re-registering with the other kind moves the target."""
+    if kind not in ("half", "float"):
+        raise ValueError(f"kind must be 'half' or 'float', got {kind!r}")
+    fn = getattr(module, attr)
+    if not callable(fn):
+        raise TypeError(f"{attr!r} on {module!r} is not callable")
+    key = (module, attr)
+    with _lock:
+        for lst in (_USER_HALF_TARGETS, _USER_FLOAT_TARGETS):
+            if key in lst:
+                lst.remove(key)
+        (_USER_HALF_TARGETS if kind == "half"
+         else _USER_FLOAT_TARGETS).append(key)
+        if _patch_count > 0:
+            # live scope: (re)wrap now. A target may appear in
+            # _originals more than once (user target overlapping a
+            # built-in): restore the FIRST-pushed entry — the true
+            # original — and drop every record, so wrappers never stack
+            # or leak past the scope exit.
+            matches = [i for i, (mod, name, _) in enumerate(_originals)
+                       if (mod, name) == key]
+            if matches:
+                setattr(module, attr, _originals[matches[0]][2])
+                for i in reversed(matches):
+                    del _originals[i]
+            orig = getattr(module, attr)
+            _originals.append((module, attr, orig))
+            wrap = _wrap_half if kind == "half" else _wrap_float
+            setattr(module, attr, wrap(orig))
+
 _lock = threading.Lock()
 _patch_count = 0             # processwide: are the setattr patches in?
 _originals: list = []
@@ -124,14 +166,21 @@ def patch_functional(policy) -> None:
         _patch_count += 1
         if _patch_count > 1:
             return
-        for mod, name in _HALF_TARGETS:
-            orig = getattr(mod, name)
-            _originals.append((mod, name, orig))
-            setattr(mod, name, _wrap_half(orig))
-        for mod, name in _FLOAT_TARGETS:
-            orig = getattr(mod, name)
-            _originals.append((mod, name, orig))
-            setattr(mod, name, _wrap_float(orig))
+        seen = set()
+        # user registrations out-prioritize the built-ins (the
+        # reference's "user wrapper wins"): wrap each target once
+        for targets, wrap in (
+                (_USER_HALF_TARGETS, _wrap_half),
+                (_USER_FLOAT_TARGETS, _wrap_float),
+                (_HALF_TARGETS, _wrap_half),
+                (_FLOAT_TARGETS, _wrap_float)):
+            for mod, name in targets:
+                if (id(mod), name) in seen:
+                    continue
+                seen.add((id(mod), name))
+                orig = getattr(mod, name)
+                _originals.append((mod, name, orig))
+                setattr(mod, name, wrap(orig))
 
 
 def unpatch_functional() -> None:

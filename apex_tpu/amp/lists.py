@@ -72,13 +72,27 @@ def classify(op_name: str) -> str:
     return "neutral"
 
 
-def register_half_op(name: str) -> None:
+def register_half_op(name) -> None:
+    """Classify op ``name`` (str) as half, or — given a ``(module,
+    attr)`` pair — give a user-owned *raw function* the O1
+    functional-patch half treatment (the reference's arbitrary-function
+    registration, `apex/amp/amp.py:30-64`)."""
+    if not isinstance(name, str):
+        from apex_tpu.amp.functional_patch import register_raw_target
+        register_raw_target(name[0], name[1], "half")
+        return
     FLOAT_OPS.discard(name)
     PROMOTE_OPS.discard(name)
     HALF_OPS.add(name)
 
 
-def register_float_op(name: str) -> None:
+def register_float_op(name) -> None:
+    """Classify op ``name`` (str) as fp32, or register a raw ``(module,
+    attr)`` target for the fp32 functional patch."""
+    if not isinstance(name, str):
+        from apex_tpu.amp.functional_patch import register_raw_target
+        register_raw_target(name[0], name[1], "float")
+        return
     HALF_OPS.discard(name)
     PROMOTE_OPS.discard(name)
     FLOAT_OPS.add(name)

@@ -481,6 +481,87 @@ class TestFunctionalPatch:
             s = jax.nn.softmax(jnp.ones((4, 4), jnp.bfloat16))
         assert s.dtype == jnp.float32
 
+    def test_raw_op_user_registry(self):
+        """User-owned (module, attr) targets get the functional-patch
+        treatment via register_half_op/register_float_op — the
+        reference's arbitrary-function O1 registration
+        (`apex/amp/amp.py:30-64`)."""
+        import types
+        from apex_tpu.amp import functional_patch as fp
+
+        ns = types.SimpleNamespace(
+            mm=lambda a, b: jnp.matmul(a, b),
+            sm=lambda a: jax.nn.softmax(a))
+        policy = amp.Policy.from_opt_level("O1")
+        a = jnp.ones((4, 4), jnp.float32)
+        orig_mm, orig_sm = ns.mm, ns.sm
+        try:
+            amp.register_half_op((ns, "mm"))
+            amp.register_float_op((ns, "sm"))
+            with amp.auto_cast(policy):
+                assert ns.mm is not orig_mm
+                assert ns.mm(a, a).dtype == jnp.bfloat16
+                assert ns.sm(a.astype(jnp.bfloat16)).dtype == jnp.float32
+            # originals restored on exit
+            assert ns.mm is orig_mm and ns.sm is orig_sm
+            # outside any scope: passthrough
+            assert ns.mm(a, a).dtype == jnp.float32
+
+            # registering INSIDE a live scope takes effect immediately,
+            # and re-registering with the other kind moves the target.
+            # The body is a neutral op: a body calling a *half-listed*
+            # entry point would legitimately re-cast inside (innermost
+            # policy wins, as with nested auto_cast).
+            ns.late = lambda a, b: a + b
+            orig_late = ns.late
+            with amp.auto_cast(policy):
+                amp.register_half_op((ns, "late"))
+                assert ns.late(a, a).dtype == jnp.bfloat16
+                amp.register_float_op((ns, "late"))
+                assert ns.late(a.astype(jnp.bfloat16),
+                               a.astype(jnp.bfloat16)).dtype \
+                    == jnp.float32
+            assert ns.late is orig_late
+            # nesting still composes and restores with user targets in
+            with amp.auto_cast(policy):
+                with amp.auto_cast(policy):
+                    assert getattr(ns.mm, "__wrapped_by_apex_tpu__",
+                                   False)
+                assert ns.mm is not orig_mm
+            assert ns.mm is orig_mm
+        finally:
+            for lst in (fp._USER_HALF_TARGETS, fp._USER_FLOAT_TARGETS):
+                lst[:] = [t for t in lst if t[0] is not ns]
+
+    def test_raw_op_registry_builtin_overlap(self):
+        """Registering a target that overlaps a BUILT-IN patched entry
+        must not stack wrappers or leak one past scope exit (the
+        first-pushed original is restored on re-registration)."""
+        from apex_tpu.amp import functional_patch as fp
+        policy = amp.Policy.from_opt_level("O1")
+        a = jnp.ones((4, 4), jnp.float32)
+        orig_mm = jnp.matmul
+        try:
+            amp.register_half_op((jnp, "matmul"))   # overlaps built-in
+            with amp.auto_cast(policy):
+                assert jnp.matmul(a, a).dtype == jnp.bfloat16
+                # move it to float inside the live scope
+                amp.register_float_op((jnp, "matmul"))
+                assert jnp.matmul(
+                    a.astype(jnp.bfloat16),
+                    a.astype(jnp.bfloat16)).dtype == jnp.float32
+            assert jnp.matmul is orig_mm, "stale wrapper leaked"
+            # a later scope applies the user's final (float) choice
+            with amp.auto_cast(policy):
+                assert jnp.matmul(
+                    a.astype(jnp.bfloat16),
+                    a.astype(jnp.bfloat16)).dtype == jnp.float32
+            assert jnp.matmul is orig_mm
+        finally:
+            for lst in (fp._USER_HALF_TARGETS, fp._USER_FLOAT_TARGETS):
+                lst[:] = [t for t in lst
+                          if not (t[0] is jnp and t[1] == "matmul")]
+
     def test_functional_patch_restores(self):
         policy = amp.Policy.from_opt_level("O1")
         orig_einsum = jnp.einsum
