@@ -33,6 +33,7 @@ from apex_tpu.amp.lists import (
     register_half_op,
     register_float_op,
     register_promote_op,
+    unregister_op,
     register_half_module,
     register_float_module,
 )
@@ -46,5 +47,6 @@ __all__ = [
     "half_function", "float_function", "promote_function",
     "auto_cast", "make_interceptor", "OptimWrapper",
     "register_half_op", "register_float_op", "register_promote_op",
+    "unregister_op",
     "register_half_module", "register_float_module",
 ]

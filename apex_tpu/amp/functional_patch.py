@@ -157,6 +157,25 @@ def _wrap_float(fn):
     return wrapped
 
 
+def unregister_raw_target(module, attr: str) -> None:
+    """Remove a user-registered raw target (inverse of
+    :func:`register_raw_target`). If a scope is live, the original
+    function is restored immediately; future scopes no longer wrap it.
+    Unknown targets are ignored (idempotent)."""
+    key = (module, attr)
+    with _lock:
+        for lst in (_USER_HALF_TARGETS, _USER_FLOAT_TARGETS):
+            if key in lst:
+                lst.remove(key)
+        if _patch_count > 0:
+            matches = [i for i, (mod, name, _) in enumerate(_originals)
+                       if (mod, name) == key]
+            if matches:
+                setattr(module, attr, _originals[matches[0]][2])
+                for i in reversed(matches):
+                    del _originals[i]
+
+
 def patch_functional(policy) -> None:
     """Install the raw-op casts for ``policy`` (nested contexts push the
     policy; call :func:`unpatch_functional` symmetrically)."""

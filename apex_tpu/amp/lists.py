@@ -104,6 +104,20 @@ def register_promote_op(name: str) -> None:
     PROMOTE_OPS.add(name)
 
 
+def unregister_op(name) -> None:
+    """Remove an op-name (str) from every classification table, or —
+    given a ``(module, attr)`` pair — drop a raw functional-patch
+    registration (restoring the original immediately if a scope is
+    live). Idempotent."""
+    if not isinstance(name, str):
+        from apex_tpu.amp.functional_patch import unregister_raw_target
+        unregister_raw_target(name[0], name[1])
+        return
+    HALF_OPS.discard(name)
+    FLOAT_OPS.discard(name)
+    PROMOTE_OPS.discard(name)
+
+
 # --- Flax module-class tables (consulted by the interceptor) ----------------
 
 # user-registered module classes (the module-level analogue of

@@ -128,7 +128,7 @@ def _kv_valid(ik, bk, kv_len, bq):
     return cols < kv_len
 
 
-def _keep_mask(seed, iq, ik, bq, bk, rate):
+def _keep_mask(seed, iq, ik, bq, bk, rate, gb=None):
     """In-kernel softmax-dropout keep mask — the TPU analogue of the
     reference's Philox dropout fused into the softmax kernel
     (`apex/contrib/csrc/multihead_attn/dropout.h:1-308`).
@@ -138,9 +138,12 @@ def _keep_mask(seed, iq, ik, bq, bk, rate):
     k-block, row, col): the forward and both backward kernels regenerate
     bitwise-identical masks regardless of grid iteration order, and
     compiled/interpret modes agree exactly (unlike ``pltpu.prng_*``,
-    which has no interpret lowering).
+    which has no interpret lowering). ``gb`` overrides the batch·head
+    coordinate for kernels whose grid packs several heads per step (the
+    native-layout path); default is one bh-row per step.
     """
-    gb = pl.program_id(0)
+    if gb is None:
+        gb = pl.program_id(0)
     rows = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 0)
     cols = jax.lax.broadcasted_iota(jnp.uint32, (bq, bk), 1)
     return _mix_keep(seed, gb, iq, ik, rows, cols, rate)
@@ -545,6 +548,522 @@ def _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3, scale, causal,
     return dq[:, :sq, :d], dk[:, :sk, :d], dv[:, :sk, :d]
 
 
+# --- native-layout kernels ---------------------------------------------------
+#
+# The wrappers above take (B·H, S, D) operands, which costs a transpose
+# copy per tensor at the custom-call boundary (measured 10.6 ms/step on
+# the BERT bench — `{3,0,2,1}`-style relayouts XLA cannot fuse into a
+# pallas call) plus a zero-pad of D up to the 128-lane tile. The
+# native-layout path keeps the model's (B, S, H) activations AS the
+# kernel operands: the grid still enumerates batch·head rows, but the
+# BlockSpec index maps slice each head's D columns out of the lane axis
+# (g heads per step so the block width g·D is lane-aligned — for the
+# ubiquitous D=64 two heads share one 128-lane tile, removing the
+# zero-pad too). Dropout stays bitwise-identical: the hash's batch·head
+# coordinate is reconstructed as ``t·g + h``, exactly the bh-row the
+# transposed path would have used.
+
+
+def _native_g0(nh: int, d: int) -> Optional[int]:
+    """Smallest head-group g with (g·d) lane-aligned; None = no native
+    path (heads not groupable into a lane-aligned block)."""
+    if d <= 0:
+        return None
+    g0 = 128 // int(np.gcd(d, 128))
+    if nh % g0:
+        return None
+    return g0
+
+
+def _native_g(nh, d, bh, nq, dropout_rate, bq, bk, itemsize):
+    """Heads per grid step on the native path: at least g0 (lane
+    alignment), more when the VMEM budget allows (same ~9 MiB estimate
+    as _g_pack; packing amortizes per-step DMA setup).
+    ``APEX_TPU_NATIVE_G`` overrides for perf experiments."""
+    import os
+    g0 = _native_g0(nh, d)
+    forced = os.environ.get("APEX_TPU_NATIVE_G")
+    if forced:
+        g = int(forced)
+        if g % g0 == 0 and nh % g == 0:
+            return g
+    for mult in (4, 2, 1):
+        g = g0 * mult
+        if nh % g:
+            continue
+        half_bufs = (bq + 2 * bk) * g * d * 2 * itemsize
+        scratch = g * bq * 2 * LANES * 4 + bq * g * d * 4
+        if half_bufs + scratch <= 9 * 2 ** 20:
+            return g
+    return g0
+
+
+def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
+                   refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    o_ref, lse_ref, m_scr, l_scr, acc = refs[pos:]
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc[:] = jnp.zeros_like(acc)
+
+    for h in range(g):
+        sl = slice(h * d, (h + 1) * d)
+        q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
+        bq, bk = q.shape[0], k.shape[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        valid = _kv_valid(ik, bk, kv_len, bq)
+        if causal:
+            valid = jnp.logical_and(
+                valid, _causal_mask(iq, ik, bq, bk, kv_len - q_len))
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_scr[h][:, :1]
+        l_prev = l_scr[h][:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        pd = p
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate,
+                              gb=pl.program_id(0) * g + h)
+            pd = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
+        acc[0, :, sl] = acc[0][:, sl] * alpha + jax.lax.dot_general(
+            pd.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[h] = jnp.broadcast_to(m_new, m_scr.shape[1:])
+        l_scr[h] = jnp.broadcast_to(l_new, l_scr.shape[1:])
+
+        @pl.when(ik == nk - 1)
+        def _(h=h, sl=sl):
+            l = l_scr[h][:, :1]
+            safe_l = jnp.where(l == 0.0, 1.0, l)
+            bq_ = o_ref.shape[1]
+            o_ref[0, :, sl] = (acc[0][:, sl] / safe_l).astype(o_ref.dtype)
+            lse_ref[h * bq_:(h + 1) * bq_] = \
+                (m_scr[h][:, :1] + jnp.log(safe_l)) \
+                + jnp.zeros((bq_, lse_ref.shape[1]), jnp.float32)
+
+
+def _head_specs(nh, g, bq, bk, gd):
+    """(q, k) BlockSpecs over (B, S, H) with head columns in the lane
+    axis; grid dim 0 enumerates (batch, head-group) pairs group-minor."""
+    hg = nh // g
+    q_spec = pl.BlockSpec((1, bq, gd),
+                          lambda t, i, j: (t // hg, i, t % hg),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, bk, gd),
+                          lambda t, i, j: (t // hg, j, t % hg),
+                          memory_space=pltpu.VMEM)
+    return q_spec, k_spec
+
+
+def _lse_reorder(lse_rows, bh, g, nq, bq):
+    """Kernel lse row order [group][q-block][head][row] → (bh, sqp).
+    With nq == 1 or g == 1 the orders already coincide."""
+    x = lse_rows.reshape(bh // g, nq, g, bq)
+    if g > 1 and nq > 1:
+        x = x.transpose(0, 2, 1, 3)
+    return x.reshape(bh, nq * bq)
+
+
+def _lanes_nl(x, bh, g, nq, bq, sq):
+    """(bh, sq) per-row scalars → (bh·sqp, LANES) in the kernels' block
+    row order [group][q-block][head][row]."""
+    sqp = nq * bq
+    xp = jnp.pad(x, ((0, 0), (0, sqp - sq)))
+    xp = xp.reshape(bh // g, g, nq, bq)
+    if g > 1 and nq > 1:
+        xp = xp.transpose(0, 2, 1, 3)
+    xp = xp.reshape(bh * sqp, 1)
+    return jnp.broadcast_to(xp, (bh * sqp, LANES))
+
+
+def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
+                  dropout_rate=0.0, seed=None):
+    b, sq, H = q2.shape
+    sk = k2.shape[1]
+    bh = b * nh
+    block_q, block_k = _block_cap(block_q, block_k, False, dropout_rate)
+    bq = _choose_block(block_q, sq)
+    bk = _choose_block(block_k, sk, lane=True)
+    sqp = -(-sq // bq) * bq
+    skp = -(-sk // bk) * bk
+    nq, nk = sqp // bq, skp // bk
+
+    pad_s = lambda t, s_: t if t.shape[1] == s_ else jnp.pad(
+        t, ((0, 0), (0, s_ - t.shape[1]), (0, 0)))
+    qp, kp, vp = pad_s(q2, sqp), pad_s(k2, skp), pad_s(v2, skp)
+
+    g = _native_g(nh, d, bh, nq, dropout_rate, bq, bk, q2.dtype.itemsize)
+    gd = g * d
+    q_spec, k_spec = _head_specs(nh, g, bq, bk, gd)
+    in_specs = [q_spec, k_spec, k_spec]
+    args = [qp, kp, vp]
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+
+    kernel = functools.partial(_fwd_kernel_nl, scale, causal, sk, sq,
+                               dropout_rate, d, g)
+    o, lse = pl.pallas_call(
+        lambda *refs: kernel(refs),
+        grid=(bh // g, nq, nk),
+        in_specs=in_specs,
+        out_specs=(
+            q_spec,
+            pl.BlockSpec((g * bq, LANES), lambda t, i, j: (t * nq + i, 0),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, sqp, H), q2.dtype),
+            jax.ShapeDtypeStruct((bh * nq * bq, LANES), jnp.float32),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((g, bq, LANES), jnp.float32),
+            pltpu.VMEM((g, bq, LANES), jnp.float32),
+            pltpu.VMEM((1, bq, gd), jnp.float32),
+        ],
+        interpret=use_interpret(),
+    )(*args)
+    lse = _lse_reorder(lse[:, 0], bh, g, nq, bq)[:, :sq]
+    return o[:, :sq, :], lse
+
+
+def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
+                      refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    do_ref, lse_ref, dl_ref, dq_ref, dq_acc = refs[pos:]
+    iq, ik = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    for h in range(g):
+        sl = slice(h * d, (h + 1) * d)
+        q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        bq, bk = q.shape[0], k.shape[0]
+        lse = lse_ref[h * bq:(h + 1) * bq, :1]
+        delta = dl_ref[h * bq:(h + 1) * bq, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        valid = _kv_valid(ik, bk, kv_len, bq)
+        if causal:
+            valid = jnp.logical_and(
+                valid, _causal_mask(iq, ik, bq, bk, kv_len - q_len))
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate,
+                              gb=pl.program_id(0) * g + h)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dq_acc[0, :, sl] = dq_acc[0][:, sl] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        @pl.when(ik == nk - 1)
+        def _(sl=sl):
+            dq_ref[0, :, sl] = dq_acc[0][:, sl].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
+                       refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    do_ref, lse_ref, dl_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[pos:]
+    ik, iq = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    for h in range(g):
+        sl = slice(h * d, (h + 1) * d)
+        q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        bq, bk = q.shape[0], k.shape[0]
+        lse = lse_ref[h * bq:(h + 1) * bq, :1]
+        delta = dl_ref[h * bq:(h + 1) * bq, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        valid = _kv_valid(ik, bk, kv_len, bq)
+        if causal:
+            valid = jnp.logical_and(
+                valid, _causal_mask(iq, ik, bq, bk, kv_len - q_len))
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        valid = jnp.logical_and(valid, rows < q_len)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        pv = p
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate,
+                              gb=pl.program_id(0) * g + h)
+            inv_keep = 1.0 / (1.0 - dropout_rate)
+            pv = jnp.where(keep, p * inv_keep, 0.0)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        dv_acc[0, :, sl] = dv_acc[0][:, sl] + jax.lax.dot_general(
+            pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc[0, :, sl] = dk_acc[0][:, sl] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[:] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
+                         g, refs):
+    """Single-sweep backward for single-block grids (Sq, Sk each one
+    tile): s and p are computed ONCE per head and all three gradients
+    come out of the same sweep — the two-kernel split pays a redundant
+    QKᵀ and exp pass per kernel, which at short sequence lengths is the
+    dominant backward cost (BERT-Large: ~0.8 ms/layer two-kernel vs the
+    fused sweep)."""
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    pos = 3
+    seed_ref = None
+    if dropout_rate > 0.0:
+        seed_ref = refs[pos]
+        pos += 1
+    do_ref, lse_ref, dl_ref, dq_ref, dk_ref, dv_ref = refs[pos:]
+
+    for h in range(g):
+        sl = slice(h * d, (h + 1) * d)
+        q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
+        do = do_ref[0][:, sl]
+        bq, bk = q.shape[0], k.shape[0]
+        lse = lse_ref[h * bq:(h + 1) * bq, :1]
+        delta = dl_ref[h * bq:(h + 1) * bq, :1]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        valid = _kv_valid(0, bk, kv_len, bq)
+        if causal:
+            valid = jnp.logical_and(
+                valid, _causal_mask(0, 0, bq, bk, kv_len - q_len))
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        valid = jnp.logical_and(valid, rows < q_len)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        pv = p
+        if dropout_rate > 0.0:
+            keep = _keep_mask(seed_ref[0], 0, 0, bq, bk, dropout_rate,
+                              gb=pl.program_id(0) * g + h)
+            inv_keep = 1.0 / (1.0 - dropout_rate)
+            pv = jnp.where(keep, p * inv_keep, 0.0)
+            dp = jnp.where(keep, dp * inv_keep, 0.0)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dq_ref[0, :, sl] = (jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(
+                dq_ref.dtype)
+        dk_ref[0, :, sl] = (jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(
+                dk_ref.dtype)
+        dv_ref[0, :, sl] = jax.lax.dot_general(
+            pv.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+
+
+def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
+                        scale, causal, sq, sk, sqp, skp, bq, bk, seed,
+                        dropout_rate):
+    b = qp.shape[0]
+    H = qp.shape[2]
+    bh = b * nh
+    gd = g * d
+    hg = nh // g
+    q_spec = pl.BlockSpec((1, sqp, gd), lambda t: (t // hg, 0, t % hg),
+                          memory_space=pltpu.VMEM)
+    k_spec = pl.BlockSpec((1, skp, gd), lambda t: (t // hg, 0, t % hg),
+                          memory_space=pltpu.VMEM)
+    lane_spec = pl.BlockSpec((g * bq, LANES), lambda t: (t, 0),
+                             memory_space=pltpu.VMEM)
+    in_specs = [q_spec, k_spec, k_spec]
+    args = [qp, kp, vp]
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [q_spec, lane_spec, lane_spec]
+    args += [dop, lse_l, delta_l]
+
+    dq, dk, dv = pl.pallas_call(
+        lambda *refs: functools.partial(
+            _bwd_fused_kernel_nl, scale, causal, sk, sq, dropout_rate,
+            d, g)(refs),
+        grid=(bh // g,),
+        in_specs=in_specs,
+        out_specs=(q_spec, k_spec, k_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, sqp, H), qp.dtype),
+            jax.ShapeDtypeStruct((b, skp, H), kp.dtype),
+            jax.ShapeDtypeStruct((b, skp, H), kp.dtype),
+        ),
+        interpret=use_interpret(),
+    )(*args)
+    return dq[:, :sq, :], dk[:, :sk, :], dv[:, :sk, :]
+
+
+def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
+                  block_q, block_k, dropout_rate=0.0, seed=None):
+    """Native-layout backward: operands/outputs (B, S, H); ``lse`` and
+    ``delta`` arrive (B·H, Sq)."""
+    b, sq, H = q2.shape
+    sk = k2.shape[1]
+    bh = b * nh
+    block_q, block_k = _block_cap(block_q, block_k, False, dropout_rate)
+    bq = _choose_block(block_q, sq)
+    bk = _choose_block(block_k, sk, lane=True)
+    sqp = -(-sq // bq) * bq
+    skp = -(-sk // bk) * bk
+    nq, nk = sqp // bq, skp // bk
+
+    pad_s = lambda t, s_: t if t.shape[1] == s_ else jnp.pad(
+        t, ((0, 0), (0, s_ - t.shape[1]), (0, 0)))
+    qp, kp, vp = pad_s(q2, sqp), pad_s(k2, skp), pad_s(v2, skp)
+    dop = pad_s(do2, sqp)
+
+    g = _native_g(nh, d, bh, nq, dropout_rate, bq, bk, q2.dtype.itemsize)
+
+    if nq == 1 and nk == 1:
+        # single-block grids: one fused sweep computes dq/dk/dv from a
+        # single s/p evaluation. Its VMEM budget carries all seven
+        # blocks + f32 score temporaries — shrink g until it fits, and
+        # fall back to the two-kernel split when even the minimum
+        # lane-aligned group does not (large-S fp32 shapes).
+        isz = q2.dtype.itemsize
+
+        def fused_est(g_):
+            gd_ = g_ * d
+            return ((2 * sqp + 2 * skp) * gd_ * isz * 2
+                    + (sqp + 2 * skp) * gd_ * isz * 2
+                    + bq * bk * 4 * 3 + 2 * g_ * bq * LANES * 4 * 2)
+
+        g0 = _native_g0(nh, d)
+        gf = g
+        while gf > g0 and fused_est(gf) > 13 * 2 ** 20:
+            # halve while staying a lane-aligned group that divides the
+            # head count (an APEX_TPU_NATIVE_G override may start on a
+            # non-power-of-two multiple of g0)
+            nxt = gf // 2
+            if nxt % g0 or nh % nxt:
+                nxt = g0
+            gf = nxt
+        if fused_est(gf) <= 13 * 2 ** 20:
+            lse_f = _lanes_nl(lse, bh, gf, 1, bq, sq)
+            delta_f = _lanes_nl(delta, bh, gf, 1, bq, sq)
+            return _flash_bwd_fused_nl(qp, kp, vp, dop, lse_f, delta_f,
+                                       nh, d, gf, scale, causal, sq, sk,
+                                       sqp, skp, bq, bk, seed,
+                                       dropout_rate)
+
+    gd = g * d
+    lse_l = _lanes_nl(lse, bh, g, nq, bq, sq)
+    delta_l = _lanes_nl(delta, bh, g, nq, bq, sq)
+
+    hg = nh // g
+    q_spec, k_spec = _head_specs(nh, g, bq, bk, gd)
+    lane_spec = pl.BlockSpec((g * bq, LANES),
+                             lambda t, i, j: (t * nq + i, 0),
+                             memory_space=pltpu.VMEM)
+
+    in_specs = [q_spec, k_spec, k_spec]
+    args = [qp, kp, vp]
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    in_specs += [q_spec, lane_spec, lane_spec]
+    args += [dop, lse_l, delta_l]
+
+    dq = pl.pallas_call(
+        lambda *refs: functools.partial(
+            _bwd_dq_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
+            g)(refs),
+        grid=(bh // g, nq, nk),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, sqp, H), q2.dtype),
+        scratch_shapes=[pltpu.VMEM((1, bq, gd), jnp.float32)],
+        interpret=use_interpret(),
+    )(*args)
+
+    # dk/dv: grid loops q innermost
+    q_spec_k = pl.BlockSpec((1, bq, gd),
+                            lambda t, j, i: (t // hg, i, t % hg),
+                            memory_space=pltpu.VMEM)
+    k_spec_k = pl.BlockSpec((1, bk, gd),
+                            lambda t, j, i: (t // hg, j, t % hg),
+                            memory_space=pltpu.VMEM)
+    lane_spec_k = pl.BlockSpec((g * bq, LANES),
+                               lambda t, j, i: (t * nq + i, 0),
+                               memory_space=pltpu.VMEM)
+    in_specs2 = [q_spec_k, k_spec_k, k_spec_k]
+    args2 = [qp, kp, vp]
+    if dropout_rate > 0.0:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(seed)
+    in_specs2 += [q_spec_k, lane_spec_k, lane_spec_k]
+    args2 += [dop, lse_l, delta_l]
+
+    dk, dv = pl.pallas_call(
+        lambda *refs: functools.partial(
+            _bwd_dkv_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
+            g)(refs),
+        grid=(bh // g, nk, nq),
+        in_specs=in_specs2,
+        out_specs=(k_spec_k, k_spec_k),
+        out_shape=(jax.ShapeDtypeStruct((b, skp, H), k2.dtype),) * 2,
+        scratch_shapes=[pltpu.VMEM((1, bk, gd), jnp.float32)] * 2,
+        interpret=use_interpret(),
+    )(*args2)
+
+    return dq[:, :sq, :], dk[:, :sk, :], dv[:, :sk, :]
+
+
 # --- public op --------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
@@ -625,9 +1144,19 @@ def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
                              block_q, block_k, dropout_rate):
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    seed = _seed_arr(dropout_seed, dropout_rate)
+    if bias is None and _native_g0(h, d) is not None:
+        # native-layout path: (B, S, H) operands straight through — no
+        # transpose copies, no D zero-pad (see the native-kernel block)
+        q2 = q.reshape(b, sq, h * d)
+        k2 = k.reshape(b, k.shape[1], h * d)
+        v2 = v.reshape(b, v.shape[1], h * d)
+        o2, lse = _flash_fwd_nl(q2, k2, v2, h, d, scale, causal,
+                                block_q, block_k, dropout_rate, seed)
+        o = o2.reshape(b, sq, h, d)
+        return o, (q, k, v, bias, dropout_seed, o, lse)
     q3, k3, v3 = _to3(q, k, v)
     bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
-    seed = _seed_arr(dropout_seed, dropout_rate)
     o3, lse = _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q,
                          block_k, dropout_rate, seed)
     o = jnp.swapaxes(o3.reshape(b, h, sq, d), 1, 2)
@@ -647,9 +1176,25 @@ def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
+    seed = _seed_arr(dropout_seed, dropout_rate)
+    if bias is None and _native_g0(h, d) is not None:
+        q2 = q.reshape(b, sq, h * d)
+        k2 = k.reshape(b, sk, h * d)
+        v2 = v.reshape(b, sk, h * d)
+        o2 = o.reshape(b, sq, h * d)
+        do2 = do.reshape(b, sq, h * d)
+        # delta = rowsum(do·o) per head, straight from the (B, S, H)
+        # layout: only the tiny (B, S, nh) per-head sums transpose
+        delta = jnp.sum(
+            (do.astype(jnp.float32) * o.astype(jnp.float32)), axis=-1)
+        delta = jnp.swapaxes(delta, 1, 2).reshape(b * h, sq)
+        dq2, dk2, dv2 = _flash_bwd_nl(
+            q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
+            block_q, block_k, dropout_rate=dropout_rate, seed=seed)
+        return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
+                dv2.reshape(b, sk, h, d), None, None)
     q3, k3, v3 = _to3(q, k, v)
     bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
-    seed = _seed_arr(dropout_seed, dropout_rate)
     o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
     do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3,

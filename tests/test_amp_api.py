@@ -530,8 +530,11 @@ class TestFunctionalPatch:
                 assert ns.mm is not orig_mm
             assert ns.mm is orig_mm
         finally:
-            for lst in (fp._USER_HALF_TARGETS, fp._USER_FLOAT_TARGETS):
-                lst[:] = [t for t in lst if t[0] is not ns]
+            amp.unregister_op((ns, "mm"))
+            amp.unregister_op((ns, "sm"))
+            amp.unregister_op((ns, "late"))
+        assert not any(t[0] is ns for t in fp._USER_HALF_TARGETS)
+        assert not any(t[0] is ns for t in fp._USER_FLOAT_TARGETS)
 
     def test_raw_op_registry_builtin_overlap(self):
         """Registering a target that overlaps a BUILT-IN patched entry
@@ -558,9 +561,16 @@ class TestFunctionalPatch:
                     a.astype(jnp.bfloat16)).dtype == jnp.float32
             assert jnp.matmul is orig_mm
         finally:
-            for lst in (fp._USER_HALF_TARGETS, fp._USER_FLOAT_TARGETS):
-                lst[:] = [t for t in lst
-                          if not (t[0] is jnp and t[1] == "matmul")]
+            amp.unregister_op((jnp, "matmul"))
+        # unregister inside a live scope restores immediately
+        ns2 = __import__("types").SimpleNamespace(f=lambda a: a + a)
+        orig_f = ns2.f
+        with amp.auto_cast(policy):
+            amp.register_half_op((ns2, "f"))
+            assert ns2.f is not orig_f
+            amp.unregister_op((ns2, "f"))
+            assert ns2.f is orig_f
+        assert ns2.f is orig_f
 
     def test_functional_patch_restores(self):
         policy = amp.Policy.from_opt_level("O1")

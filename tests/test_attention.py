@@ -374,3 +374,90 @@ class TestFusedDropout:
         q, k, v = rand_qkv(rng, 1, 32, 1, 32)
         with pytest.raises(ValueError, match="dropout_seed"):
             A.flash_attention(q, k, v, dropout_rate=0.5)
+
+
+class TestNativeLayoutPath:
+    """d=64-class shapes route through the native-layout kernels
+    (heads sliced from the lane axis — see the native-kernel block in
+    ops/attention.py); these pin the fwd, both bwd variants (fused
+    single-sweep and two-kernel multi-block) and the dropout
+    coordinate reconstruction against the same oracles the transposed
+    path is held to. d=32/d=16 tests elsewhere cover the transposed
+    fallback."""
+
+    def _grads(self, fn, args, argn=(0, 1, 2)):
+        return jax.jit(jax.grad(
+            lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2),
+            argnums=argn))(*args)
+
+    @pytest.mark.parametrize("s,causal", [(128, False), (384, True)])
+    def test_fused_single_sweep_bwd_matches_oracle(self, s, causal):
+        # single-block grid -> the fused dq/dk/dv sweep
+        rng = np.random.RandomState(5)
+        q, k, v = rand_qkv(rng, 2, s, 4, 64)
+        assert A._native_g0(4, 64) == 2
+
+        def fn(q, k, v):
+            return A.flash_attention(q, k, v, causal=causal)
+
+        def ref(q, k, v):
+            return A.attention_reference(q, k, v, causal=causal)
+
+        np.testing.assert_allclose(jax.jit(fn)(q, k, v), ref(q, k, v),
+                                   atol=2e-5, rtol=1e-5)
+        for g, w in zip(self._grads(fn, (q, k, v)),
+                        self._grads(ref, (q, k, v))):
+            np.testing.assert_allclose(g, w, atol=5e-4, rtol=1e-3)
+
+    def test_two_kernel_multiblock_bwd_matches_oracle(self):
+        # force a multi-block grid (block_q/k < s) -> two-kernel path
+        rng = np.random.RandomState(6)
+        q, k, v = rand_qkv(rng, 1, 256, 4, 64)
+
+        def fn(q, k, v):
+            return A.flash_attention(q, k, v, causal=True, block_q=128,
+                                     block_k=128)
+
+        def ref(q, k, v):
+            return A.attention_reference(q, k, v, causal=True)
+
+        np.testing.assert_allclose(jax.jit(fn)(q, k, v), ref(q, k, v),
+                                   atol=2e-5, rtol=1e-5)
+        for g, w in zip(self._grads(fn, (q, k, v)),
+                        self._grads(ref, (q, k, v))):
+            np.testing.assert_allclose(g, w, atol=5e-4, rtol=1e-3)
+
+    @pytest.mark.parametrize("s,bq", [(128, None), (256, 128)])
+    def test_native_dropout_matches_dense_mask_oracle(self, s, bq):
+        """gb = t·g + h must reproduce the dense replica's bh-row
+        numbering — fwd values AND gradients, single- and multi-block."""
+        rng = np.random.RandomState(7)
+        q, k, v = rand_qkv(rng, 1, s, 4, 64)
+        rate, seed = 0.3, 17
+        kw = {} if bq is None else {"block_q": bq, "block_k": bq}
+
+        def fn(q, k, v):
+            return A.flash_attention(q, k, v, dropout_rate=rate,
+                                     dropout_seed=seed, **kw)
+
+        cq, ck = A._block_cap(kw.get("block_q", A.DEFAULT_BLOCK_Q),
+                              kw.get("block_k", A.DEFAULT_BLOCK_K),
+                              False, rate)
+        bq_ = A._choose_block(cq, s)
+        bk_ = A._choose_block(ck, s, lane=True)
+
+        def ref(q, k, v):
+            b, sq, h, d = q.shape
+            sm = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+            p = jax.nn.softmax(sm, axis=-1)
+            keep = A._keep_mask_dense(jnp.asarray(seed, jnp.int32), b,
+                                      h, sq, sq, bq_, bk_, rate)
+            pd = jnp.where(keep.reshape(b, h, sq, sq), p / (1 - rate),
+                           0.0)
+            return jnp.einsum("bhqk,bkhd->bqhd", pd, v)
+
+        np.testing.assert_allclose(jax.jit(fn)(q, k, v), ref(q, k, v),
+                                   atol=2e-5, rtol=1e-5)
+        for g, w in zip(self._grads(fn, (q, k, v)),
+                        self._grads(ref, (q, k, v))):
+            np.testing.assert_allclose(g, w, atol=5e-4, rtol=1e-3)
