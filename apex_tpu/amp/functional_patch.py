@@ -159,21 +159,36 @@ def _wrap_float(fn):
 
 def unregister_raw_target(module, attr: str) -> None:
     """Remove a user-registered raw target (inverse of
-    :func:`register_raw_target`). If a scope is live, the original
-    function is restored immediately; future scopes no longer wrap it.
-    Unknown targets are ignored (idempotent)."""
+    :func:`register_raw_target`). If a scope is live, the user wrapper
+    is unwound immediately; future scopes no longer wrap it. Unknown
+    targets are ignored (idempotent), and built-in patch surface is
+    never stripped — a target that overlaps a built-in list reverts to
+    the built-in treatment, not to the raw function."""
     key = (module, attr)
     with _lock:
+        was_registered = False
         for lst in (_USER_HALF_TARGETS, _USER_FLOAT_TARGETS):
             if key in lst:
                 lst.remove(key)
-        if _patch_count > 0:
-            matches = [i for i, (mod, name, _) in enumerate(_originals)
-                       if (mod, name) == key]
-            if matches:
-                setattr(module, attr, _originals[matches[0]][2])
-                for i in reversed(matches):
-                    del _originals[i]
+                was_registered = True
+        if not was_registered or _patch_count == 0:
+            return
+        matches = [i for i, (mod, name, _) in enumerate(_originals)
+                   if (mod, name) == key]
+        if not matches:
+            return
+        orig = _originals[matches[0]][2]
+        for i in reversed(matches):
+            del _originals[i]
+        setattr(module, attr, orig)
+        # overlapping built-in target: re-install ITS wrapper so the
+        # scope's built-in O1 surface survives the user unregistration
+        for targets, wrap in ((_HALF_TARGETS, _wrap_half),
+                              (_FLOAT_TARGETS, _wrap_float)):
+            if key in targets:
+                _originals.append((module, attr, orig))
+                setattr(module, attr, wrap(orig))
+                break
 
 
 def patch_functional(policy) -> None:

@@ -575,10 +575,11 @@ def _native_g0(nh: int, d: int) -> Optional[int]:
     return g0
 
 
-def _native_g(nh, d, bh, nq, dropout_rate, bq, bk, itemsize):
+def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
     """Heads per grid step on the native path: at least g0 (lane
     alignment), more when the VMEM budget allows (same ~9 MiB estimate
-    as _g_pack; packing amortizes per-step DMA setup).
+    as _g_pack; packing amortizes per-step DMA setup). Dropout adds a
+    (bq, bk)-sized keep-mask/hash temporary per live tile.
     ``APEX_TPU_NATIVE_G`` overrides for perf experiments."""
     import os
     g0 = _native_g0(nh, d)
@@ -587,13 +588,14 @@ def _native_g(nh, d, bh, nq, dropout_rate, bq, bk, itemsize):
         g = int(forced)
         if g % g0 == 0 and nh % g == 0:
             return g
+    mask_tmp = bq * bk * 8 if dropout_rate > 0.0 else 0
     for mult in (4, 2, 1):
         g = g0 * mult
         if nh % g:
             continue
         half_bufs = (bq + 2 * bk) * g * d * 2 * itemsize
         scratch = g * bq * 2 * LANES * 4 + bq * g * d * 4
-        if half_bufs + scratch <= 9 * 2 ** 20:
+        if half_bufs + scratch + mask_tmp <= 9 * 2 ** 20:
             return g
     return g0
 
@@ -709,7 +711,7 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
         t, ((0, 0), (0, s_ - t.shape[1]), (0, 0)))
     qp, kp, vp = pad_s(q2, sqp), pad_s(k2, skp), pad_s(v2, skp)
 
-    g = _native_g(nh, d, bh, nq, dropout_rate, bq, bk, q2.dtype.itemsize)
+    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
     gd = g * d
     q_spec, k_spec = _head_specs(nh, g, bq, bk, gd)
     in_specs = [q_spec, k_spec, k_spec]
@@ -967,7 +969,7 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     qp, kp, vp = pad_s(q2, sqp), pad_s(k2, skp), pad_s(v2, skp)
     dop = pad_s(do2, sqp)
 
-    g = _native_g(nh, d, bh, nq, dropout_rate, bq, bk, q2.dtype.itemsize)
+    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
 
     if nq == 1 and nk == 1:
         # single-block grids: one fused sweep computes dq/dk/dv from a
@@ -1181,7 +1183,6 @@ def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
         q2 = q.reshape(b, sq, h * d)
         k2 = k.reshape(b, sk, h * d)
         v2 = v.reshape(b, sk, h * d)
-        o2 = o.reshape(b, sq, h * d)
         do2 = do.reshape(b, sq, h * d)
         # delta = rowsum(do·o) per head, straight from the (B, S, H)
         # layout: only the tiny (B, S, nh) per-head sums transpose

@@ -92,6 +92,12 @@ def _resnet_step_builder(batch: int, size: int, opt_level: str = "O2"):
     model = models.ResNet50(num_classes=1000, dtype=policy.compute_dtype)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, size, size, 3).astype(np.float32))
+    # inputs arrive pre-cast to the compute dtype, as the example's
+    # prefetcher ships them (the reference casts on a side stream,
+    # `main_amp.py:264-317`) — the in-graph fp32->half cast is not part
+    # of the step being measured
+    if policy.cast_model_type is not None:
+        x = x.astype(policy.compute_dtype)
     y = jnp.asarray(rng.randint(0, 1000, batch), jnp.int32)
 
     variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)

@@ -630,3 +630,24 @@ class TestFunctionalPatch:
         with amp.auto_cast(policy):
             out = ops.attention_reference(q, q, q)
         assert out.dtype == jnp.float32
+
+
+class TestUnregisterBuiltinOverlap:
+    def test_unregister_never_strips_builtin_surface(self):
+        """Unregistering a user target that overlaps a BUILT-IN O1 entry
+        reverts to the built-in treatment mid-scope (and unregistering a
+        never-registered builtin is a no-op)."""
+        from apex_tpu import amp
+        policy = amp.Policy.from_opt_level("O1")
+        a = jnp.ones((4, 4), jnp.float32)
+        orig_mm = jnp.matmul
+        with amp.auto_cast(policy):
+            amp.register_half_op((jnp, "matmul"))
+            amp.unregister_op((jnp, "matmul"))
+            # built-in half surface must survive
+            assert jnp.matmul(a, a).dtype == jnp.bfloat16
+            # unregistering something never registered: no-op
+            amp.unregister_op((jax.nn, "softmax"))
+            s = jax.nn.softmax(a.astype(jnp.bfloat16))
+            assert s.dtype == jnp.float32
+        assert jnp.matmul is orig_mm
