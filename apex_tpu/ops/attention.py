@@ -577,10 +577,11 @@ def _native_g0(nh: int, d: int) -> Optional[int]:
 
 def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
     """Heads per grid step on the native path: at least g0 (lane
-    alignment), more when the VMEM budget allows (same ~9 MiB estimate
-    as _g_pack; packing amortizes per-step DMA setup). Dropout adds a
-    (bq, bk)-sized keep-mask/hash temporary per live tile.
-    ``APEX_TPU_NATIVE_G`` overrides for perf experiments."""
+    alignment), more when the forward kernel's VMEM ledger fits the
+    16 MiB scoped budget (in-blocks, scratch, score tile, out-blocks;
+    packing amortizes per-step DMA setup). Dropout adds a (bq, bk)
+    keep-mask/hash temporary. ``APEX_TPU_NATIVE_G`` overrides for perf
+    experiments."""
     import os
     g0 = _native_g0(nh, d)
     forced = os.environ.get("APEX_TPU_NATIVE_G")
@@ -588,14 +589,23 @@ def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
         g = int(forced)
         if g % g0 == 0 and nh % g == 0:
             return g
+    # full ledger of what the fwd kernel keeps in scoped VMEM: the
+    # double-buffered q/k/v in-blocks, the m/l/acc scratch, the f32
+    # score tile, the o and lse out-blocks (also double-buffered), and
+    # dropout's keep-mask temporary. Calibrated against the measured
+    # ceiling: S=2048 nh=16 OOM'd at g=4 (17.9 MiB actual) while
+    # S=512 g=8 and fp32 S=1024 g=2 compile.
     mask_tmp = bq * bk * 8 if dropout_rate > 0.0 else 0
     for mult in (4, 2, 1):
         g = g0 * mult
         if nh % g:
             continue
-        half_bufs = (bq + 2 * bk) * g * d * 2 * itemsize
-        scratch = g * bq * 2 * LANES * 4 + bq * g * d * 4
-        if half_bufs + scratch + mask_tmp <= 9 * 2 ** 20:
+        gd = g * d
+        half_bufs = (bq + 2 * bk) * gd * itemsize * 2
+        scratch = g * bq * 2 * LANES * 4 + bq * gd * 4
+        score = bq * bk * 4
+        outs = bq * gd * itemsize * 2 + g * bq * LANES * 4 * 2
+        if half_bufs + scratch + score + outs + mask_tmp <= 16 * 2 ** 20:
             return g
     return g0
 
@@ -960,6 +970,25 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     block_q, block_k = _block_cap(block_q, block_k, False, dropout_rate)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
+    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
+    if (sq > bq or sk > bk) and bq * bk * 4 >= (1 << 22) and bh > g:
+        # multi-block two-kernel path with 1024²-class f32 score tiles:
+        # Mosaic multi-buffers the streamed blocks across head-group
+        # boundaries when more groups follow (measured: the identical
+        # kernel compiles at bh == g and OOMs at 19.6 MiB with 64
+        # groups), so multi-group grids drop to the proven 512 tile
+        bq = _choose_block(min(block_q, 512), sq)
+        bk = _choose_block(min(block_k, 512), sk, lane=True)
+        g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
+        # the bwd kernels carry q/do blocks AND two lane arrays on top
+        # of what the fwd estimate models — cap the head group too
+        # (measured: g=8 at 512 tiles still lands 16.4 MiB)
+        g0_ = _native_g0(nh, d)
+        while g > 2 * g0_ or (nh % g) or (g % g0_):
+            nxt = g // 2
+            if nxt < g0_ or nxt % g0_ or nh % nxt:
+                nxt = g0_
+            g = nxt
     sqp = -(-sq // bq) * bq
     skp = -(-sk // bk) * bk
     nq, nk = sqp // bq, skp // bk
@@ -968,8 +997,6 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
         t, ((0, 0), (0, s_ - t.shape[1]), (0, 0)))
     qp, kp, vp = pad_s(q2, sqp), pad_s(k2, skp), pad_s(v2, skp)
     dop = pad_s(do2, sqp)
-
-    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
 
     if nq == 1 and nk == 1:
         # single-block grids: one fused sweep computes dq/dk/dv from a
