@@ -611,13 +611,17 @@ def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
 
 
 def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                   refs):
+                   has_off, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
+        pos += 1
+    off_ref = None
+    if has_off:
+        off_ref = refs[pos]
         pos += 1
     o_ref, lse_ref, m_scr, l_scr, acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
@@ -639,8 +643,9 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
             preferred_element_type=jnp.float32) * scale
         valid = _kv_valid(ik, bk, kv_len, bq)
         if causal:
+            off = off_ref[0] if has_off else kv_len - q_len
             valid = jnp.logical_and(
-                valid, _causal_mask(iq, ik, bq, bk, kv_len - q_len))
+                valid, _causal_mask(iq, ik, bq, bk, off))
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[h][:, :1]
@@ -706,7 +711,7 @@ def _lanes_nl(x, bh, g, nq, bq, sq):
 
 
 def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
-                  dropout_rate=0.0, seed=None):
+                  dropout_rate=0.0, seed=None, causal_off=None):
     b, sq, H = q2.shape
     sk = k2.shape[1]
     bh = b * nh
@@ -729,9 +734,13 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if causal_off is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(causal_off)
 
     kernel = functools.partial(_fwd_kernel_nl, scale, causal, sk, sq,
-                               dropout_rate, d, g)
+                               dropout_rate, d, g,
+                               causal_off is not None)
     o, lse = pl.pallas_call(
         lambda *refs: kernel(refs),
         grid=(bh // g, nq, nk),
@@ -757,13 +766,17 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                      refs):
+                      has_off, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
+        pos += 1
+    off_ref = None
+    if has_off:
+        off_ref = refs[pos]
         pos += 1
     do_ref, lse_ref, dl_ref, dq_ref, dq_acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
@@ -785,8 +798,9 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
                                 preferred_element_type=jnp.float32) * scale
         valid = _kv_valid(ik, bk, kv_len, bq)
         if causal:
+            off = off_ref[0] if has_off else kv_len - q_len
             valid = jnp.logical_and(
-                valid, _causal_mask(iq, ik, bq, bk, kv_len - q_len))
+                valid, _causal_mask(iq, ik, bq, bk, off))
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -805,13 +819,17 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 
 def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                       refs):
+                       has_off, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
+        pos += 1
+    off_ref = None
+    if has_off:
+        off_ref = refs[pos]
         pos += 1
     do_ref, lse_ref, dl_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[pos:]
     ik, iq = pl.program_id(1), pl.program_id(2)
@@ -834,8 +852,9 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
                                 preferred_element_type=jnp.float32) * scale
         valid = _kv_valid(ik, bk, kv_len, bq)
         if causal:
+            off = off_ref[0] if has_off else kv_len - q_len
             valid = jnp.logical_and(
-                valid, _causal_mask(iq, ik, bq, bk, kv_len - q_len))
+                valid, _causal_mask(iq, ik, bq, bk, off))
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
         valid = jnp.logical_and(valid, rows < q_len)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
@@ -864,7 +883,7 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 
 def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
-                         g, refs):
+                         g, has_off, refs):
     """Single-sweep backward for single-block grids (Sq, Sk each one
     tile): s and p are computed ONCE per head and all three gradients
     come out of the same sweep — the two-kernel split pays a redundant
@@ -877,6 +896,10 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
+        pos += 1
+    off_ref = None
+    if has_off:
+        off_ref = refs[pos]
         pos += 1
     do_ref, lse_ref, dl_ref, dq_ref, dk_ref, dv_ref = refs[pos:]
 
@@ -892,8 +915,9 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
                                 preferred_element_type=jnp.float32) * scale
         valid = _kv_valid(0, bk, kv_len, bq)
         if causal:
+            off = off_ref[0] if has_off else kv_len - q_len
             valid = jnp.logical_and(
-                valid, _causal_mask(0, 0, bq, bk, kv_len - q_len))
+                valid, _causal_mask(0, 0, bq, bk, off))
         rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         valid = jnp.logical_and(valid, rows < q_len)
         p = jnp.where(valid, jnp.exp(s - lse), 0.0)
@@ -923,7 +947,7 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
 
 def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
                         scale, causal, sq, sk, sqp, skp, bq, bk, seed,
-                        dropout_rate):
+                        dropout_rate, causal_off=None):
     b = qp.shape[0]
     H = qp.shape[2]
     bh = b * nh
@@ -940,13 +964,16 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if causal_off is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(causal_off)
     in_specs += [q_spec, lane_spec, lane_spec]
     args += [dop, lse_l, delta_l]
 
     dq, dk, dv = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_fused_kernel_nl, scale, causal, sk, sq, dropout_rate,
-            d, g)(refs),
+            d, g, causal_off is not None)(refs),
         grid=(bh // g,),
         in_specs=in_specs,
         out_specs=(q_spec, k_spec, k_spec),
@@ -961,7 +988,8 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
 
 
 def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
-                  block_q, block_k, dropout_rate=0.0, seed=None):
+                  block_q, block_k, dropout_rate=0.0, seed=None,
+                  causal_off=None):
     """Native-layout backward: operands/outputs (B, S, H); ``lse`` and
     ``delta`` arrive (B·H, Sq)."""
     b, sq, H = q2.shape
@@ -1028,7 +1056,7 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
             return _flash_bwd_fused_nl(qp, kp, vp, dop, lse_f, delta_f,
                                        nh, d, gf, scale, causal, sq, sk,
                                        sqp, skp, bq, bk, seed,
-                                       dropout_rate)
+                                       dropout_rate, causal_off)
 
     gd = g * d
     lse_l = _lanes_nl(lse, bh, g, nq, bq, sq)
@@ -1045,13 +1073,16 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
+    if causal_off is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(causal_off)
     in_specs += [q_spec, lane_spec, lane_spec]
     args += [dop, lse_l, delta_l]
 
     dq = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_dq_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
-            g)(refs),
+            g, causal_off is not None)(refs),
         grid=(bh // g, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -1075,13 +1106,16 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     if dropout_rate > 0.0:
         in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args2.append(seed)
+    if causal_off is not None:
+        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args2.append(causal_off)
     in_specs2 += [q_spec_k, lane_spec_k, lane_spec_k]
     args2 += [dop, lse_l, delta_l]
 
     dk, dv = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_dkv_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
-            g)(refs),
+            g, causal_off is not None)(refs),
         grid=(bh // g, nk, nq),
         in_specs=in_specs2,
         out_specs=(k_spec_k, k_spec_k),
@@ -1098,7 +1132,8 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, bias=None, scale=None, causal=False,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                    dropout_rate=0.0, dropout_seed=None):
+                    dropout_rate=0.0, dropout_seed=None,
+                    causal_offset=None):
     """Blockwise softmax attention.
 
     q: (B, Sq, H, D); k/v: (B, Sk, H, D); bias: optional additive
@@ -1114,10 +1149,18 @@ def flash_attention(q, k, v, bias=None, scale=None, causal=False,
     scalar, typically drawn fresh per step from the training rng). The
     backward kernels regenerate the identical mask from the same seed;
     no mask tensor ever exists in HBM.
+
+    ``causal_offset`` (int32 scalar, may be TRACED — e.g. derived from
+    ``axis_index`` inside a ring hop) shifts the causal frontier: query
+    i attends key j iff ``i + causal_offset >= j``. With ``None`` the
+    frontier is bottom-right aligned (``Sk − Sq``). On the native-layout
+    path the offset rides SMEM into the kernels so ring hops need no
+    O(S²) additive bias; geometries that fall back to the bias path
+    build the mask from the offset internally.
     """
     o, _ = _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale,
                                     causal, block_q, block_k,
-                                    dropout_rate)
+                                    dropout_rate, causal_offset)
     return o
 
 
@@ -1169,11 +1212,32 @@ def _seed_arr(dropout_seed, dropout_rate):
     return jnp.asarray(dropout_seed, jnp.int32).reshape(-1)[:1]
 
 
+def _off_arr(causal_offset, causal):
+    if causal_offset is None:
+        return None
+    if not causal:
+        raise ValueError("causal_offset requires causal=True")
+    return jnp.asarray(causal_offset, jnp.int32).reshape(-1)[:1]
+
+
+def _offset_bias(off_arr, sq, sk):
+    """Fallback additive mask for geometries off the native path:
+    built from the (possibly traced) offset scalar."""
+    rows = jnp.arange(sq, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(sk, dtype=jnp.int32)[None, :]
+    return jnp.where(rows + off_arr[0] >= cols, 0.0,
+                     NEG_INF).reshape(1, 1, sq, sk)
+
+
 def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
-                             block_q, block_k, dropout_rate):
+                             block_q, block_k, dropout_rate,
+                             causal_offset=None):
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     seed = _seed_arr(dropout_seed, dropout_rate)
+    off = _off_arr(causal_offset, causal)
+    if off is not None and bias is not None:
+        raise ValueError("causal_offset cannot combine with a bias")
     if bias is None and _native_g0(h, d) is not None:
         # native-layout path: (B, S, H) operands straight through — no
         # transpose copies, no D zero-pad (see the native-kernel block)
@@ -1181,27 +1245,33 @@ def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
         k2 = k.reshape(b, k.shape[1], h * d)
         v2 = v.reshape(b, v.shape[1], h * d)
         o2, lse = _flash_fwd_nl(q2, k2, v2, h, d, scale, causal,
-                                block_q, block_k, dropout_rate, seed)
+                                block_q, block_k, dropout_rate, seed,
+                                causal_off=off)
         o = o2.reshape(b, sq, h, d)
-        return o, (q, k, v, bias, dropout_seed, o, lse)
+        return o, (q, k, v, bias, dropout_seed, o, lse, causal_offset)
+    eff_bias, eff_causal = bias, causal
+    if off is not None:
+        # no native path for this geometry: the offset becomes an
+        # additive mask (exactly what a caller would have built)
+        eff_bias, eff_causal = _offset_bias(off, sq, k.shape[1]), False
     q3, k3, v3 = _to3(q, k, v)
-    bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
-    o3, lse = _flash_fwd(q3, k3, v3, bias_g, bidx, scale, causal, block_q,
-                         block_k, dropout_rate, seed)
+    bias_g, bidx = _bias_group(eff_bias, b, h, sq, k.shape[1])
+    o3, lse = _flash_fwd(q3, k3, v3, bias_g, bidx, scale, eff_causal,
+                         block_q, block_k, dropout_rate, seed)
     o = jnp.swapaxes(o3.reshape(b, h, sq, d), 1, 2)
-    return o, (q, k, v, bias, dropout_seed, o, lse)
+    return o, (q, k, v, bias, dropout_seed, o, lse, causal_offset)
 
 
 def _fa_fwd(q, k, v, bias, scale, causal, block_q, block_k, dropout_rate,
-            dropout_seed):
+            dropout_seed, causal_offset):
     o, res = _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale,
                                       causal, block_q, block_k,
-                                      dropout_rate)
+                                      dropout_rate, causal_offset)
     return o, res
 
 
 def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
-    q, k, v, bias, dropout_seed, o, lse = res
+    q, k, v, bias, dropout_seed, o, lse, causal_offset = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
@@ -1218,22 +1288,27 @@ def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
         delta = jnp.swapaxes(delta, 1, 2).reshape(b * h, sq)
         dq2, dk2, dv2 = _flash_bwd_nl(
             q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
-            block_q, block_k, dropout_rate=dropout_rate, seed=seed)
+            block_q, block_k, dropout_rate=dropout_rate, seed=seed,
+            causal_off=_off_arr(causal_offset, causal))
         return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
-                dv2.reshape(b, sk, h, d), None, None)
+                dv2.reshape(b, sk, h, d), None, None, None)
+    eff_bias, eff_causal = bias, causal
+    off = _off_arr(causal_offset, causal)
+    if off is not None:
+        eff_bias, eff_causal = _offset_bias(off, sq, sk), False
     q3, k3, v3 = _to3(q, k, v)
-    bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
+    bias_g, bidx = _bias_group(eff_bias, b, h, sq, k.shape[1])
     o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
     do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3,
-                               scale_, causal, block_q, block_k,
+                               scale_, eff_causal, block_q, block_k,
                                dropout_rate=dropout_rate, seed=seed)
     un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
     dbias = None if bias is None else _bias_grad(
         q, k, v, bias, o, lse, do, scale_, causal,
         dropout_rate=dropout_rate, seed=seed,
         block_q=block_q, block_k=block_k)
-    return un(dq3, sq), un(dk3, sk), un(dv3, sk), dbias, None
+    return un(dq3, sq), un(dk3, sk), un(dv3, sk), dbias, None, None
 
 
 def _keep_mask_dense(seed, b, h, sq, sk, bq, bk, rate):
@@ -1336,42 +1411,66 @@ def mask_softmax_dropout(scores, mask=None, dropout_rate=0.0,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
 def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
-                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+                        block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
+                        causal_offset=None):
     """Like :func:`flash_attention` but returns ``(out, lse)`` with
     ``lse`` (B, H, Sq) differentiable — the building block ring attention
     needs to merge partial results across sequence shards.
+    ``causal_offset`` shifts the causal frontier like
+    :func:`flash_attention`'s (ring hops pass their traced global
+    offset so no O(S²) hop bias is ever built on the native path).
     """
-    o, (*_, lse) = _flash_attention_fwd_res(
-        q, k, v, bias, None, scale, causal, block_q, block_k, 0.0)
-    b, sq, h, d = q.shape
-    return o, lse.reshape(b, h, sq)
+    (o, lse), _ = _fal_fwd(q, k, v, bias, scale, causal, block_q,
+                           block_k, causal_offset)
+    return o, lse
 
 
-def _fal_fwd(q, k, v, bias, scale, causal, block_q, block_k):
+def _fal_fwd(q, k, v, bias, scale, causal, block_q, block_k,
+             causal_offset):
     o, res = _flash_attention_fwd_res(q, k, v, bias, None, scale, causal,
-                                      block_q, block_k, 0.0)
+                                      block_q, block_k, 0.0,
+                                      causal_offset)
     b, sq, h, _ = q.shape
     return (o, res[6].reshape(b, h, sq)), res
 
 
 def _fal_bwd(scale, causal, block_q, block_k, res, cot):
     do, dlse = cot
-    q, k, v, bias, _, o, lse = res
+    q, k, v, bias, _, o, lse, causal_offset = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
-    q3, k3, v3 = _to3(q, k, v)
-    bias_g, bidx = _bias_group(bias, b, h, sq, k.shape[1])
-    o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
-    do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     # d lse/d s = p, so the lse cotangent folds into the delta term:
     # ds = p*(dp - delta) + p*dlse = p*(dp - (delta - dlse))
+    if bias is None and _native_g0(h, d) is not None:
+        q2 = q.reshape(b, sq, h * d)
+        k2 = k.reshape(b, sk, h * d)
+        v2 = v.reshape(b, sk, h * d)
+        do2 = do.reshape(b, sq, h * d)
+        delta = jnp.sum(
+            (do.astype(jnp.float32) * o.astype(jnp.float32)), axis=-1)
+        delta = jnp.swapaxes(delta, 1, 2).reshape(b * h, sq)
+        delta = delta - dlse.reshape(b * h, sq).astype(jnp.float32)
+        dq2, dk2, dv2 = _flash_bwd_nl(
+            q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
+            block_q, block_k,
+            causal_off=_off_arr(causal_offset, causal))
+        return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
+                dv2.reshape(b, sk, h, d), None, None)
+    eff_bias, eff_causal = bias, causal
+    off = _off_arr(causal_offset, causal)
+    if off is not None:
+        eff_bias, eff_causal = _offset_bias(off, sq, sk), False
+    q3, k3, v3 = _to3(q, k, v)
+    bias_g, bidx = _bias_group(eff_bias, b, h, sq, k.shape[1])
+    o3 = jnp.swapaxes(o, 1, 2).reshape(b * h, sq, d)
+    do3 = jnp.swapaxes(do, 1, 2).reshape(b * h, sq, d)
     dlse3 = dlse.reshape(b * h, sq)
     dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, bias_g, bidx, o3, lse, do3,
-                               scale_, causal, block_q, block_k,
+                               scale_, eff_causal, block_q, block_k,
                                delta_shift=dlse3)
     un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
-    return un(dq3, sq), un(dk3, sk), un(dv3, sk), None
+    return un(dq3, sq), un(dk3, sk), un(dv3, sk), None, None
 
 
 flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)

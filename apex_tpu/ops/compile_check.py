@@ -293,37 +293,53 @@ def _():
 
 @case("attention/ring-hop-shapes")
 def _():
-    # the ring per-hop call: flash_attention_lse under a (1,1,sq,sk)
-    # global-causal additive bias (the 512-tile bias path), grads
-    # through (o, lse) both — Mosaic legality on the chip for the hop
-    # kernels the CPU-mesh dryrun exercises only in interpret mode
+    # the ring per-hop call: flash_attention_lse under the TRACED
+    # causal_offset (the native-path hop — no O(S²) bias), grads
+    # through (o, lse) both, PLUS the (1,1,sq,sk) additive-bias form
+    # it replaced (still the fallback for non-native geometries) —
+    # Mosaic legality on the chip for the hop kernels the CPU-mesh
+    # dryrun exercises only in interpret mode
     from apex_tpu.ops.attention import (attention_reference,
                                         flash_attention_lse)
     sq = sk = 1024
     q = _rand((1, sq, 2, 64), 0, jnp.bfloat16, 0.5)
     k = _rand((1, sk, 2, 64), 1, jnp.bfloat16, 0.5)
     v = _rand((1, sk, 2, 64), 2, jnp.bfloat16, 0.5)
-    # hop bias: query global offset sq (second shard), key offset 0
+    # hop: query global offset sq (second shard), key offset 0
     rows = np.arange(sq)[:, None] + sq
     cols = np.arange(sk)[None, :]
     bias = jnp.asarray(np.where(rows >= cols, 0.0, -1e9),
                        jnp.float32).reshape(1, 1, sq, sk)
     g = _rand((1, sq, 2, 64), 3)
 
-    def loss(q, k, v):
+    def loss_off(q, k, v, off):
+        o, lse = flash_attention_lse(q, k, v, causal=True,
+                                     causal_offset=off)
+        return jnp.sum(o.astype(jnp.float32) * g) \
+            + 1e-3 * jnp.sum(lse.astype(jnp.float32))
+
+    def loss_bias(q, k, v):
         o, lse = flash_attention_lse(q, k, v, bias=bias)
         return jnp.sum(o.astype(jnp.float32) * g) \
             + 1e-3 * jnp.sum(lse.astype(jnp.float32))
 
-    got = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))(q, k, v)
-    assert np.isfinite(float(got[0]))
+    off = jnp.int32(sq)
     want_o = attention_reference(q.astype(jnp.float32),
                                  k.astype(jnp.float32),
                                  v.astype(jnp.float32), bias=bias)
-    o, _ = jax.jit(flash_attention_lse)(q, k, v, bias=bias)
-    _check("ring hop fwd", o, want_o, 5e-2)
-    for gg in got[1]:
-        assert np.all(np.isfinite(np.asarray(gg, np.float32)))
+    o_off, _ = jax.jit(lambda q, k, v, s: flash_attention_lse(
+        q, k, v, causal=True, causal_offset=s))(q, k, v, off)
+    _check("ring hop fwd (offset)", o_off, want_o, 5e-2)
+    o_b, _ = jax.jit(flash_attention_lse)(q, k, v, bias=bias)
+    _check("ring hop fwd (bias)", o_b, want_o, 5e-2)
+
+    for lossfn, args in ((loss_off, (q, k, v, off)),
+                         (loss_bias, (q, k, v))):
+        got = jax.jit(jax.value_and_grad(
+            lossfn, argnums=(0, 1, 2)))(*args)
+        assert np.isfinite(float(got[0]))
+        for gg in got[1]:
+            assert np.all(np.isfinite(np.asarray(gg, np.float32)))
 
 
 @case("attention/ulysses-resharded")

@@ -18,9 +18,14 @@ over a ``seq`` mesh axis:
   flash attention runs unsharded, and a second all-to-all restores
   sequence sharding. One collective pair, best when heads % devices == 0.
 
-Causality across shards uses global-position additive bias, so the kernel
+Causality across shards rides the kernels' ``causal_offset`` (a traced
+scalar, derived from the device's ring position): query i attends key j
+iff ``i + offset >= j`` with ``offset = my·sq − src·sk``. The kernel
 call stays identical on every device (SPMD-friendly: no data-dependent
-branching on rank).
+branching on rank), no O(S²) hop bias is ever materialized, and the hop
+runs the native-layout kernel path at full tile sizes. Geometries the
+native path can't serve fall back to an internally-built additive mask
+(the previous behavior).
 """
 
 from __future__ import annotations
@@ -33,13 +38,6 @@ import jax.numpy as jnp
 from apex_tpu.ops.attention import flash_attention, flash_attention_lse
 
 NEG_INF = -1e30
-
-
-def _global_causal_bias(sq, sk, q_off, k_off):
-    """(1, 1, sq, sk) additive bias: 0 where global q pos >= global k pos."""
-    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + q_off
-    cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1) + k_off
-    return jnp.where(rows >= cols, 0.0, NEG_INF)[None, None]
 
 
 def _merge(o, lse, o_i, lse_i):
@@ -69,10 +67,11 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
     def block(q, kv_k, kv_v, src):
         if causal:
-            bias = _global_causal_bias(sq, sk, my * sq, src * sk)
-        else:
-            bias = None
-        return flash_attention_lse(q, kv_k, kv_v, bias=bias, scale=scale)
+            # global causality as a traced offset — no hop bias tensor
+            off = my * sq - src * sk
+            return flash_attention_lse(q, kv_k, kv_v, scale=scale,
+                                       causal=True, causal_offset=off)
+        return flash_attention_lse(q, kv_k, kv_v, scale=scale)
 
     o, lse = block(q, k, v, my)
     cur_k, cur_v = k, v

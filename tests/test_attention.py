@@ -461,3 +461,122 @@ class TestNativeLayoutPath:
         for g, w in zip(self._grads(fn, (q, k, v)),
                         self._grads(ref, (q, k, v))):
             np.testing.assert_allclose(g, w, atol=5e-4, rtol=1e-3)
+
+
+class TestCausalOffset:
+    """flash_attention(causal_offset=...) vs the additive-mask oracle:
+    the offset (a traced scalar) must reproduce exactly the mask a
+    caller would build — native path (d=64) and bias-fallback path
+    (d=32), lse variant included (the ring-hop building block)."""
+
+    @pytest.mark.parametrize("d", [64, 32])
+    @pytest.mark.parametrize("off", [0, 64, 4096])
+    def test_matches_offset_bias_oracle(self, d, off):
+        rng = np.random.RandomState(11)
+        q, k, v = rand_qkv(rng, 1, 128, 4, d)
+
+        def fn(q, k, v, off_):
+            return A.flash_attention(q, k, v, causal=True,
+                                     causal_offset=off_)
+
+        rows = np.arange(128)[:, None] + off
+        cols = np.arange(128)[None, :]
+        bias = jnp.asarray(np.where(rows >= cols, 0.0, A.NEG_INF),
+                           jnp.float32)[None, None]
+
+        def ref(q, k, v):
+            return A.attention_reference(q, k, v, bias=bias)
+
+        got = jax.jit(fn)(q, k, v, jnp.int32(off))
+        np.testing.assert_allclose(got, ref(q, k, v), atol=2e-5,
+                                   rtol=1e-5)
+        g1 = jax.jit(jax.grad(
+            lambda q, k, v, o_: jnp.sum(fn(q, k, v, o_) ** 2),
+            argnums=(0, 1, 2)))(q, k, v, jnp.int32(off))
+        g2 = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+
+    def test_fully_masked_rows_finite_and_lse_guarded(self):
+        """Negative offsets can leave early rows with NO valid keys.
+        Those rows are out-of-contract (softmax over an empty set);
+        what the framework guarantees is (a) finite outputs/gradients
+        and (b) an lse of ~NEG_INF so ring attention's merge gives the
+        hop zero weight — the guard `ring.py` relies on. Valid rows
+        must still match the oracle exactly."""
+        rng = np.random.RandomState(14)
+        q, k, v = rand_qkv(rng, 1, 128, 2, 64)
+        off = -96   # rows 0..95 fully masked
+        o, lse = jax.jit(lambda q, k, v: A.flash_attention_lse(
+            q, k, v, causal=True,
+            causal_offset=jnp.int32(off)))(q, k, v)
+        assert np.all(np.isfinite(np.asarray(o, np.float32)))
+        # masked rows: merge weight exp(lse - lse_c) underflows to 0
+        assert np.all(np.asarray(lse)[..., :96] < -1e29)
+        assert np.all(np.asarray(lse)[..., 96:] > -1e4)
+        # valid rows agree with the dense oracle
+        rows = np.arange(128)[:, None] + off
+        cols = np.arange(128)[None, :]
+        bias = jnp.asarray(np.where(rows >= cols, 0.0, A.NEG_INF),
+                           jnp.float32)[None, None]
+        want = A.attention_reference(q, k, v, bias=bias)
+        np.testing.assert_allclose(np.asarray(o)[:, 96:],
+                                   np.asarray(want)[:, 96:], atol=2e-5,
+                                   rtol=1e-5)
+        g = jax.jit(jax.grad(lambda q: jnp.sum(A.flash_attention(
+            q, k, v, causal=True,
+            causal_offset=jnp.int32(off)) ** 2)))(q)
+        assert np.all(np.isfinite(np.asarray(g, np.float32)))
+
+    def test_lse_variant_offset(self):
+        rng = np.random.RandomState(12)
+        q, k, v = rand_qkv(rng, 1, 128, 2, 64)
+        o1, lse1 = A.flash_attention_lse(q, k, v, causal=True,
+                                         causal_offset=jnp.int32(32))
+        rows = np.arange(128)[:, None] + 32
+        cols = np.arange(128)[None, :]
+        bias = jnp.asarray(np.where(rows >= cols, 0.0, A.NEG_INF),
+                           jnp.float32)[None, None]
+        o2, lse2 = A.flash_attention_lse(q, k, v, bias=bias)
+        np.testing.assert_allclose(o1, o2, atol=2e-5, rtol=1e-5)
+        np.testing.assert_allclose(lse1, lse2, atol=1e-5, rtol=1e-5)
+
+    def test_offset_requires_causal(self):
+        rng = np.random.RandomState(13)
+        q, k, v = rand_qkv(rng, 1, 64, 2, 64)
+        with pytest.raises(ValueError):
+            A.flash_attention(q, k, v, causal_offset=jnp.int32(1))
+
+    @pytest.mark.parametrize("off", [0, 96])
+    def test_multiblock_native_offset_bwd(self, off):
+        """Small blocks over S=256 force the two-kernel native backward
+        (the kernels a ring hop at per-shard S > the tile hits): the
+        off_ref handling in _bwd_dq_kernel_nl/_bwd_dkv_kernel_nl must
+        match the dense oracle, gradients included."""
+        rng = np.random.RandomState(15)
+        q, k, v = rand_qkv(rng, 1, 256, 2, 64)
+        kw = {"block_q": 128, "block_k": 128}
+
+        def fn(q, k, v, off_):
+            return A.flash_attention(q, k, v, causal=True,
+                                     causal_offset=off_, **kw)
+
+        rows = np.arange(256)[:, None] + off
+        cols = np.arange(256)[None, :]
+        bias = jnp.asarray(np.where(rows >= cols, 0.0, A.NEG_INF),
+                           jnp.float32)[None, None]
+
+        def ref(q, k, v):
+            return A.attention_reference(q, k, v, bias=bias)
+
+        got = jax.jit(fn)(q, k, v, jnp.int32(off))
+        np.testing.assert_allclose(got, ref(q, k, v), atol=2e-5,
+                                   rtol=1e-5)
+        g1 = jax.jit(jax.grad(
+            lambda q, k, v, o_: jnp.sum(fn(q, k, v, o_) ** 2),
+            argnums=(0, 1, 2)))(q, k, v, jnp.int32(off))
+        g2 = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) ** 2),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
