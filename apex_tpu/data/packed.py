@@ -31,6 +31,7 @@ checked in BENCH_TABLE.md.
 from __future__ import annotations
 
 import concurrent.futures
+import hashlib
 import json
 import os
 from typing import Iterator, Optional, Tuple
@@ -38,6 +39,44 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 META = "meta.json"
+
+
+def _v3_view_on_strided() -> bool:
+    """numpy>=1.23 allows an itemsize-changing view on arrays that are
+    contiguous only in the last axis — probed once, not per image."""
+    try:
+        np.zeros((4, 4, 3), np.uint8)[1:3, 1:3].view("V3")
+        return True
+    except ValueError:
+        return False
+
+
+_V3_STRIDED_OK = _v3_view_on_strided()
+
+
+def _fingerprint(root: str, paths, labels) -> str:
+    """Content identity of the source listing: relative paths, labels,
+    and each file's (size, mtime_ns). Guards cache reuse against a
+    same-count dataset whose files, labels, or in-place contents
+    changed (ADVICE r4: count+size alone served stale pixels).
+
+    Detecting in-place edits costs one metadata sweep even on the
+    cache-HIT path; it is batched as one scandir per class directory
+    (readdir-plus filesystems serve size/mtime from the directory
+    pass), which bounds the warm-start cost at directory enumeration —
+    the same order as the listing build_cache already does."""
+    stats = {}
+    for d in sorted({os.path.dirname(p) for p in paths}):
+        with os.scandir(d) as it:
+            for e in it:
+                stats[e.path] = e.stat()
+    h = hashlib.sha256()
+    for p, y in zip(paths, labels):
+        st = stats[p]
+        h.update(os.path.relpath(p, root).encode())
+        h.update(b"\0%d\0%d\0%d\n" % (int(y), st.st_size,
+                                      st.st_mtime_ns))
+    return h.hexdigest()
 
 
 def _decode_store(path: str, store_size: int) -> np.ndarray:
@@ -65,11 +104,13 @@ def build_cache(root: str, cache_dir: str, *, store_size: int = 256,
     paths, labels, classes = _list_imagefolder(root)
     os.makedirs(cache_dir, exist_ok=True)
     meta_path = os.path.join(cache_dir, META)
+    fp = _fingerprint(root, paths, labels)
     if os.path.exists(meta_path):
         with open(meta_path) as f:
             meta = json.load(f)
         if (meta.get("n") == len(paths)
-                and meta.get("store_size") == store_size):
+                and meta.get("store_size") == store_size
+                and meta.get("fingerprint") == fp):
             return cache_dir
 
     workers = workers or min(16, (os.cpu_count() or 1))
@@ -90,7 +131,7 @@ def build_cache(root: str, cache_dir: str, *, store_size: int = 256,
         pool.shutdown(wait=False)
     np.save(os.path.join(cache_dir, "labels.npy"), labels)
     meta = {"n": len(paths), "store_size": store_size,
-            "shards": shards, "classes": classes}
+            "shards": shards, "classes": classes, "fingerprint": fp}
     with open(meta_path, "w") as f:
         json.dump(meta, f)
     return cache_dir
@@ -166,7 +207,10 @@ class PackedSource:
             else:
                 crop = img[y0s[j]:y0s[j] + c, x0s[j]:x0s[j] + c]
             if flips is not None and flips[j]:
-                u8v[j] = crop.view("V3")[:, ::-1]
+                if _V3_STRIDED_OK:
+                    u8v[j] = crop.view("V3")[:, ::-1]
+                else:
+                    u8[j] = crop[:, ::-1]
             else:
                 u8[j] = crop
 

@@ -586,9 +586,20 @@ def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
     g0 = _native_g0(nh, d)
     forced = os.environ.get("APEX_TPU_NATIVE_G")
     if forced:
-        g = int(forced)
-        if g % g0 == 0 and nh % g == 0:
+        try:
+            g = int(forced)
+        except ValueError:
+            raise ValueError(
+                f"APEX_TPU_NATIVE_G={forced!r} is not an integer; it "
+                "must be a multiple of the lane-alignment group "
+                f"g0={g0} that divides nh={nh}") from None
+        if g > 0 and g % g0 == 0 and nh % g == 0:
             return g
+        import warnings
+        warnings.warn(
+            f"APEX_TPU_NATIVE_G={g} ignored: must be a positive multiple of "
+            f"g0={g0} (lane alignment for d={d}) and divide nh={nh}; "
+            "using the VMEM-ledger choice instead", stacklevel=3)
     # full ledger of what the fwd kernel keeps in scoped VMEM: the
     # double-buffered q/k/v in-blocks, the m/l/acc scratch, the f32
     # score tile, the o and lse out-blocks (also double-buffered), and

@@ -326,6 +326,26 @@ class FusedAdagrad(FusedOptimizer):
         return p2, FusedOptState(count=count, slots={"h": h2})
 
 
+def lamb_trust_ratios(part, p, u, *, use_nvlamb, weight_decay):
+    """Per-position LAMB trust ratios over one arena partition.
+
+    Static arena ranges → per-tensor norms as fused slice-reduces and
+    the trust-ratio spread as concatenated broadcasts; the traced
+    segment_ids alternative lowers to scatter/gather over the whole
+    arena, which TPU serializes (~500 ms on a BERT-Large buffer).
+    NVLAMB applies the ratio even where wd==0 — with a single group,
+    plain LAMB and NVLAMB agree unless wd==0 globally. Shared by the
+    modern and legacy-contrib FusedLAMB surfaces.
+    """
+    p_norms = MT.per_tensor_l2norm_ranges(p, part.offsets, part.sizes)
+    u_norms = MT.per_tensor_l2norm_ranges(u, part.offsets, part.sizes)
+    ratio = jnp.where((p_norms > 0) & (u_norms > 0),
+                      p_norms / u_norms, 1.0)
+    if not use_nvlamb and weight_decay == 0.0:
+        ratio = jnp.ones_like(ratio)
+    return MT.spread_per_tensor(ratio, part.offsets, part.padded, len(p))
+
+
 class FusedLAMB(FusedOptimizer):
     """LAMB (`apex/optimizers/fused_lamb.py:4-215`): global grad-norm clip,
     Adam-style direction, per-tensor trust ratio.
@@ -373,20 +393,9 @@ class FusedLAMB(FusedOptimizer):
             adam_w_mode=self.adam_w_mode, clip_scale=clip)
 
         part = spec.partition(dt)
-        # static arena ranges → per-tensor norms as fused slice-reduces and
-        # the trust-ratio spread as concatenated broadcasts; the traced
-        # segment_ids alternative lowers to scatter/gather over the whole
-        # arena, which TPU serializes (~500 ms on a BERT-Large buffer)
-        p_norms = MT.per_tensor_l2norm_ranges(p, part.offsets, part.sizes)
-        u_norms = MT.per_tensor_l2norm_ranges(u, part.offsets, part.sizes)
-        # trust ratio per tensor; NVLAMB applies it even where wd==0 — with
-        # a single group, plain LAMB and NVLAMB agree unless wd==0 globally
-        ratio = jnp.where((p_norms > 0) & (u_norms > 0),
-                          p_norms / u_norms, 1.0)
-        if not self.use_nvlamb and self.weight_decay == 0.0:
-            ratio = jnp.ones_like(ratio)
-        ratio_pos = MT.spread_per_tensor(ratio, part.offsets, part.padded,
-                                         len(p))
+        ratio_pos = lamb_trust_ratios(part, p, u,
+                                      use_nvlamb=self.use_nvlamb,
+                                      weight_decay=self.weight_decay)
         p2 = K.lamb_stage2(p, u, ratio_pos, lr=lr)
         return p2, {"m": m2, "v": v2}
 

@@ -22,7 +22,9 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu import arena
+from apex_tpu.ops import multi_tensor as MT
 from apex_tpu.ops import optim_kernels as K
+from apex_tpu.optim import fused
 from apex_tpu.optim.fused import FusedOptState, Scalar
 
 
@@ -35,6 +37,11 @@ class _LegacyFused:
         slots = {name: arena.zeros(spec, dtype=jnp.float32)
                  for name in self.slot_names}
         return FusedOptState(count=jnp.int32(0), slots=slots)
+
+    def _step_context(self, g_bufs, inv):
+        """Per-step value computed once over ALL partitions before the
+        per-partition kernels (LAMB's global grad-norm clip)."""
+        return None
 
     def step(self, grads, state: FusedOptState, params, *,
              scale: float = 1.0, output_dtype=None):
@@ -52,14 +59,15 @@ class _LegacyFused:
         count = state.count + 1
         lr = self.lr(count) if callable(self.lr) else self.lr
         inv = 1.0 / scale
+        ctx = self._step_context(g_bufs, inv)
 
         new_p, new_slots = {}, {n: {} for n in self.slot_names}
         copies = {}
         for part in spec.partitions:
             dt = part.dtype
             slots = {n: state.slots[n][dt] for n in self.slot_names}
-            out = self._kernel(p_bufs[dt], g_bufs[dt], slots, count, lr,
-                               inv, output_dtype)
+            out = self._kernel(part, p_bufs[dt], g_bufs[dt], slots, count,
+                               lr, inv, output_dtype, ctx)
             new_p[dt] = out[0]
             for n, v in zip(self.slot_names, out[1:1 + len(
                     self.slot_names)]):
@@ -92,7 +100,8 @@ class FusedAdam(_LegacyFused):
         self.adam_w_mode = adam_w_mode
         self.bias_correction = bias_correction
 
-    def _kernel(self, p, g, slots, count, lr, inv, output_dtype):
+    def _kernel(self, part, p, g, slots, count, lr, inv, output_dtype,
+                ctx):
         return K.adam_update(
             p, g, slots["m"], slots["v"], lr=lr, beta1=self.beta1,
             beta2=self.beta2, eps=self.eps,
@@ -119,7 +128,8 @@ class FusedSGD(_LegacyFused):
         self.nesterov = nesterov
         self.wd_after_momentum = wd_after_momentum
 
-    def _kernel(self, p, g, slots, count, lr, inv, output_dtype):
+    def _kernel(self, part, p, g, slots, count, lr, inv, output_dtype,
+                ctx):
         first = (count == 1) if self.momentum > 0 else False
         return K.sgd_update(
             p, g, slots["m"], lr=lr, momentum=self.momentum,
@@ -127,3 +137,63 @@ class FusedSGD(_LegacyFused):
             nesterov=self.nesterov, first_run=first,
             wd_after_momentum=self.wd_after_momentum, grad_scale=inv,
             param_copy_dtype=output_dtype)
+
+
+class FusedLAMB(_LegacyFused):
+    """Deprecated contrib FusedLAMB (`contrib/optimizers/fused_lamb.py:
+    6-192`): global grad-norm clip + Adam direction + per-tensor trust
+    ratio over the arena kernels.
+
+    The reference's legacy class drives ``p.grad`` directly
+    (`fused_lamb.py:95`), but this surface keeps the shared legacy call
+    shape — ``step(grads, state, params, scale=..., output_dtype=...)``
+    — so its ``FP16_Optimizer`` interop (scaled grads in, model-copy
+    out) works identically across the legacy trio. The clip factor is
+    computed from the *unscaled* global norm and folded with ``1/scale``
+    into stage 1's grad multiplier, so unscale+clip cost no extra pass.
+    """
+
+    slot_names = ("m", "v")
+
+    def __init__(self, lr: Scalar = 1e-3, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
+                 adam_w_mode=True, max_grad_norm=1.0, use_nvlamb=False):
+        self.lr = lr
+        self.bias_correction = bias_correction
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.max_grad_norm = max_grad_norm
+        self.use_nvlamb = use_nvlamb
+
+    def _step_context(self, g_bufs, inv):
+        # differs from fused.FusedLAMB._global_clip_scale only in that
+        # the buffers here hold SCALED grads: the threshold compare must
+        # see gnorm*inv, and the clip factor folds with inv into stage
+        # 1's single grad multiplier (unscaled grads never materialize)
+        if not self.max_grad_norm:
+            return jnp.float32(inv)
+        sq = sum(jnp.square(MT.multi_tensor_l2norm(g))
+                 for g in g_bufs.values())
+        gnorm = jnp.sqrt(sq) * inv
+        clip = jnp.where(gnorm > self.max_grad_norm,
+                         self.max_grad_norm / gnorm, 1.0)
+        return (clip * inv).astype(jnp.float32)
+
+    def _kernel(self, part, p, g, slots, count, lr, inv, output_dtype,
+                ctx):
+        u, m2, v2 = K.lamb_stage1(
+            p, g, slots["m"], slots["v"], beta1=self.beta1,
+            beta2=self.beta2, eps=self.eps,
+            weight_decay=self.weight_decay, step=count,
+            bias_correction=self.bias_correction,
+            adam_w_mode=self.adam_w_mode, clip_scale=ctx)
+        ratio_pos = fused.lamb_trust_ratios(
+            part, p, u, use_nvlamb=self.use_nvlamb,
+            weight_decay=self.weight_decay)
+        out = K.lamb_stage2(p, u, ratio_pos, lr=lr,
+                            param_copy_dtype=output_dtype)
+        if output_dtype is not None:
+            return out[0], m2, v2, out[1]
+        return out, m2, v2                 # single output is unwrapped

@@ -112,11 +112,15 @@ def latest_xplane(logdir: str) -> Optional[str]:
 def _load_xspace(path: str):
     try:
         from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception as e:  # pragma: no cover - env without tensorflow
+    except Exception as e:
         raise ImportError(
             "parsing xplane.pb requires the xplane proto bundled with "
             "tensorflow (tensorflow.tsl.profiler.protobuf.xplane_pb2); "
-            f"import failed: {e!r}") from e
+            f"import failed: {e!r}. Without tensorflow, use the "
+            "XLA-cost-analysis path instead — apex_tpu.prof.hlo."
+            "op_estimates / cost_analysis on the jitted step — "
+            "which needs no trace files (the reference degrades its "
+            "scaler the same way, apex/amp/scaler.py:39-52)") from e
     xs = xplane_pb2.XSpace()
     with open(path, "rb") as f:
         xs.ParseFromString(f.read())

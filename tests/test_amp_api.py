@@ -456,6 +456,39 @@ class TestLegacySurfaces:
         np.testing.assert_allclose(np.asarray(p1["w"]),
                                    np.asarray(p2["w"]), rtol=1e-6)
 
+    def test_legacy_fused_lamb_parity_and_scale(self):
+        """legacy.FusedLAMB at scale=1 matches optim.FusedLAMB (arena
+        strategy) bit-for-bit in math; scaled grads land identically
+        (`contrib/optimizers/fused_lamb.py` capability)."""
+        from apex_tpu.optim import legacy, FusedLAMB
+
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(32, 8), jnp.float32),
+                  "b": jnp.asarray(rng.randn(8), jnp.float32)}
+        grads = {"w": jnp.asarray(rng.randn(32, 8), jnp.float32),
+                 "b": jnp.asarray(rng.randn(8), jnp.float32)}
+
+        lo = legacy.FusedLAMB(lr=1e-2, weight_decay=0.01)
+        ls = lo.init(params)
+        p1, ls = lo.step(grads, ls, params, scale=1.0)
+
+        modern = FusedLAMB(lr=1e-2, weight_decay=0.01, strategy="arena")
+        ms = modern.init(params)
+        p2, _ = modern.step(grads, ms, params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p1[k]),
+                                       np.asarray(p2[k]), rtol=1e-6)
+
+        # scaled grads + copy-out: same result as unscaled, bf16 copy
+        lo2 = legacy.FusedLAMB(lr=1e-2, weight_decay=0.01)
+        sg = jax.tree_util.tree_map(lambda g: g * 256.0, grads)
+        p3, _, copy = lo2.step(sg, lo2.init(params), params, scale=256.0,
+                               output_dtype=jnp.bfloat16)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p3[k]),
+                                       np.asarray(p1[k]), rtol=1e-5)
+            assert copy[k].dtype == jnp.bfloat16
+
 
 class TestFunctionalPatch:
     """O1 raw-op coverage: jnp/lax entry points under auto_cast

@@ -230,3 +230,73 @@ def test_resnet_dx_distribute_matches_baseline_grads():
             jax.tree_util.tree_leaves_with_path(bsd)):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5,
                                    err_msg=jax.tree_util.keystr(pa))
+
+
+def _map_block_params_join(bp, *, params):
+    """Baseline BottleneckBlock subtree → dx_distribute='join' layout:
+    only the final 1x1+BN+add+relu becomes a ConvBNAct unit, so Conv_0/1
+    and _BN_0/1 keep their names, the projection shifts Conv_3→Conv_2,
+    and baseline Conv_2 (final 1x1) + _BN_3 fold into ConvBNAct_0."""
+    out = {}
+    if params:
+        out["Conv_0"] = bp["Conv_0"]
+        out["Conv_1"] = bp["Conv_1"]
+        out["Conv_2"] = bp["Conv_3"]          # projection
+        out["ConvBNAct_0"] = {"kernel": bp["Conv_2"]["kernel"]}
+    for name in ("_BN_0", "_BN_1"):
+        out[name] = bp[name]
+    out["_BN_2"] = bp["_BN_2"]            # projection BN
+    for k, v in bp["_BN_3"]["FusedBNAct_0"].items():
+        out.setdefault("ConvBNAct_0", {})[k] = v
+    return out
+
+
+def test_block_dx_distribute_join_matches_baseline():
+    """ADVICE r4: 'join' mode had no coverage. Block-level oracle — the
+    join-only BottleneckBlock (skipped final conv, ConvBNAct join unit,
+    shifted flax auto-names) must reproduce the baseline block's output,
+    every gradient, and the updated running stats."""
+    from apex_tpu.models.resnet import BottleneckBlock
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((4, 16, 16, 8)), jnp.float32)
+
+    def build(mode):
+        return BottleneckBlock(features=4, strides=(2, 2),
+                               dx_distribute=mode)
+
+    base, dist = build(None), build("join")
+    vb = base.init(jax.random.PRNGKey(0), x, train=True)
+    pj = _map_block_params_join(vb["params"], params=True)
+    sj = _map_block_params_join(vb["batch_stats"], params=False)
+    vj_shape = dist.init(jax.random.PRNGKey(0), x, train=True)
+    assert (jax.tree_util.tree_structure(pj)
+            == jax.tree_util.tree_structure(vj_shape["params"]))
+
+    t = jnp.asarray(rng.standard_normal((4, 8, 8, 16)), jnp.float32)
+
+    def loss_fn(model, params, stats):
+        z, mut = model.apply(
+            {"params": params, "batch_stats": stats}, x, train=True,
+            mutable=["batch_stats"])
+        return jnp.sum(z * t), mut["batch_stats"]
+
+    (lb, bsb), gb = jax.value_and_grad(
+        lambda p: loss_fn(base, p, vb["batch_stats"]),
+        has_aux=True)(vb["params"])
+    (lj, bsj), gj = jax.value_and_grad(
+        lambda p: loss_fn(dist, p, sj), has_aux=True)(pj)
+
+    np.testing.assert_allclose(float(lb), float(lj), rtol=1e-5)
+    gb_mapped = _map_block_params_join(gb, params=True)
+    for (pa, a), (pb_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(gb_mapped),
+            jax.tree_util.tree_leaves_with_path(gj)):
+        np.testing.assert_allclose(a, b, rtol=5e-4, atol=5e-5,
+                                   err_msg=jax.tree_util.keystr(pa))
+    bsb_mapped = _map_block_params_join(bsb, params=False)
+    for (pa, a), (pb_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(bsb_mapped),
+            jax.tree_util.tree_leaves_with_path(bsj)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5,
+                                   err_msg=jax.tree_util.keystr(pa))

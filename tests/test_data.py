@@ -116,6 +116,36 @@ def test_build_cache_layout_and_idempotence(tree, cache):
         os.path.join(cache, "shard_00000.npy")) == m0
 
 
+def test_build_cache_rebuilds_on_content_change(tmp_path):
+    """Same file count, changed content → fingerprint mismatch forces a
+    rebuild (ADVICE r4: count+size reuse served stale pixels)."""
+    import json, os
+    root = make_fake_imagefolder(str(tmp_path / "imgs"), n_classes=2,
+                                 per_class=3, size=64)
+    cdir = str(tmp_path / "cache")
+    build_cache(root, cdir, store_size=48, shard_images=4)
+    meta_path = os.path.join(cdir, "meta.json")
+    with open(meta_path) as f:
+        fp0 = json.load(f)["fingerprint"]
+    # rename one class dir: same count, different path list + labels
+    cls = sorted(os.listdir(root))[0]
+    os.rename(os.path.join(root, cls), os.path.join(root, "zzz_" + cls))
+    build_cache(root, cdir, store_size=48, shard_images=4)
+    with open(meta_path) as f:
+        fp1 = json.load(f)["fingerprint"]
+    assert fp1 != fp0
+
+    # in-place edit: same paths and labels, touched mtime → rebuild
+    cls0 = sorted(os.listdir(root))[0]
+    img0 = os.path.join(root, cls0,
+                        sorted(os.listdir(os.path.join(root, cls0)))[0])
+    os.utime(img0, ns=(os.stat(img0).st_atime_ns,
+                       os.stat(img0).st_mtime_ns + 10**9))
+    build_cache(root, cdir, store_size=48, shard_images=4)
+    with open(meta_path) as f:
+        assert json.load(f)["fingerprint"] != fp1
+
+
 def test_packed_source_batches_and_labels(cache):
     with PackedSource(cache, batch=4, size=32, seed=0) as src:
         assert len(src) == 3

@@ -155,6 +155,25 @@ def test_xplane_parser_synthetic(tmp_path):
     assert "conv" in tp.table()
 
 
+def test_xplane_parse_without_tensorflow(tmp_path, monkeypatch):
+    """With the tf proto import blocked, parse raises an actionable error
+    naming the HLO-estimates fallback (the reference degrades its scaler
+    import the same way, apex/amp/scaler.py:39-52)."""
+    import builtins
+    path = tmp_path / "host.xplane.pb"
+    path.write_bytes(b"")
+    real_import = builtins.__import__
+
+    def block_tf(name, *args, **kwargs):
+        if name.startswith("tensorflow"):
+            raise ModuleNotFoundError("No module named 'tensorflow'")
+        return real_import(name, *args, **kwargs)
+
+    monkeypatch.setattr(builtins, "__import__", block_tf)
+    with pytest.raises(ImportError, match="op_estimates"):
+        prof.parse_trace(str(path))
+
+
 def test_trace_capture_roundtrip(tmp_path):
     """End-to-end: capture a real trace, parse it without raising."""
     logdir = str(tmp_path / "trace")
