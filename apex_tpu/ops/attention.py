@@ -129,6 +129,30 @@ def _kv_valid(ik, bk, kv_len, bq):
     return cols < kv_len
 
 
+def _tile_valid(iq, ik, bq, bk, kv_len, q_len, causal, off, *,
+                need_rows):
+    """Validity mask for one (bq, bk) score tile, or None when it is
+    statically all-true. kv_len/q_len/bq/bk are Python ints, so each
+    term elides independently at trace time: the kv-pad term exists iff
+    ``kv_len % bk``, the q-row-pad term iff ``need_rows and q_len %
+    bq``; only the causal frontier is inherently dynamic. ONE
+    definition for all four native-layout kernels — each retained term
+    costs a full-tile iota/compare/AND VPU sweep."""
+    valid = None
+
+    def land(a, b):
+        return b if a is None else jnp.logical_and(a, b)
+
+    if kv_len % bk:
+        valid = land(valid, _kv_valid(ik, bk, kv_len, bq))
+    if causal:
+        valid = land(valid, _causal_mask(iq, ik, bq, bk, off))
+    if need_rows and q_len % bq:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
+        valid = land(valid, rows < q_len)
+    return valid
+
+
 def _keep_mask(seed, iq, ik, bq, bk, rate, gb=None):
     """In-kernel softmax-dropout keep mask — the TPU analogue of the
     reference's Philox dropout fused into the softmax kernel
@@ -637,12 +661,17 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
     o_ref, lse_ref, m_scr, l_scr, acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+    # single k-block (kv fits one tile, the S<=1024 regime): the online
+    # running-max carry is dead weight — no scratch init, no alpha
+    # rescale, no carry broadcasts, no separate epilogue division pass
+    single_k = kv_len <= k_ref.shape[1]
 
-    @pl.when(ik == 0)
-    def _():
-        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc[:] = jnp.zeros_like(acc)
+    if not single_k:
+        @pl.when(ik == 0)
+        def _():
+            m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+            l_scr[:] = jnp.zeros_like(l_scr)
+            acc[:] = jnp.zeros_like(acc)
 
     for h in range(g):
         sl = slice(h * d, (h + 1) * d)
@@ -652,12 +681,38 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        valid = _kv_valid(ik, bk, kv_len, bq)
-        if causal:
-            off = off_ref[0] if has_off else kv_len - q_len
-            valid = jnp.logical_and(
-                valid, _causal_mask(iq, ik, bq, bk, off))
-        s = jnp.where(valid, s, NEG_INF)
+        off = ((off_ref[0] if has_off else kv_len - q_len)
+               if causal else None)
+        valid = _tile_valid(iq, ik, bq, bk, kv_len, q_len, causal, off,
+                            need_rows=False)
+        masked = valid is not None
+        if masked:
+            s = jnp.where(valid, s, NEG_INF)
+
+        if single_k:
+            m_new = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m_new)
+            if masked:
+                # fully-masked rows: m == NEG_INF ⇒ p rows of exp(0)=1
+                # garbage; zero them so l lands at 0 (ring contract)
+                p = jnp.where(valid, p, 0.0)
+            l = jnp.sum(p, axis=1, keepdims=True)
+            pd = p
+            if dropout_rate > 0.0:
+                keep = _keep_mask(seed_ref[0], iq, ik, bq, bk,
+                                  dropout_rate,
+                                  gb=pl.program_id(0) * g + h)
+                pd = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)),
+                               0.0)
+            safe_l = jnp.where(l == 0.0, 1.0, l) if masked else l
+            o_ref[0, :, sl] = (jax.lax.dot_general(
+                pd.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) / safe_l).astype(
+                    o_ref.dtype)
+            lse_ref[h * bq:(h + 1) * bq] = \
+                (m_new + jnp.log(safe_l)) \
+                + jnp.zeros((bq, lse_ref.shape[1]), jnp.float32)
+            continue
 
         m_prev = m_scr[h][:, :1]
         l_prev = l_scr[h][:, :1]
@@ -807,12 +862,13 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        valid = _kv_valid(ik, bk, kv_len, bq)
-        if causal:
-            off = off_ref[0] if has_off else kv_len - q_len
-            valid = jnp.logical_and(
-                valid, _causal_mask(iq, ik, bq, bk, off))
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        off = ((off_ref[0] if has_off else kv_len - q_len)
+               if causal else None)
+        valid = _tile_valid(iq, ik, bq, bk, kv_len, q_len, causal, off,
+                            need_rows=False)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
@@ -861,14 +917,13 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        valid = _kv_valid(ik, bk, kv_len, bq)
-        if causal:
-            off = off_ref[0] if has_off else kv_len - q_len
-            valid = jnp.logical_and(
-                valid, _causal_mask(iq, ik, bq, bk, off))
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + iq * bq
-        valid = jnp.logical_and(valid, rows < q_len)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - lse)
+        off = ((off_ref[0] if has_off else kv_len - q_len)
+               if causal else None)
+        valid = _tile_valid(iq, ik, bq, bk, kv_len, q_len, causal, off,
+                            need_rows=True)
+        if valid is not None:
+            p = jnp.where(valid, p, 0.0)
 
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -894,13 +949,26 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 
 def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
-                         g, has_off, refs):
+                         g, has_off, self_delta, refs):
     """Single-sweep backward for single-block grids (Sq, Sk each one
     tile): s and p are computed ONCE per head and all three gradients
     come out of the same sweep — the two-kernel split pays a redundant
     QKᵀ and exp pass per kernel, which at short sequence lengths is the
     dominant backward cost (BERT-Large: ~0.8 ms/layer two-kernel vs the
-    fused sweep)."""
+    fused sweep).
+
+    ``self_delta``: with the full row in the tile, the kernel needs NO
+    lse/delta operands at all — the softmax normalizer is recomputed
+    from ``s`` (same max/sum the forward took) and
+    ``delta = Σⱼ dp̃ⱼ·pⱼ ≡ Σⱼ doⱼ·oⱼ`` falls out of the dp tile the
+    sweep already holds (with dropout: the masked dp̃, since
+    o = (keep⊙p/(1−r))@v makes both sums run over the same terms).
+    The lane-broadcast (g·bq, 128) f32 operand layout those inputs
+    needed was a 128× HBM inflation — 2×64 MB per BERT-Large layer for
+    512 KB of data, ~40% of the backward kernel's input bytes plus a
+    materialized broadcast per layer (round-5 profile). Only the
+    lse-cotangent path (`_fal_bwd`, the ring merge) still feeds an
+    externally shifted delta."""
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
@@ -912,26 +980,43 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
     if has_off:
         off_ref = refs[pos]
         pos += 1
-    do_ref, lse_ref, dl_ref, dq_ref, dk_ref, dv_ref = refs[pos:]
+    if self_delta:
+        do_ref, dq_ref, dk_ref, dv_ref = refs[pos:]
+        lse_ref = dl_ref = None
+    else:
+        do_ref, lse_ref, dl_ref, dq_ref, dk_ref, dv_ref = refs[pos:]
 
     for h in range(g):
         sl = slice(h * d, (h + 1) * d)
         q, k, v = q_ref[0][:, sl], k_ref[0][:, sl], v_ref[0][:, sl]
         do = do_ref[0][:, sl]
         bq, bk = q.shape[0], k.shape[0]
-        lse = lse_ref[h * bq:(h + 1) * bq, :1]
-        delta = dl_ref[h * bq:(h + 1) * bq, :1]
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
-        valid = _kv_valid(0, bk, kv_len, bq)
-        if causal:
-            off = off_ref[0] if has_off else kv_len - q_len
-            valid = jnp.logical_and(
-                valid, _causal_mask(0, 0, bq, bk, off))
-        rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-        valid = jnp.logical_and(valid, rows < q_len)
-        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        off = ((off_ref[0] if has_off else kv_len - q_len)
+               if causal else None)
+        valid = _tile_valid(0, 0, bq, bk, kv_len, q_len, causal, off,
+                            need_rows=True)
+        masked = valid is not None
+        if self_delta:
+            if masked:
+                m = jnp.max(jnp.where(valid, s, NEG_INF), axis=1,
+                            keepdims=True)
+                e = jnp.where(valid, jnp.exp(s - m), 0.0)
+                l = jnp.sum(e, axis=1, keepdims=True)
+                # fully-masked rows (ring causal hops): l == 0 ⇒ p ≡ 0
+                p = e * jnp.where(l > 0.0, 1.0 / l, 0.0)
+            else:
+                m = jnp.max(s, axis=1, keepdims=True)
+                e = jnp.exp(s - m)
+                l = jnp.sum(e, axis=1, keepdims=True)
+                p = e * (1.0 / l)
+        else:
+            lse = lse_ref[h * bq:(h + 1) * bq, :1]
+            p = jnp.exp(s - lse)
+            if masked:
+                p = jnp.where(valid, p, 0.0)
 
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -942,6 +1027,10 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
             inv_keep = 1.0 / (1.0 - dropout_rate)
             pv = jnp.where(keep, p * inv_keep, 0.0)
             dp = jnp.where(keep, dp * inv_keep, 0.0)
+        if self_delta:
+            delta = jnp.sum(dp * p, axis=1, keepdims=True)
+        else:
+            delta = dl_ref[h * bq:(h + 1) * bq, :1]
         ds = (p * (dp - delta)).astype(q.dtype)
         dq_ref[0, :, sl] = (jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -959,6 +1048,9 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
 def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
                         scale, causal, sq, sk, sqp, skp, bq, bk, seed,
                         dropout_rate, causal_off=None):
+    """``lse_l``/``delta_l`` None ⇒ the kernel self-computes the
+    normalizer and delta (the single-block identity, no lane operands)."""
+    self_delta = lse_l is None
     b = qp.shape[0]
     H = qp.shape[2]
     bh = b * nh
@@ -968,8 +1060,6 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
                           memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, skp, gd), lambda t: (t // hg, 0, t % hg),
                           memory_space=pltpu.VMEM)
-    lane_spec = pl.BlockSpec((g * bq, LANES), lambda t: (t, 0),
-                             memory_space=pltpu.VMEM)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
     if dropout_rate > 0.0:
@@ -978,13 +1068,19 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
     if causal_off is not None:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(causal_off)
-    in_specs += [q_spec, lane_spec, lane_spec]
-    args += [dop, lse_l, delta_l]
+    if self_delta:
+        in_specs += [q_spec]
+        args += [dop]
+    else:
+        lane_spec = pl.BlockSpec((g * bq, LANES), lambda t: (t, 0),
+                                 memory_space=pltpu.VMEM)
+        in_specs += [q_spec, lane_spec, lane_spec]
+        args += [dop, lse_l, delta_l]
 
     dq, dk, dv = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_fused_kernel_nl, scale, causal, sk, sq, dropout_rate,
-            d, g, causal_off is not None)(refs),
+            d, g, causal_off is not None, self_delta)(refs),
         grid=(bh // g,),
         in_specs=in_specs,
         out_specs=(q_spec, k_spec, k_spec),
@@ -1000,9 +1096,15 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
 
 def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                   block_q, block_k, dropout_rate=0.0, seed=None,
-                  causal_off=None):
+                  causal_off=None, delta_shifted=False):
     """Native-layout backward: operands/outputs (B, S, H); ``lse`` and
-    ``delta`` arrive (B·H, Sq)."""
+    ``delta`` arrive (B·H, Sq).
+
+    ``delta_shifted``: the caller folded an lse cotangent into delta
+    (`_fal_bwd`), so the single-block fused kernel may NOT self-compute
+    it and must take the lane operands. In the default unshifted case
+    the fused path drops lse/delta entirely (their producing graphs are
+    dead-code-eliminated by XLA)."""
     b, sq, H = q2.shape
     sk = k2.shape[1]
     bh = b * nh
@@ -1064,9 +1166,11 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
 
         def fused_est(g_):
             gd_ = g_ * d
+            lanes = (2 * g_ * bq * LANES * 4 * 2 if delta_shifted
+                     else bq * bk * 4)   # self-delta: one extra f32 tile
             return ((2 * sqp + 2 * skp) * gd_ * isz * 2
                     + (sqp + 2 * skp) * gd_ * isz * 2
-                    + bq * bk * 4 * 3 + 2 * g_ * bq * LANES * 4 * 2)
+                    + bq * bk * 4 * 3 + lanes)
 
         g0 = _native_g0(nh, d)
         gf = g
@@ -1079,8 +1183,11 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                 nxt = g0
             gf = nxt
         if fused_est(gf) <= 13 * 2 ** 20:
-            lse_f = _lanes_nl(lse, bh, gf, 1, bq, sq)
-            delta_f = _lanes_nl(delta, bh, gf, 1, bq, sq)
+            if delta_shifted:
+                lse_f = _lanes_nl(lse, bh, gf, 1, bq, sq)
+                delta_f = _lanes_nl(delta, bh, gf, 1, bq, sq)
+            else:
+                lse_f = delta_f = None
             return _flash_bwd_fused_nl(qp, kp, vp, dop, lse_f, delta_f,
                                        nh, d, gf, scale, causal, sq, sk,
                                        sqp, skp, bq, bk, seed,
@@ -1489,7 +1596,8 @@ def _fal_bwd(scale, causal, block_q, block_k, res, cot):
         dq2, dk2, dv2 = _flash_bwd_nl(
             q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
             block_q, block_k,
-            causal_off=_off_arr(causal_offset, causal))
+            causal_off=_off_arr(causal_offset, causal),
+            delta_shifted=True)
         return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
                 dv2.reshape(b, sk, h, d), None, None)
     eff_bias, eff_causal = bias, causal

@@ -47,18 +47,26 @@ def _fwd_kernel(v, smoothing, x_ref, lab_ref, loss_ref, lse_ref):
     x = x_ref[:].astype(jnp.float32)
     r, vp = x.shape
     cols = jax.lax.broadcasted_iota(jnp.int32, (r, vp), 1)
-    mask = cols < v
-    xm = jnp.where(mask, x, -jnp.inf)
-    xmax = jnp.max(xm, axis=1, keepdims=True)
-    lse = xmax + jnp.log(jnp.sum(jnp.where(mask, jnp.exp(x - xmax), 0.0),
-                                 axis=1, keepdims=True))
+    # the lane block covers the vocab dim exactly (vp == v in
+    # _fwd_call/_bwd_call), so the vocab-validity mask is statically
+    # all-true and its where passes are elided — each is a full
+    # (r, 30522)-class VPU sweep at BERT shapes
+    padded = vp > v
+    if padded:
+        mask = cols < v
+        x = jnp.where(mask, x, -jnp.inf)
+    xmax = jnp.max(x, axis=1, keepdims=True)
+    # padded lanes already hold -inf in x, so exp underflows to 0
+    lse = xmax + jnp.log(jnp.sum(jnp.exp(x - xmax), axis=1,
+                                 keepdims=True))
     labels = lab_ref[:, :1]                      # (r, 1) int32
     onehot = cols == labels
     x_label = jnp.sum(jnp.where(onehot, x, 0.0), axis=1, keepdims=True)
     loss = lse - (1.0 - smoothing) * x_label
     if smoothing:
+        xs = jnp.where(mask, x, 0.0) if padded else x
         loss = loss - (smoothing / v) * jnp.sum(
-            jnp.where(mask, x, 0.0), axis=1, keepdims=True)
+            xs, axis=1, keepdims=True)
     # ignored rows (label < 0) produce zero loss (padding convention)
     valid = labels >= 0
     loss_ref[:] = jnp.where(valid, loss, 0.0) + jnp.zeros((r, LANES),
@@ -70,13 +78,18 @@ def _bwd_kernel(v, smoothing, x_ref, lab_ref, lse_ref, g_ref, dx_ref):
     x = x_ref[:].astype(jnp.float32)
     r, vp = x.shape
     cols = jax.lax.broadcasted_iota(jnp.int32, (r, vp), 1)
-    mask = cols < v
     labels = lab_ref[:, :1]
     lse = lse_ref[:, :1]
     g = g_ref[:, :1]
-    prob = jnp.where(mask, jnp.exp(x - lse), 0.0)
-    target = (1.0 - smoothing) * (cols == labels) + \
-        jnp.where(mask, smoothing / v, 0.0)
+    prob = jnp.exp(x - lse)
+    target = (1.0 - smoothing) * (cols == labels)
+    if smoothing:
+        target = target + smoothing / v
+    if vp > v:                   # vp == v by construction; see _fwd_call
+        mask = cols < v
+        prob = jnp.where(mask, prob, 0.0)
+        if smoothing:
+            target = jnp.where(mask, target, 0.0)
     dx = g * (prob - target)
     dx = jnp.where(labels >= 0, dx, 0.0)
     dx_ref[:] = dx.astype(dx_ref.dtype)
