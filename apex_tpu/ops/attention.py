@@ -600,12 +600,14 @@ def _native_g0(nh: int, d: int) -> Optional[int]:
     return g0
 
 
-def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
+def _native_g(nh, d, dropout_rate, bq, bk, itemsize, *, bias_isz=0,
+              bias_per_head=False, carry_scratch=True):
     """Heads per grid step on the native path: at least g0 (lane
     alignment), more when the forward kernel's VMEM ledger fits the
     16 MiB scoped budget (in-blocks, scratch, score tile, out-blocks;
     packing amortizes per-step DMA setup). Dropout adds a (bq, bk)
-    keep-mask/hash temporary. ``APEX_TPU_NATIVE_G`` overrides for perf
+    keep-mask/hash temporary; a bias adds its double-buffered
+    (g|1, bq, bk) in-block. ``APEX_TPU_NATIVE_G`` overrides for perf
     experiments."""
     g0 = _native_g0(nh, d)
     forced = os.environ.get("APEX_TPU_NATIVE_G")
@@ -637,19 +639,34 @@ def _native_g(nh, d, dropout_rate, bq, bk, itemsize):
             continue
         gd = g * d
         half_bufs = (bq + 2 * bk) * gd * itemsize * 2
-        scratch = g * bq * 2 * LANES * 4 + bq * gd * 4
-        score = bq * bk * 4
+        # single-k (no carry): the m/l/acc scratch disappears, but the
+        # fp32 PV result and its divided copy live as stack temps (the
+        # acc role, twice) and up to three score-class tiles coexist
+        # (s, p, and the masked/dropout product). Calibrated against a
+        # measured 16.73 MiB OOM at fp32 S=512 g=8 (the ledger must
+        # reject g=8 there — a single-temp estimate lands at exactly
+        # the 16 MiB boundary and slips through; g=4 fits).
+        scratch = (g * bq * 2 * LANES * 4 + bq * gd * 4
+                   if carry_scratch else 2 * bq * gd * 4)
+        score = bq * bk * 4 * (1 if carry_scratch else 3)
         outs = bq * gd * itemsize * 2 + g * bq * LANES * 4 * 2
-        if half_bufs + scratch + score + outs + mask_tmp <= 16 * 2 ** 20:
+        bias_buf = ((g if bias_per_head else 1) * bq * bk * bias_isz * 2
+                    if bias_isz else 0)
+        if (half_bufs + scratch + score + outs + mask_tmp + bias_buf
+                <= 16 * 2 ** 20):
             return g
     return g0
 
 
 def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                   has_off, refs):
+                   has_off, has_bias, bias_per_head, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
+    b_ref = None
+    if has_bias:
+        b_ref = refs[pos]
+        pos += 1
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
@@ -658,13 +675,18 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
     if has_off:
         off_ref = refs[pos]
         pos += 1
-    o_ref, lse_ref, m_scr, l_scr, acc = refs[pos:]
+    # single k-block (kv fits one tile, the S<=1024 regime): the online
+    # running-max carry is dead weight — the wrapper passes no scratch,
+    # and there is no init, no alpha rescale, no carry broadcasts, no
+    # separate epilogue division pass
+    single_k = kv_len <= k_ref.shape[1]
+    if single_k:
+        o_ref, lse_ref = refs[pos:]
+        m_scr = l_scr = acc = None
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
-    # single k-block (kv fits one tile, the S<=1024 regime): the online
-    # running-max carry is dead weight — no scratch init, no alpha
-    # rescale, no carry broadcasts, no separate epilogue division pass
-    single_k = kv_len <= k_ref.shape[1]
 
     if not single_k:
         @pl.when(ik == 0)
@@ -681,6 +703,8 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + b_ref[h if bias_per_head else 0].astype(jnp.float32)
         off = ((off_ref[0] if has_off else kv_len - q_len)
                if causal else None)
         valid = _tile_valid(iq, ik, bq, bk, kv_len, q_len, causal, off,
@@ -776,12 +800,50 @@ def _lanes_nl(x, bh, g, nq, bq, sq):
     return jnp.broadcast_to(xp, (bh * sqp, LANES))
 
 
+def _bias_group_nl(bias, b, nh, sq, sk):
+    """(B|1, H|1, Sq|1, Sk|1) bias → ((G, Sq, Sk), mode) for the native
+    grid whose dim 0 enumerates (batch, head-group) pairs group-minor.
+    mode picks the dim-0 block shape and index map: 'shared' (G=1) and
+    'batch' (G=B) ride (1, bq, bk) blocks; 'head' (G=H) and 'full'
+    (G=B·H) need the step's g per-head slabs, (g, bq, bk) blocks.
+    The reference's additive-mask MHA variants
+    (`setup.py:295-320`, `self_multihead_attn_bias_additive_mask_cuda.cu`)
+    are the per-batch/per-head cases."""
+    if bias is None:
+        return None, None
+    bias_g, bb, bh_ = _bias_flat(bias, b, nh, sq, sk)
+    mode = {(True, True): "shared", (False, True): "batch",
+            (True, False): "head", (False, False): "full"}[
+                (bb == 1, bh_ == 1)]
+    return bias_g, mode
+
+
+def _bias_blk_nl(mode, g, hg):
+    """(dim-0 block size, grid-step → dim-0 block index) for a native
+    bias spec; block index is in units of the block size."""
+    return {
+        "shared": (1, lambda t: 0),
+        "batch": (1, lambda t: t // hg),
+        "head": (g, lambda t: t % hg),
+        "full": (g, lambda t: t),
+    }[mode]
+
+
+def _pad_bias_nl(bias_g, sqp, skp):
+    G, sq, sk = bias_g.shape
+    if sq == sqp and sk == skp:
+        return bias_g
+    return jnp.pad(bias_g, ((0, 0), (0, sqp - sq), (0, skp - sk)))
+
+
 def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
-                  dropout_rate=0.0, seed=None, causal_off=None):
+                  dropout_rate=0.0, seed=None, causal_off=None,
+                  bias_g=None, bias_mode=None):
     b, sq, H = q2.shape
     sk = k2.shape[1]
     bh = b * nh
-    block_q, block_k = _block_cap(block_q, block_k, False, dropout_rate)
+    block_q, block_k = _block_cap(block_q, block_k, bias_g is not None,
+                                  dropout_rate)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
     sqp = -(-sq // bq) * bq
@@ -792,11 +854,22 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
         t, ((0, 0), (0, s_ - t.shape[1]), (0, 0)))
     qp, kp, vp = pad_s(q2, sqp), pad_s(k2, skp), pad_s(v2, skp)
 
-    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
+    bias_per_head = bias_mode in ("head", "full")
+    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize,
+                  bias_isz=(bias_g.dtype.itemsize if bias_g is not None
+                            else 0),
+                  bias_per_head=bias_per_head, carry_scratch=nk > 1)
     gd = g * d
+    hg = nh // g
     q_spec, k_spec = _head_specs(nh, g, bq, bk, gd)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
+    if bias_g is not None:
+        blk0, row = _bias_blk_nl(bias_mode, g, hg)
+        in_specs.append(pl.BlockSpec(
+            (blk0, bq, bk), lambda t, i, j: (row(t), i, j),
+            memory_space=pltpu.VMEM))
+        args.append(_pad_bias_nl(bias_g, sqp, skp))
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
@@ -806,7 +879,8 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
 
     kernel = functools.partial(_fwd_kernel_nl, scale, causal, sk, sq,
                                dropout_rate, d, g,
-                               causal_off is not None)
+                               causal_off is not None,
+                               bias_g is not None, bias_per_head)
     o, lse = pl.pallas_call(
         lambda *refs: kernel(refs),
         grid=(bh // g, nq, nk),
@@ -820,11 +894,11 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
             jax.ShapeDtypeStruct((b, sqp, H), q2.dtype),
             jax.ShapeDtypeStruct((bh * nq * bq, LANES), jnp.float32),
         ),
-        scratch_shapes=[
+        scratch_shapes=([] if nk == 1 else [
             pltpu.VMEM((g, bq, LANES), jnp.float32),
             pltpu.VMEM((g, bq, LANES), jnp.float32),
             pltpu.VMEM((1, bq, gd), jnp.float32),
-        ],
+        ]),
         interpret=use_interpret(),
     )(*args)
     lse = _lse_reorder(lse[:, 0], bh, g, nq, bq)[:, :sq]
@@ -832,10 +906,14 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                      has_off, refs):
+                      has_off, has_bias, bias_per_head, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
+    b_ref = None
+    if has_bias:
+        b_ref = refs[pos]
+        pos += 1
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
@@ -862,6 +940,8 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + b_ref[h if bias_per_head else 0].astype(jnp.float32)
         p = jnp.exp(s - lse)
         off = ((off_ref[0] if has_off else kv_len - q_len)
                if causal else None)
@@ -886,10 +966,14 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 
 def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                       has_off, refs):
+                       has_off, has_bias, bias_per_head, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
+    b_ref = None
+    if has_bias:
+        b_ref = refs[pos]
+        pos += 1
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
@@ -917,6 +1001,8 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + b_ref[h if bias_per_head else 0].astype(jnp.float32)
         p = jnp.exp(s - lse)
         off = ((off_ref[0] if has_off else kv_len - q_len)
                if causal else None)
@@ -949,7 +1035,8 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 
 def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
-                         g, has_off, self_delta, refs):
+                         g, has_off, self_delta, has_bias,
+                         bias_per_head, refs):
     """Single-sweep backward for single-block grids (Sq, Sk each one
     tile): s and p are computed ONCE per head and all three gradients
     come out of the same sweep — the two-kernel split pays a redundant
@@ -972,6 +1059,10 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
     pos = 3
+    b_ref = None
+    if has_bias:
+        b_ref = refs[pos]
+        pos += 1
     seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
@@ -994,6 +1085,8 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
 
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        if has_bias:
+            s = s + b_ref[h if bias_per_head else 0].astype(jnp.float32)
         off = ((off_ref[0] if has_off else kv_len - q_len)
                if causal else None)
         valid = _tile_valid(0, 0, bq, bk, kv_len, q_len, causal, off,
@@ -1047,7 +1140,8 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
 
 def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
                         scale, causal, sq, sk, sqp, skp, bq, bk, seed,
-                        dropout_rate, causal_off=None):
+                        dropout_rate, causal_off=None, bias_p=None,
+                        bias_mode=None):
     """``lse_l``/``delta_l`` None ⇒ the kernel self-computes the
     normalizer and delta (the single-block identity, no lane operands)."""
     self_delta = lse_l is None
@@ -1056,12 +1150,19 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
     bh = b * nh
     gd = g * d
     hg = nh // g
+    bias_per_head = bias_mode in ("head", "full")
     q_spec = pl.BlockSpec((1, sqp, gd), lambda t: (t // hg, 0, t % hg),
                           memory_space=pltpu.VMEM)
     k_spec = pl.BlockSpec((1, skp, gd), lambda t: (t // hg, 0, t % hg),
                           memory_space=pltpu.VMEM)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
+    if bias_p is not None:
+        blk0, row = _bias_blk_nl(bias_mode, g, hg)
+        in_specs.append(pl.BlockSpec(
+            (blk0, sqp, skp), lambda t: (row(t), 0, 0),
+            memory_space=pltpu.VMEM))
+        args.append(bias_p)
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
@@ -1080,7 +1181,8 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
     dq, dk, dv = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_fused_kernel_nl, scale, causal, sk, sq, dropout_rate,
-            d, g, causal_off is not None, self_delta)(refs),
+            d, g, causal_off is not None, self_delta,
+            bias_p is not None, bias_per_head)(refs),
         grid=(bh // g,),
         in_specs=in_specs,
         out_specs=(q_spec, k_spec, k_spec),
@@ -1096,7 +1198,8 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
 
 def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                   block_q, block_k, dropout_rate=0.0, seed=None,
-                  causal_off=None, delta_shifted=False):
+                  causal_off=None, delta_shifted=False, bias_g=None,
+                  bias_mode=None):
     """Native-layout backward: operands/outputs (B, S, H); ``lse`` and
     ``delta`` arrive (B·H, Sq).
 
@@ -1108,10 +1211,14 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     b, sq, H = q2.shape
     sk = k2.shape[1]
     bh = b * nh
-    block_q, block_k = _block_cap(block_q, block_k, False, dropout_rate)
+    block_q, block_k = _block_cap(block_q, block_k, bias_g is not None,
+                                  dropout_rate)
     bq = _choose_block(block_q, sq)
     bk = _choose_block(block_k, sk, lane=True)
-    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize)
+    bias_isz = bias_g.dtype.itemsize if bias_g is not None else 0
+    bias_per_head = bias_mode in ("head", "full")
+    g = _native_g(nh, d, dropout_rate, bq, bk, q2.dtype.itemsize,
+                  bias_isz=bias_isz, bias_per_head=bias_per_head)
     bwd_vmem = None
     if (sq > bq or sk > bk) and bq * bk * 4 >= (1 << 22) and bh > g:
         # multi-block two-kernel path with 1024²-class f32 score tiles:
@@ -1132,13 +1239,16 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
         bwd_est = ((2 * bq + 2 * bk) * gd_ * isz * 3
                    + 2 * g * bq * LANES * 4 * 3
                    + 2 * bk * gd_ * isz * 2 + 2 * bk * gd_ * 4
-                   + 3 * bq * bk * 4)
+                   + 3 * bq * bk * 4
+                   + ((g if bias_per_head else 1) * bq * bk
+                      * bias_isz * 3 if bias_isz else 0))
         if (os.environ.get("APEX_TPU_BWD_512") == "1"
                 or bwd_est > 32 * 2 ** 20):
             bq = _choose_block(min(block_q, 512), sq)
             bk = _choose_block(min(block_k, 512), sk, lane=True)
             g = _native_g(nh, d, dropout_rate, bq, bk,
-                          q2.dtype.itemsize)
+                          q2.dtype.itemsize, bias_isz=bias_isz,
+                          bias_per_head=bias_per_head)
             g0_ = _native_g0(nh, d)
             while g > 2 * g0_ or (nh % g) or (g % g0_):
                 nxt = g // 2
@@ -1168,9 +1278,11 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
             gd_ = g_ * d
             lanes = (2 * g_ * bq * LANES * 4 * 2 if delta_shifted
                      else bq * bk * 4)   # self-delta: one extra f32 tile
+            bias_buf = ((g_ if bias_per_head else 1) * bq * bk
+                        * bias_isz * 2 if bias_isz else 0)
             return ((2 * sqp + 2 * skp) * gd_ * isz * 2
                     + (sqp + 2 * skp) * gd_ * isz * 2
-                    + bq * bk * 4 * 3 + lanes)
+                    + bq * bk * 4 * 3 + lanes + bias_buf)
 
         g0 = _native_g0(nh, d)
         gf = g
@@ -1188,10 +1300,14 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                 delta_f = _lanes_nl(delta, bh, gf, 1, bq, sq)
             else:
                 lse_f = delta_f = None
+            bias_p = (None if bias_g is None
+                      else _pad_bias_nl(bias_g, sqp, skp))
             return _flash_bwd_fused_nl(qp, kp, vp, dop, lse_f, delta_f,
                                        nh, d, gf, scale, causal, sq, sk,
                                        sqp, skp, bq, bk, seed,
-                                       dropout_rate, causal_off)
+                                       dropout_rate, causal_off,
+                                       bias_p=bias_p,
+                                       bias_mode=bias_mode)
 
     gd = g * d
     lse_l = _lanes_nl(lse, bh, g, nq, bq, sq)
@@ -1203,8 +1319,15 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                              lambda t, i, j: (t * nq + i, 0),
                              memory_space=pltpu.VMEM)
 
+    bias_p = None if bias_g is None else _pad_bias_nl(bias_g, sqp, skp)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
+    if bias_p is not None:
+        blk0, row = _bias_blk_nl(bias_mode, g, hg)
+        in_specs.append(pl.BlockSpec(
+            (blk0, bq, bk), lambda t, i, j: (row(t), i, j),
+            memory_space=pltpu.VMEM))
+        args.append(bias_p)
     if dropout_rate > 0.0:
         in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args.append(seed)
@@ -1222,7 +1345,8 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     dq = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_dq_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
-            g, causal_off is not None)(refs),
+            g, causal_off is not None, bias_p is not None,
+            bias_per_head)(refs),
         grid=(bh // g, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -1244,6 +1368,12 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                                memory_space=pltpu.VMEM)
     in_specs2 = [q_spec_k, k_spec_k, k_spec_k]
     args2 = [qp, kp, vp]
+    if bias_p is not None:
+        blk0, row = _bias_blk_nl(bias_mode, g, hg)
+        in_specs2.append(pl.BlockSpec(
+            (blk0, bq, bk), lambda t, j, i: (row(t), i, j),
+            memory_space=pltpu.VMEM))
+        args2.append(bias_p)
     if dropout_rate > 0.0:
         in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
         args2.append(seed)
@@ -1256,7 +1386,8 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     dk, dv = pl.pallas_call(
         lambda *refs: functools.partial(
             _bwd_dkv_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
-            g, causal_off is not None)(refs),
+            g, causal_off is not None, bias_p is not None,
+            bias_per_head)(refs),
         grid=(bh // g, nk, nq),
         in_specs=in_specs2,
         out_specs=(k_spec_k, k_spec_k),
@@ -1312,17 +1443,11 @@ def _to3(q, k, v):
     return tr(q), tr(k), tr(v)
 
 
-def _bias_group(bias, b, h, sq, sk):
-    """(B|1, H|1, Sq|1, Sk|1) bias → ((G, Sq, Sk), idx_fn).
-
-    The kernels index the bias through ``idx_fn(grid_b)`` in their
-    BlockSpecs, so a (1, 1, Sq, Sk) causal bias (the ring-attention
-    per-hop case) occupies exactly one copy in HBM instead of B·H
-    score-sized buffers. Size-1 *sequence* dims can't ride the index
-    map (blocks tile them) and are materialized to (Sq, Sk).
-    """
-    if bias is None:
-        return None, None
+def _bias_flat(bias, b, h, sq, sk):
+    """Shared validate/broadcast/flatten for both bias groupings:
+    (B|1, H|1, Sq|1, Sk|1) → ((bb·bh, Sq, Sk), bb, bh). Size-1
+    *sequence* dims can't ride the index map (blocks tile them) and
+    are materialized to (Sq, Sk)."""
     bb, bh_ = bias.shape[0], bias.shape[1]
     if bb not in (1, b) or bh_ not in (1, h):
         raise ValueError(f"bias dims {bias.shape[:2]} must broadcast "
@@ -1331,7 +1456,20 @@ def _bias_group(bias, b, h, sq, sk):
         raise ValueError(f"bias dims {bias.shape[2:]} must broadcast "
                          f"against (Sq={sq}, Sk={sk})")
     bias = jnp.broadcast_to(bias, (bb, bh_, sq, sk))
-    bias_g = bias.reshape(bb * bh_, sq, sk)
+    return bias.reshape(bb * bh_, sq, sk), bb, bh_
+
+
+def _bias_group(bias, b, h, sq, sk):
+    """(B|1, H|1, Sq|1, Sk|1) bias → ((G, Sq, Sk), idx_fn).
+
+    The kernels index the bias through ``idx_fn(grid_b)`` in their
+    BlockSpecs, so a (1, 1, Sq, Sk) causal bias (the ring-attention
+    per-hop case) occupies exactly one copy in HBM instead of B·H
+    score-sized buffers.
+    """
+    if bias is None:
+        return None, None
+    bias_g, bb, bh_ = _bias_flat(bias, b, h, sq, sk)
     if bb == 1 and bh_ == 1:
         idx = lambda g: 0
     elif bb == 1:                       # (1, H, ...) — per-head bias
@@ -1380,15 +1518,19 @@ def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
     off = _off_arr(causal_offset, causal)
     if off is not None and bias is not None:
         raise ValueError("causal_offset cannot combine with a bias")
-    if bias is None and _native_g0(h, d) is not None:
+    if _native_g0(h, d) is not None:
         # native-layout path: (B, S, H) operands straight through — no
-        # transpose copies, no D zero-pad (see the native-kernel block)
+        # transpose copies, no D zero-pad (see the native-kernel block).
+        # An additive bias rides the native grid as (g|1, bq, bk)
+        # blocks (round-5; biased MHA no longer pays the transpose tax)
+        bias_nl, bias_mode = _bias_group_nl(bias, b, h, sq, k.shape[1])
         q2 = q.reshape(b, sq, h * d)
         k2 = k.reshape(b, k.shape[1], h * d)
         v2 = v.reshape(b, v.shape[1], h * d)
         o2, lse = _flash_fwd_nl(q2, k2, v2, h, d, scale, causal,
                                 block_q, block_k, dropout_rate, seed,
-                                causal_off=off)
+                                causal_off=off, bias_g=bias_nl,
+                                bias_mode=bias_mode)
         o = o2.reshape(b, sq, h, d)
         return o, (q, k, v, bias, dropout_seed, o, lse, causal_offset)
     eff_bias, eff_causal = bias, causal
@@ -1418,7 +1560,8 @@ def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
     seed = _seed_arr(dropout_seed, dropout_rate)
-    if bias is None and _native_g0(h, d) is not None:
+    if _native_g0(h, d) is not None:
+        bias_nl, bias_mode = _bias_group_nl(bias, b, h, sq, sk)
         q2 = q.reshape(b, sq, h * d)
         k2 = k.reshape(b, sk, h * d)
         v2 = v.reshape(b, sk, h * d)
@@ -1431,9 +1574,14 @@ def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
         dq2, dk2, dv2 = _flash_bwd_nl(
             q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
             block_q, block_k, dropout_rate=dropout_rate, seed=seed,
-            causal_off=_off_arr(causal_offset, causal))
+            causal_off=_off_arr(causal_offset, causal),
+            bias_g=bias_nl, bias_mode=bias_mode)
+        dbias = None if bias is None else _bias_grad(
+            q, k, v, bias, o, lse, do, scale_, causal,
+            dropout_rate=dropout_rate, seed=seed,
+            block_q=block_q, block_k=block_k)
         return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
-                dv2.reshape(b, sk, h, d), None, None, None)
+                dv2.reshape(b, sk, h, d), dbias, None, None)
     eff_bias, eff_causal = bias, causal
     off = _off_arr(causal_offset, causal)
     if off is not None:
@@ -1584,7 +1732,8 @@ def _fal_bwd(scale, causal, block_q, block_k, res, cot):
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
     # d lse/d s = p, so the lse cotangent folds into the delta term:
     # ds = p*(dp - delta) + p*dlse = p*(dp - (delta - dlse))
-    if bias is None and _native_g0(h, d) is not None:
+    if _native_g0(h, d) is not None:
+        bias_nl, bias_mode = _bias_group_nl(bias, b, h, sq, sk)
         q2 = q.reshape(b, sq, h * d)
         k2 = k.reshape(b, sk, h * d)
         v2 = v.reshape(b, sk, h * d)
@@ -1597,7 +1746,7 @@ def _fal_bwd(scale, causal, block_q, block_k, res, cot):
             q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
             block_q, block_k,
             causal_off=_off_arr(causal_offset, causal),
-            delta_shifted=True)
+            delta_shifted=True, bias_g=bias_nl, bias_mode=bias_mode)
         return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
                 dv2.reshape(b, sk, h, d), None, None)
     eff_bias, eff_causal = bias, causal

@@ -124,6 +124,34 @@ def _():
     _attn_case(2, 256, 256, 2, 64, with_bias=True)
 
 
+@case("attention/bias-native-no-transpose")
+def _():
+    # round-5: per-head additive bias rides the native-layout grid —
+    # the compiled fwd+bwd graph must contain NO transpose ops (the
+    # 10.6 ms/step-class tax the (B·H,S,D) wrappers paid) and exactly
+    # the two native custom-calls
+    import jax
+    import numpy as np
+    from apex_tpu.ops.attention import flash_attention
+
+    B, S, H, D = 2, 256, 4, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.bfloat16)
+    bias = jnp.asarray(rng.randn(1, H, S, S), jnp.float32)
+
+    def f(q, k, v, bias):
+        return jnp.sum(flash_attention(q, k, v, bias)
+                       .astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+    hlo = g.lower(q, k, v, bias).compile().as_text()
+    n_tr = sum(1 for l in hlo.splitlines() if " transpose(" in l)
+    assert n_tr == 0, f"biased attention compiled {n_tr} transposes"
+    assert hlo.count("tpu_custom_call") == 2, "expected fwd + fused bwd"
+
+
 @case("attention/short-seq-multihead")
 def _():
     # sq < 128 with several heads — the round-2 lse-alignment bug shape
