@@ -51,6 +51,11 @@ LANES = 128
 DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 NEG_INF = -1e30
+#: tile cap for bias/dropout-carrying kernels. ONE definition: the
+#: dropout keep-mask hash is a function of block coordinates, so
+#: _block_cap, the dense replica, AND ring_attention's shard-alignment
+#: check + block-offset units must all agree on this number.
+DROPOUT_TILE = 512
 
 
 def _block_cap(block_q, block_k, has_bias, dropout_rate):
@@ -67,7 +72,7 @@ def _block_cap(block_q, block_k, has_bias, dropout_rate):
     cap silently changes the dropout mask between kernels and the dense
     replica."""
     if has_bias or dropout_rate > 0.0:
-        return min(block_q, 512), min(block_k, 512)
+        return min(block_q, DROPOUT_TILE), min(block_k, DROPOUT_TILE)
     return block_q, block_k
 
 
@@ -658,23 +663,57 @@ def _native_g(nh, d, dropout_rate, bq, bk, itemsize, *, bias_isz=0,
     return g0
 
 
-def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                   has_off, has_bias, bias_per_head, refs):
-    refs = list(refs)
-    q_ref, k_ref, v_ref = refs[:3]
-    pos = 3
-    b_ref = None
+def _unpack_common(refs, pos, has_bias, dropout_rate, has_dbo,
+                   has_off):
+    """ONE optional-operand order for every native kernel:
+    bias, dropout seed, dropout block offsets, causal offset. The
+    wrapper-side mirror is :func:`_append_common` — a new optional
+    operand is added in exactly these two places."""
+    b_ref = seed_ref = dbo_ref = off_ref = None
     if has_bias:
         b_ref = refs[pos]
         pos += 1
-    seed_ref = None
     if dropout_rate > 0.0:
         seed_ref = refs[pos]
         pos += 1
-    off_ref = None
+    if has_dbo:
+        dbo_ref = refs[pos]
+        pos += 1
     if has_off:
         off_ref = refs[pos]
         pos += 1
+    return b_ref, seed_ref, dbo_ref, off_ref, pos
+
+
+def _append_common(in_specs, args, *, bias_p, bias_mode, g, hg,
+                   bias_dims, bias_idx, dropout_rate, seed, dbo,
+                   causal_off):
+    """Wrapper-side mirror of :func:`_unpack_common`: appends the
+    optional operands' specs/args in the shared order. ``bias_idx``
+    maps the dim-0 row function to this grid's index map."""
+    if bias_p is not None:
+        blk0, row = _bias_blk_nl(bias_mode, g, hg)
+        in_specs.append(pl.BlockSpec((blk0,) + tuple(bias_dims),
+                                     bias_idx(row),
+                                     memory_space=pltpu.VMEM))
+        args.append(bias_p)
+    if dropout_rate > 0.0:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(seed)
+    if dbo is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(dbo)
+    if causal_off is not None:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(causal_off)
+
+
+def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
+                   has_off, has_bias, bias_per_head, has_dbo, refs):
+    refs = list(refs)
+    q_ref, k_ref, v_ref = refs[:3]
+    b_ref, seed_ref, dbo_ref, off_ref, pos = _unpack_common(
+        refs, 3, has_bias, dropout_rate, has_dbo, has_off)
     # single k-block (kv fits one tile, the S<=1024 regime): the online
     # running-max carry is dead weight — the wrapper passes no scratch,
     # and there is no init, no alpha rescale, no carry broadcasts, no
@@ -723,7 +762,9 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
             l = jnp.sum(p, axis=1, keepdims=True)
             pd = p
             if dropout_rate > 0.0:
-                keep = _keep_mask(seed_ref[0], iq, ik, bq, bk,
+                iqo = iq + dbo_ref[0] if has_dbo else iq
+                iko = ik + dbo_ref[1] if has_dbo else ik
+                keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk,
                                   dropout_rate,
                                   gb=pl.program_id(0) * g + h)
                 pd = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)),
@@ -746,7 +787,9 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         pd = p
         if dropout_rate > 0.0:
-            keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate,
+            iqo = iq + dbo_ref[0] if has_dbo else iq
+            iko = ik + dbo_ref[1] if has_dbo else ik
+            keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             pd = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
         acc[0, :, sl] = acc[0][:, sl] * alpha + jax.lax.dot_general(
@@ -838,7 +881,7 @@ def _pad_bias_nl(bias_g, sqp, skp):
 
 def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
                   dropout_rate=0.0, seed=None, causal_off=None,
-                  bias_g=None, bias_mode=None):
+                  bias_g=None, bias_mode=None, dbo=None):
     b, sq, H = q2.shape
     sk = k2.shape[1]
     bh = b * nh
@@ -864,23 +907,20 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
     q_spec, k_spec = _head_specs(nh, g, bq, bk, gd)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
-    if bias_g is not None:
-        blk0, row = _bias_blk_nl(bias_mode, g, hg)
-        in_specs.append(pl.BlockSpec(
-            (blk0, bq, bk), lambda t, i, j: (row(t), i, j),
-            memory_space=pltpu.VMEM))
-        args.append(_pad_bias_nl(bias_g, sqp, skp))
-    if dropout_rate > 0.0:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(seed)
-    if causal_off is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(causal_off)
+    _append_common(
+        in_specs, args,
+        bias_p=(None if bias_g is None
+                else _pad_bias_nl(bias_g, sqp, skp)),
+        bias_mode=bias_mode, g=g, hg=hg, bias_dims=(bq, bk),
+        bias_idx=lambda row: lambda t, i, j: (row(t), i, j),
+        dropout_rate=dropout_rate, seed=seed, dbo=dbo,
+        causal_off=causal_off)
 
     kernel = functools.partial(_fwd_kernel_nl, scale, causal, sk, sq,
                                dropout_rate, d, g,
                                causal_off is not None,
-                               bias_g is not None, bias_per_head)
+                               bias_g is not None, bias_per_head,
+                               dbo is not None)
     o, lse = pl.pallas_call(
         lambda *refs: kernel(refs),
         grid=(bh // g, nq, nk),
@@ -906,22 +946,11 @@ def _flash_fwd_nl(q2, k2, v2, nh, d, scale, causal, block_q, block_k,
 
 
 def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                      has_off, has_bias, bias_per_head, refs):
+                      has_off, has_bias, bias_per_head, has_dbo, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
-    pos = 3
-    b_ref = None
-    if has_bias:
-        b_ref = refs[pos]
-        pos += 1
-    seed_ref = None
-    if dropout_rate > 0.0:
-        seed_ref = refs[pos]
-        pos += 1
-    off_ref = None
-    if has_off:
-        off_ref = refs[pos]
-        pos += 1
+    b_ref, seed_ref, dbo_ref, off_ref, pos = _unpack_common(
+        refs, 3, has_bias, dropout_rate, has_dbo, has_off)
     do_ref, lse_ref, dl_ref, dq_ref, dq_acc = refs[pos:]
     iq, ik = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -952,7 +981,9 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate,
+            iqo = iq + dbo_ref[0] if has_dbo else iq
+            iko = ik + dbo_ref[1] if has_dbo else ik
+            keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
         ds = (p * (dp - delta)).astype(q.dtype)
@@ -966,22 +997,11 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 
 def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
-                       has_off, has_bias, bias_per_head, refs):
+                       has_off, has_bias, bias_per_head, has_dbo, refs):
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
-    pos = 3
-    b_ref = None
-    if has_bias:
-        b_ref = refs[pos]
-        pos += 1
-    seed_ref = None
-    if dropout_rate > 0.0:
-        seed_ref = refs[pos]
-        pos += 1
-    off_ref = None
-    if has_off:
-        off_ref = refs[pos]
-        pos += 1
+    b_ref, seed_ref, dbo_ref, off_ref, pos = _unpack_common(
+        refs, 3, has_bias, dropout_rate, has_dbo, has_off)
     do_ref, lse_ref, dl_ref, dk_ref, dv_ref, dk_acc, dv_acc = refs[pos:]
     ik, iq = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -1015,7 +1035,9 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
                                  preferred_element_type=jnp.float32)
         pv = p
         if dropout_rate > 0.0:
-            keep = _keep_mask(seed_ref[0], iq, ik, bq, bk, dropout_rate,
+            iqo = iq + dbo_ref[0] if has_dbo else iq
+            iko = ik + dbo_ref[1] if has_dbo else ik
+            keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             inv_keep = 1.0 / (1.0 - dropout_rate)
             pv = jnp.where(keep, p * inv_keep, 0.0)
@@ -1036,7 +1058,7 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
 
 def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
                          g, has_off, self_delta, has_bias,
-                         bias_per_head, refs):
+                         bias_per_head, has_dbo, refs):
     """Single-sweep backward for single-block grids (Sq, Sk each one
     tile): s and p are computed ONCE per head and all three gradients
     come out of the same sweep — the two-kernel split pays a redundant
@@ -1058,19 +1080,8 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
     externally shifted delta."""
     refs = list(refs)
     q_ref, k_ref, v_ref = refs[:3]
-    pos = 3
-    b_ref = None
-    if has_bias:
-        b_ref = refs[pos]
-        pos += 1
-    seed_ref = None
-    if dropout_rate > 0.0:
-        seed_ref = refs[pos]
-        pos += 1
-    off_ref = None
-    if has_off:
-        off_ref = refs[pos]
-        pos += 1
+    b_ref, seed_ref, dbo_ref, off_ref, pos = _unpack_common(
+        refs, 3, has_bias, dropout_rate, has_dbo, has_off)
     if self_delta:
         do_ref, dq_ref, dk_ref, dv_ref = refs[pos:]
         lse_ref = dl_ref = None
@@ -1115,7 +1126,9 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
                                  preferred_element_type=jnp.float32)
         pv = p
         if dropout_rate > 0.0:
-            keep = _keep_mask(seed_ref[0], 0, 0, bq, bk, dropout_rate,
+            iqo = dbo_ref[0] if has_dbo else 0
+            iko = dbo_ref[1] if has_dbo else 0
+            keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             inv_keep = 1.0 / (1.0 - dropout_rate)
             pv = jnp.where(keep, p * inv_keep, 0.0)
@@ -1141,7 +1154,7 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
 def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
                         scale, causal, sq, sk, sqp, skp, bq, bk, seed,
                         dropout_rate, causal_off=None, bias_p=None,
-                        bias_mode=None):
+                        bias_mode=None, dbo=None):
     """``lse_l``/``delta_l`` None ⇒ the kernel self-computes the
     normalizer and delta (the single-block identity, no lane operands)."""
     self_delta = lse_l is None
@@ -1157,18 +1170,12 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
                           memory_space=pltpu.VMEM)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
-    if bias_p is not None:
-        blk0, row = _bias_blk_nl(bias_mode, g, hg)
-        in_specs.append(pl.BlockSpec(
-            (blk0, sqp, skp), lambda t: (row(t), 0, 0),
-            memory_space=pltpu.VMEM))
-        args.append(bias_p)
-    if dropout_rate > 0.0:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(seed)
-    if causal_off is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(causal_off)
+    _append_common(
+        in_specs, args, bias_p=bias_p, bias_mode=bias_mode, g=g, hg=hg,
+        bias_dims=(sqp, skp),
+        bias_idx=lambda row: lambda t: (row(t), 0, 0),
+        dropout_rate=dropout_rate, seed=seed, dbo=dbo,
+        causal_off=causal_off)
     if self_delta:
         in_specs += [q_spec]
         args += [dop]
@@ -1182,7 +1189,7 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
         lambda *refs: functools.partial(
             _bwd_fused_kernel_nl, scale, causal, sk, sq, dropout_rate,
             d, g, causal_off is not None, self_delta,
-            bias_p is not None, bias_per_head)(refs),
+            bias_p is not None, bias_per_head, dbo is not None)(refs),
         grid=(bh // g,),
         in_specs=in_specs,
         out_specs=(q_spec, k_spec, k_spec),
@@ -1199,7 +1206,7 @@ def _flash_bwd_fused_nl(qp, kp, vp, dop, lse_l, delta_l, nh, d, g,
 def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                   block_q, block_k, dropout_rate=0.0, seed=None,
                   causal_off=None, delta_shifted=False, bias_g=None,
-                  bias_mode=None):
+                  bias_mode=None, dbo=None):
     """Native-layout backward: operands/outputs (B, S, H); ``lse`` and
     ``delta`` arrive (B·H, Sq).
 
@@ -1307,7 +1314,7 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                                        sqp, skp, bq, bk, seed,
                                        dropout_rate, causal_off,
                                        bias_p=bias_p,
-                                       bias_mode=bias_mode)
+                                       bias_mode=bias_mode, dbo=dbo)
 
     gd = g * d
     lse_l = _lanes_nl(lse, bh, g, nq, bq, sq)
@@ -1322,18 +1329,12 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
     bias_p = None if bias_g is None else _pad_bias_nl(bias_g, sqp, skp)
     in_specs = [q_spec, k_spec, k_spec]
     args = [qp, kp, vp]
-    if bias_p is not None:
-        blk0, row = _bias_blk_nl(bias_mode, g, hg)
-        in_specs.append(pl.BlockSpec(
-            (blk0, bq, bk), lambda t, i, j: (row(t), i, j),
-            memory_space=pltpu.VMEM))
-        args.append(bias_p)
-    if dropout_rate > 0.0:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(seed)
-    if causal_off is not None:
-        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args.append(causal_off)
+    _append_common(
+        in_specs, args, bias_p=bias_p, bias_mode=bias_mode, g=g, hg=hg,
+        bias_dims=(bq, bk),
+        bias_idx=lambda row: lambda t, i, j: (row(t), i, j),
+        dropout_rate=dropout_rate, seed=seed, dbo=dbo,
+        causal_off=causal_off)
     in_specs += [q_spec, lane_spec, lane_spec]
     args += [dop, lse_l, delta_l]
 
@@ -1346,7 +1347,7 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
         lambda *refs: functools.partial(
             _bwd_dq_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
             g, causal_off is not None, bias_p is not None,
-            bias_per_head)(refs),
+            bias_per_head, dbo is not None)(refs),
         grid=(bh // g, nq, nk),
         in_specs=in_specs,
         out_specs=q_spec,
@@ -1368,18 +1369,12 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
                                memory_space=pltpu.VMEM)
     in_specs2 = [q_spec_k, k_spec_k, k_spec_k]
     args2 = [qp, kp, vp]
-    if bias_p is not None:
-        blk0, row = _bias_blk_nl(bias_mode, g, hg)
-        in_specs2.append(pl.BlockSpec(
-            (blk0, bq, bk), lambda t, j, i: (row(t), i, j),
-            memory_space=pltpu.VMEM))
-        args2.append(bias_p)
-    if dropout_rate > 0.0:
-        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args2.append(seed)
-    if causal_off is not None:
-        in_specs2.append(pl.BlockSpec(memory_space=pltpu.SMEM))
-        args2.append(causal_off)
+    _append_common(
+        in_specs2, args2, bias_p=bias_p, bias_mode=bias_mode, g=g,
+        hg=hg, bias_dims=(bq, bk),
+        bias_idx=lambda row: lambda t, j, i: (row(t), i, j),
+        dropout_rate=dropout_rate, seed=seed, dbo=dbo,
+        causal_off=causal_off)
     in_specs2 += [q_spec_k, lane_spec_k, lane_spec_k]
     args2 += [dop, lse_l, delta_l]
 
@@ -1387,7 +1382,7 @@ def _flash_bwd_nl(q2, k2, v2, nh, d, lse, delta, do2, scale, causal,
         lambda *refs: functools.partial(
             _bwd_dkv_kernel_nl, scale, causal, sk, sq, dropout_rate, d,
             g, causal_off is not None, bias_p is not None,
-            bias_per_head)(refs),
+            bias_per_head, dbo is not None)(refs),
         grid=(bh // g, nk, nq),
         in_specs=in_specs2,
         out_specs=(k_spec_k, k_spec_k),
@@ -1511,13 +1506,21 @@ def _offset_bias(off_arr, sq, sk):
 
 def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
                              block_q, block_k, dropout_rate,
-                             causal_offset=None):
+                             causal_offset=None, dbo=None):
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     seed = _seed_arr(dropout_seed, dropout_rate)
     off = _off_arr(causal_offset, causal)
     if off is not None and bias is not None:
         raise ValueError("causal_offset cannot combine with a bias")
+    if dbo is not None and (bias is not None
+                            or _native_g0(h, d) is None):
+        # block offsets shift the dropout hash's global coordinates;
+        # the dense bias-grad replica and the transposed fallback do
+        # not reconstruct them — fail loudly rather than silently
+        # diverge from the single-device mask (docs/parallel.md)
+        raise ValueError("dropout_block_offset requires the native "
+                         "attention path and no bias")
     if _native_g0(h, d) is not None:
         # native-layout path: (B, S, H) operands straight through — no
         # transpose copies, no D zero-pad (see the native-kernel block).
@@ -1530,7 +1533,7 @@ def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
         o2, lse = _flash_fwd_nl(q2, k2, v2, h, d, scale, causal,
                                 block_q, block_k, dropout_rate, seed,
                                 causal_off=off, bias_g=bias_nl,
-                                bias_mode=bias_mode)
+                                bias_mode=bias_mode, dbo=dbo)
         o = o2.reshape(b, sq, h, d)
         return o, (q, k, v, bias, dropout_seed, o, lse, causal_offset)
     eff_bias, eff_causal = bias, causal
@@ -1615,7 +1618,7 @@ def _keep_mask_dense(seed, b, h, sq, sk, bq, bk, rate):
 
 def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
                dropout_rate=0.0, seed=None, block_q=DEFAULT_BLOCK_Q,
-               block_k=DEFAULT_BLOCK_K):
+               block_k=DEFAULT_BLOCK_K, delta_shift=None):
     """Cotangent for a learned additive bias (e.g. relative-position
     biases): ds = p * (dp - delta), reduced to the bias's broadcast
     shape. Recomputes p from the saved lse so no extra softmax pass is
@@ -1647,7 +1650,11 @@ def _bias_grad(q, k, v, bias, o, lse, do, scale, causal, *,
         dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                       # (b, sq, h)
-    ds = p * (dp - jnp.swapaxes(delta, 1, 2)[..., None])
+    delta = jnp.swapaxes(delta, 1, 2)              # (b, h, sq)
+    if delta_shift is not None:
+        # the lse-cotangent fold: ds = p*(dp - (delta - dlse))
+        delta = delta - delta_shift.astype(jnp.float32)
+    ds = p * (dp - delta[..., None])
     for axis in range(4):
         if bias.shape[axis] == 1:
             ds = jnp.sum(ds, axis=axis, keepdims=True)
@@ -1699,37 +1706,50 @@ def mask_softmax_dropout(scores, mask=None, dropout_rate=0.0,
 
 # --- lse-returning variant (sequence-parallel building block) ---------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                        causal_offset=None):
+                        dropout_rate=0.0, dropout_seed=None,
+                        causal_offset=None, dropout_block_offset=None):
     """Like :func:`flash_attention` but returns ``(out, lse)`` with
     ``lse`` (B, H, Sq) differentiable — the building block ring attention
     needs to merge partial results across sequence shards.
     ``causal_offset`` shifts the causal frontier like
     :func:`flash_attention`'s (ring hops pass their traced global
     offset so no O(S²) hop bias is ever built on the native path).
+    ``dropout_block_offset`` — a traced (2,) int32 of global
+    (q-block, k-block) offsets — shifts the counter-based dropout
+    hash's block coordinates, so a sequence shard reproduces exactly
+    the keep mask the single-device call would have generated at the
+    same global coordinates (ring hops pass their ring position; the
+    reference's fused dropout has no distributed counterpart,
+    `apex/contrib/csrc/multihead_attn/dropout.h:1-308`).
     """
     (o, lse), _ = _fal_fwd(q, k, v, bias, scale, causal, block_q,
-                           block_k, causal_offset)
+                           block_k, dropout_rate, dropout_seed,
+                           causal_offset, dropout_block_offset)
     return o, lse
 
 
 def _fal_fwd(q, k, v, bias, scale, causal, block_q, block_k,
-             causal_offset):
-    o, res = _flash_attention_fwd_res(q, k, v, bias, None, scale, causal,
-                                      block_q, block_k, 0.0,
-                                      causal_offset)
+             dropout_rate, dropout_seed, causal_offset,
+             dropout_block_offset):
+    dbo = (None if dropout_block_offset is None
+           else jnp.asarray(dropout_block_offset, jnp.int32).reshape(2))
+    o, res = _flash_attention_fwd_res(q, k, v, bias, dropout_seed,
+                                      scale, causal, block_q, block_k,
+                                      dropout_rate, causal_offset, dbo)
     b, sq, h, _ = q.shape
-    return (o, res[6].reshape(b, h, sq)), res
+    return (o, res[6].reshape(b, h, sq)), res + (dbo,)
 
 
-def _fal_bwd(scale, causal, block_q, block_k, res, cot):
+def _fal_bwd(scale, causal, block_q, block_k, dropout_rate, res, cot):
     do, dlse = cot
-    q, k, v, bias, _, o, lse, causal_offset = res
+    q, k, v, bias, dropout_seed, o, lse, causal_offset, dbo = res
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
+    seed = _seed_arr(dropout_seed, dropout_rate)
     # d lse/d s = p, so the lse cotangent folds into the delta term:
     # ds = p*(dp - delta) + p*dlse = p*(dp - (delta - dlse))
     if _native_g0(h, d) is not None:
@@ -1744,11 +1764,22 @@ def _fal_bwd(scale, causal, block_q, block_k, res, cot):
         delta = delta - dlse.reshape(b * h, sq).astype(jnp.float32)
         dq2, dk2, dv2 = _flash_bwd_nl(
             q2, k2, v2, h, d, lse, delta, do2, scale_, causal,
-            block_q, block_k,
+            block_q, block_k, dropout_rate=dropout_rate, seed=seed,
             causal_off=_off_arr(causal_offset, causal),
-            delta_shifted=True, bias_g=bias_nl, bias_mode=bias_mode)
+            delta_shifted=True, bias_g=bias_nl, bias_mode=bias_mode,
+            dbo=dbo)
+        dbias = None if bias is None else _bias_grad(
+            q, k, v, bias, o, lse, do, scale_, causal,
+            dropout_rate=dropout_rate, seed=seed,
+            block_q=block_q, block_k=block_k,
+            delta_shift=dlse.reshape(b, h, sq))
         return (dq2.reshape(b, sq, h, d), dk2.reshape(b, sk, h, d),
-                dv2.reshape(b, sk, h, d), None, None)
+                dv2.reshape(b, sk, h, d), dbias, None, None, None)
+    if dropout_rate > 0.0:
+        raise NotImplementedError(
+            "flash_attention_lse dropout requires the native attention "
+            "path (lane-groupable heads); this geometry fell back to "
+            "the transposed kernels")
     eff_bias, eff_causal = bias, causal
     off = _off_arr(causal_offset, causal)
     if off is not None:
@@ -1762,7 +1793,12 @@ def _fal_bwd(scale, causal, block_q, block_k, res, cot):
                                scale_, eff_causal, block_q, block_k,
                                delta_shift=dlse3)
     un = lambda t, s_: jnp.swapaxes(t.reshape(b, h, s_, d), 1, 2)
-    return un(dq3, sq), un(dk3, sk), un(dv3, sk), None, None
+    dbias = None if bias is None else _bias_grad(
+        q, k, v, bias, o, lse, do, scale_, causal,
+        block_q=block_q, block_k=block_k,
+        delta_shift=dlse.reshape(b, h, sq))
+    return (un(dq3, sq), un(dk3, sk), un(dv3, sk), dbias, None, None,
+            None)
 
 
 flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)

@@ -319,6 +319,46 @@ def _():
     _attn_case(4, 1024, 1024, 4, 64, dtype=jnp.float32, atol=2e-2)
 
 
+@case("attention/lse-dropout-block-offset")
+def _():
+    # ring-hop dropout on the chip: the lse variant with a traced
+    # (q-block, k-block) offset must equal a dense replica hashed at
+    # the SHIFTED global coordinates — bitwise mask, fp-tolerance
+    # values (round-5 ring dropout machinery)
+    import numpy as np
+    from apex_tpu.ops import attention as A
+
+    B, S, H, D = 1, 512, 2, 64
+    rate, seed = 0.3, 9
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    dbo = jnp.asarray([2, 3], jnp.int32)
+
+    def dense(q, k, v):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        p = jax.nn.softmax(s, axis=-1)
+        gb = jax.lax.broadcasted_iota(jnp.uint32, (B * H, S, S), 0)
+        rows = jax.lax.broadcasted_iota(jnp.uint32, (B * H, S, S), 1)
+        cols = jax.lax.broadcasted_iota(jnp.uint32, (B * H, S, S), 2)
+        keep = A._mix_keep(jnp.uint32(seed), gb, jnp.uint32(2),
+                           jnp.uint32(3), rows, cols, rate)
+        pk = jnp.where(keep.reshape(B, H, S, S), p / (1.0 - rate), 0.0)
+        return jnp.einsum("bhqk,bkhd->bqhd", pk, v)
+
+    # highest precision: default lowers f32 dots to bf16 passes whose
+    # ~1e-3 noise would swamp a flipped-keep signal (see
+    # _dropout_equiv_case)
+    with jax.default_matmul_precision("highest"):
+        o, lse = jax.jit(lambda q, k, v: A.flash_attention_lse(
+            q, k, v, dropout_rate=rate, dropout_seed=seed,
+            dropout_block_offset=dbo))(q, k, v)
+        ref = jax.jit(dense)(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                               atol=5e-5)
+
+
 @case("attention/ring-hop-shapes")
 def _():
     # the ring per-hop call: flash_attention_lse under the TRACED

@@ -35,7 +35,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.ops.attention import flash_attention, flash_attention_lse
+from apex_tpu.ops.attention import (DROPOUT_TILE, flash_attention,
+                                    flash_attention_lse)
 
 NEG_INF = -1e30
 
@@ -51,27 +52,59 @@ def _merge(o, lse, o_i, lse_i):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   dropout_rate: float = 0.0, dropout_seed=None):
     """Blockwise-exact attention over a sequence-sharded ring.
 
     q/k/v: (B, S_local, H, D), the local sequence shard of each device on
     ``axis_name`` (global sequence = concatenation in axis order).
     Returns the local output shard (B, S_local, H, D).
+
+    With ``dropout_rate`` > 0 the softmax dropout mask is BITWISE the
+    mask the single-device fast path would draw for the gathered
+    sequence and the same seed: the counter-based hash keys on global
+    (batch·head, q-block, k-block) coordinates, and each hop shifts its
+    block coordinates by its ring position (the ``causal_offset`` trick
+    applied to the dropout hash). Requires the local shard lengths to
+    be multiples of the 512 dropout tile so local blocks align with the
+    global blocking — anything else raises rather than silently drawing
+    a different mask. The log-space merge stays exact under dropout:
+    partial outputs carry the masked probabilities while lse carries
+    the undropped partition, which is precisely the global dropout
+    attention when combined.
     """
     world = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     sq = q.shape[1]
     sk = k.shape[1]
+    if dropout_rate > 0.0:
+        if dropout_seed is None:
+            raise ValueError("dropout_rate > 0 requires dropout_seed")
+        if sq % DROPOUT_TILE or sk % DROPOUT_TILE:
+            raise ValueError(
+                f"ring dropout needs local shard lengths that are "
+                f"multiples of the {DROPOUT_TILE} dropout tile (got "
+                f"Sq={sq}, Sk={sk}): the mask is a function of the "
+                f"global block decomposition and would not match the "
+                f"single-device mask")
+    nqb, nkb = sq // DROPOUT_TILE, sk // DROPOUT_TILE
 
     perm = [(i, (i + 1) % world) for i in range(world)]
 
     def block(q, kv_k, kv_v, src):
+        kw = {}
+        if dropout_rate > 0.0:
+            kw = dict(dropout_rate=dropout_rate,
+                      dropout_seed=dropout_seed,
+                      dropout_block_offset=jnp.stack(
+                          [my * nqb, src * nkb]).astype(jnp.int32))
         if causal:
             # global causality as a traced offset — no hop bias tensor
             off = my * sq - src * sk
             return flash_attention_lse(q, kv_k, kv_v, scale=scale,
-                                       causal=True, causal_offset=off)
-        return flash_attention_lse(q, kv_k, kv_v, scale=scale)
+                                       causal=True, causal_offset=off,
+                                       **kw)
+        return flash_attention_lse(q, kv_k, kv_v, scale=scale, **kw)
 
     o, lse = block(q, k, v, my)
     cur_k, cur_v = k, v
@@ -91,12 +124,21 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      dropout_rate: float = 0.0, dropout_seed=None):
     """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
 
     Re-shards (seq-sharded, all heads) → (all seq, head-sharded), runs
     local fused attention, and restores. Requires H % axis_size == 0.
     """
+    if dropout_rate > 0.0:
+        raise NotImplementedError(
+            "ulysses_attention does not support softmax dropout: after "
+            "the head re-shard the kernels' batch·head hash coordinate "
+            "is local, so the mask would not match the single-device "
+            "mask; use ring_attention(dropout_rate=..., "
+            "dropout_seed=...), whose mask is bitwise-identical")
+    del dropout_seed
     world = jax.lax.axis_size(axis_name)
     h = q.shape[2]
     if h % world:

@@ -580,3 +580,33 @@ class TestCausalOffset:
                       argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+
+
+def test_lse_variant_bias_cotangent():
+    """flash_attention_lse returns a bias gradient that folds the lse
+    cotangent (ds = p*(dp - (delta - dlse))) — round-5; previously the
+    bias slot was silently None."""
+    from apex_tpu.ops.attention import flash_attention_lse
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, 128, 2, 64), jnp.float32)
+               for _ in range(3))
+    bias = jnp.asarray(rng.randn(1, 2, 128, 128), jnp.float32) * 0.3
+
+    def loss(bias):
+        o, lse = flash_attention_lse(q, k, v, bias)
+        # lse term makes dlse nonzero, exercising the shift fold
+        return jnp.sum(jnp.sin(o)) + jnp.sum(lse * 0.01)
+
+    def loss_ref(bias):
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(64) + bias
+        p = jax.nn.softmax(s, -1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        lse = jax.scipy.special.logsumexp(s, -1)
+        return jnp.sum(jnp.sin(o)) + jnp.sum(lse * 0.01)
+
+    with jax.default_matmul_precision("highest"):
+        db = jax.jit(jax.grad(loss))(bias)
+        db_ref = jax.jit(jax.grad(loss_ref))(bias)
+    np.testing.assert_allclose(np.asarray(db), np.asarray(db_ref),
+                               atol=2e-4)
