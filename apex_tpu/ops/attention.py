@@ -179,6 +179,15 @@ def _keep_mask(seed, iq, ik, bq, bk, rate, gb=None):
     return _mix_keep(seed, gb, iq, ik, rows, cols, rate)
 
 
+def _dbo_shift(iq, ik, dbo_ref, has_dbo):
+    """Apply the traced (q-block, k-block) dropout offsets — ONE
+    definition for all four kernels: a drifted copy would silently
+    change the mask in exactly one of fwd/bwd."""
+    if not has_dbo:
+        return iq, ik
+    return iq + dbo_ref[0], ik + dbo_ref[1]
+
+
 def _mix_keep(seed, gb, iq, ik, rows, cols, rate):
     """The shared coordinate hash: block seed + per-element lowbias32
     avalanche → keep bool. ONE definition used by both the kernels and
@@ -762,8 +771,7 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
             l = jnp.sum(p, axis=1, keepdims=True)
             pd = p
             if dropout_rate > 0.0:
-                iqo = iq + dbo_ref[0] if has_dbo else iq
-                iko = ik + dbo_ref[1] if has_dbo else ik
+                iqo, iko = _dbo_shift(iq, ik, dbo_ref, has_dbo)
                 keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk,
                                   dropout_rate,
                                   gb=pl.program_id(0) * g + h)
@@ -787,8 +795,7 @@ def _fwd_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         pd = p
         if dropout_rate > 0.0:
-            iqo = iq + dbo_ref[0] if has_dbo else iq
-            iko = ik + dbo_ref[1] if has_dbo else ik
+            iqo, iko = _dbo_shift(iq, ik, dbo_ref, has_dbo)
             keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             pd = jnp.where(keep, p * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -981,8 +988,7 @@ def _bwd_dq_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         if dropout_rate > 0.0:
-            iqo = iq + dbo_ref[0] if has_dbo else iq
-            iko = ik + dbo_ref[1] if has_dbo else ik
+            iqo, iko = _dbo_shift(iq, ik, dbo_ref, has_dbo)
             keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout_rate)), 0.0)
@@ -1035,8 +1041,7 @@ def _bwd_dkv_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d, g,
                                  preferred_element_type=jnp.float32)
         pv = p
         if dropout_rate > 0.0:
-            iqo = iq + dbo_ref[0] if has_dbo else iq
-            iko = ik + dbo_ref[1] if has_dbo else ik
+            iqo, iko = _dbo_shift(iq, ik, dbo_ref, has_dbo)
             keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             inv_keep = 1.0 / (1.0 - dropout_rate)
@@ -1126,8 +1131,7 @@ def _bwd_fused_kernel_nl(scale, causal, kv_len, q_len, dropout_rate, d,
                                  preferred_element_type=jnp.float32)
         pv = p
         if dropout_rate > 0.0:
-            iqo = dbo_ref[0] if has_dbo else 0
-            iko = dbo_ref[1] if has_dbo else 0
+            iqo, iko = _dbo_shift(0, 0, dbo_ref, has_dbo)
             keep = _keep_mask(seed_ref[0], iqo, iko, bq, bk, dropout_rate,
                               gb=pl.program_id(0) * g + h)
             inv_keep = 1.0 / (1.0 - dropout_rate)
@@ -1513,14 +1517,28 @@ def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
     off = _off_arr(causal_offset, causal)
     if off is not None and bias is not None:
         raise ValueError("causal_offset cannot combine with a bias")
-    if dbo is not None and (bias is not None
-                            or _native_g0(h, d) is None):
-        # block offsets shift the dropout hash's global coordinates;
-        # the dense bias-grad replica and the transposed fallback do
-        # not reconstruct them — fail loudly rather than silently
-        # diverge from the single-device mask (docs/parallel.md)
-        raise ValueError("dropout_block_offset requires the native "
-                         "attention path and no bias")
+    if dbo is not None:
+        if bias is not None or _native_g0(h, d) is None:
+            # block offsets shift the dropout hash's global
+            # coordinates; the dense bias-grad replica and the
+            # transposed fallback do not reconstruct them — fail
+            # loudly rather than silently diverge from the
+            # single-device mask (docs/parallel.md)
+            raise ValueError("dropout_block_offset requires the "
+                             "native attention path and no bias")
+        cq, ck = _block_cap(block_q, block_k, False, dropout_rate)
+        bq_r = _choose_block(cq, sq)
+        bk_r = _choose_block(ck, k.shape[1], lane=True)
+        if (bq_r, bk_r) != (DROPOUT_TILE, DROPOUT_TILE):
+            # the offsets are expressed in DROPOUT_TILE units; a
+            # geometry whose realized blocks differ (short shards,
+            # overridden block sizes) would apply them in the wrong
+            # units and silently draw a different mask
+            raise ValueError(
+                f"dropout_block_offset requires {DROPOUT_TILE}-sized "
+                f"kernel blocks; this geometry realizes "
+                f"({bq_r}, {bk_r}) — shard lengths must be multiples "
+                f"of {DROPOUT_TILE}")
     if _native_g0(h, d) is not None:
         # native-layout path: (B, S, H) operands straight through — no
         # transpose copies, no D zero-pad (see the native-kernel block).
@@ -1734,6 +1752,12 @@ def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
 def _fal_fwd(q, k, v, bias, scale, causal, block_q, block_k,
              dropout_rate, dropout_seed, causal_offset,
              dropout_block_offset):
+    if dropout_rate > 0.0 and _native_g0(q.shape[2], q.shape[3]) is None:
+        # the lse variant's backward has no transposed dropout path —
+        # fail at trace time, not at the first jax.grad deep in a step
+        raise NotImplementedError(
+            "flash_attention_lse dropout requires the native attention "
+            "path (lane-groupable heads)")
     dbo = (None if dropout_block_offset is None
            else jnp.asarray(dropout_block_offset, jnp.int32).reshape(2))
     o, res = _flash_attention_fwd_res(q, k, v, bias, dropout_seed,

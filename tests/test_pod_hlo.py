@@ -1,0 +1,170 @@
+"""Pod-scale collective-structure assertions (VERDICT r4 item 5).
+
+The driver cannot attach 64 chips, but the collective structure of the
+compiled step is a compile-time artifact: these tests compile the
+O2+DDP flagship step and the ZeRO optimizer path and assert the
+optimized HLO contains the intended collectives — one fused grad
+all-reduce per step at full message size (or the reduce-scatter /
+all-gather pair for ZeRO), never a per-tensor collective storm. The
+same audit runs against a real v5e-64 topology via the AOT compiler
+when the environment provides one (scripts/pod_comm_budget.py); here
+the 8-device CPU mesh keeps it CI-runnable. Reference analogue: the
+bucketed hierarchy apex hand-builds
+(`apex/parallel/distributed.py:604-624`,
+`apex/contrib/optimizers/distributed_fused_adam.py:250-290`).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scripts.pod_comm_budget import collectives
+
+
+def _compile_resnet_step(mesh, n, delay_allreduce):
+    # small ResNet keeps CI fast; the collective structure is the same
+    from apex_tpu import amp, models, ops, parallel
+    from apex_tpu.optim import FusedSGD
+
+    x1 = jnp.ones((2, 32, 32, 3), jnp.float32)
+    model_small = models.ResNet(stage_sizes=[1, 1], num_classes=10,
+                                width=16, dtype=jnp.bfloat16)
+    ddp = parallel.DistributedDataParallel(
+        mesh, delay_allreduce=delay_allreduce)
+    amp_opt = amp.Amp(amp.Policy.from_opt_level("O2"),
+                      FusedSGD(lr=0.1, momentum=0.9))
+
+    def step(state, batch_stats, xb, yb):
+        def loss_fn(mp):
+            logits, mut = model_small.apply(
+                {"params": mp, "batch_stats": batch_stats}, xb,
+                train=True, mutable=["batch_stats"])
+            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
+            return jax.lax.pmean(loss, parallel.DATA_AXIS), \
+                mut["batch_stats"]
+
+        (loss, new_bs), grads, state, finite = amp_opt.backward(
+            state, loss_fn, has_aux=True)
+        grads = ddp.sync(grads)
+        state = amp_opt.apply_gradients(state, grads, finite)
+        return state, new_bs, loss
+
+    variables = jax.eval_shape(
+        lambda: model_small.init(jax.random.PRNGKey(0), x1, train=True))
+    params_s, bs_s = variables["params"], variables["batch_stats"]
+    state_s = jax.eval_shape(
+        lambda: amp_opt.init(jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype), params_s)))
+    x_s = jax.ShapeDtypeStruct((4 * n, 32, 32, 3), jnp.float32)
+    y_s = jax.ShapeDtypeStruct((4 * n,), jnp.int32)
+
+    stepped = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(parallel.DATA_AXIS),
+                  P(parallel.DATA_AXIS)),
+        out_specs=(P(), P(), P()),
+        check_vma=False))
+    hlo = stepped.lower(state_s, bs_s, x_s, y_s).compile().as_text()
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params_s))
+    n_tensors = len(jax.tree_util.tree_leaves(params_s))
+    return hlo, n_params, n_tensors
+
+
+@pytest.mark.parametrize("delay", [True, False])
+def test_ddp_one_fused_grad_allreduce(mesh8, delay):
+    """The grad sync must compile to ~one full-size all-reduce — with
+    delay_allreduce a flat per-dtype buffer, without it the XLA
+    combiner's variadic merge — never one collective per tensor."""
+    hlo, n_params, n_tensors = _compile_resnet_step(mesh8, 8, delay)
+    colls = collectives(hlo)
+    # everything except the scalar loss pmean is grad traffic
+    ars = [c for c in colls if c[0] == "all-reduce" and c[3] > 128]
+    grad_bytes = n_params * 4
+    assert n_tensors > 20, "model too small to prove no-storm"
+    assert len(ars) <= 4, (
+        f"collective storm: {len(ars)} all-reduces for "
+        f"{n_tensors} tensors:\n" + "\n".join(map(str, ars)))
+    total = sum(c[3] for c in ars)
+    # XLA may algebraically move a stray small tensor's reduction out
+    # of the fused op (CPU backend: 764 of 131176 bytes); the claim is
+    # structural — bulk coverage, not bitwise byte accounting
+    assert total >= int(grad_bytes * 0.95), (
+        f"grad all-reduces cover {total} bytes < fp32 grads "
+        f"{grad_bytes}")
+
+
+def test_zero_optimizer_scatter_gather(mesh8):
+    """DistributedFusedAdam (ZeRO): grads reduce-scatter to shards,
+    updated params all-gather back — and no full-size all-reduce."""
+    from apex_tpu import parallel
+    from apex_tpu.optim import DistributedFusedAdam
+
+    opt = DistributedFusedAdam(lr=1e-3, axis_name=parallel.DATA_AXIS)
+    n_params = 1 << 20
+    params = {"w": jax.ShapeDtypeStruct((n_params,), jnp.float32)}
+
+    def step(params, xb):
+        def loss_fn(p):
+            return jnp.sum(jnp.square(p["w"])) * jnp.mean(xb)
+        # grads stay UNREDUCED: the ZeRO optimizer's own pipeline does
+        # psum_scatter -> shard update -> all_gather
+        grads = jax.grad(loss_fn)(params)
+        opt_state = opt.init(params)
+        new_params, _ = opt.step(grads, opt_state, params)
+        return new_params
+
+    x_s = jax.ShapeDtypeStruct((8,), jnp.float32)
+    stepped = jax.jit(jax.shard_map(
+        step, mesh=mesh8,
+        in_specs=(P(), P(parallel.DATA_AXIS)),
+        out_specs=P(), check_vma=False))
+    hlo = stepped.lower(params, x_s).compile().as_text()
+    colls = collectives(hlo)
+    kinds = {c[0] for c in colls}
+    assert "reduce-scatter" in kinds, f"no reduce-scatter: {colls}"
+    assert "all-gather" in kinds, f"no all-gather: {colls}"
+    param_bytes = n_params * 4
+    big_ar = [c for c in colls
+              if c[0] == "all-reduce" and c[3] >= param_bytes // 2]
+    assert not big_ar, (
+        f"ZeRO path still moves full-size all-reduces: {big_ar}")
+
+
+def test_v5e64_aot_collective_structure():
+    """The same audit against a REAL v5e-64 topology via the AOT
+    compiler — the full-scale evidence. Skipped when the environment
+    cannot AOT-compile for TPU topologies (CPU-only CI)."""
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:8x8")
+    except Exception as e:
+        pytest.skip(f"no TPU AOT topology support: {e}")
+    from jax.sharding import Mesh
+    from apex_tpu import parallel
+    mesh = Mesh(np.array(topo.devices), (parallel.DATA_AXIS,))
+    try:
+        hlo, n_params, n_tensors = _compile_resnet_step(mesh, 64, True)
+    except Exception as e:
+        pytest.skip(f"TPU AOT compile unavailable: {e}")
+    colls = collectives(hlo)
+    grad_bytes = n_params * 4
+    ars = [c for c in colls if c[0] == "all-reduce" and c[3] > 128]
+    assert len(ars) <= 4, ars
+    # same 0.95 slack as the CPU sibling: XLA may algebraically move a
+    # stray small tensor's reduction out of the fused op
+    assert sum(c[3] for c in ars) >= int(grad_bytes * 0.95), ars
+    # all 64 chips participate in one replica group — enumerated or
+    # iota-printed form depending on XLA version
+    import re as _re
+    assert _re.search(r"replica_groups=(\{\{0,1,2,3|\[1,64\]<=\[64\])",
+                      hlo), "no 64-wide replica group found"
