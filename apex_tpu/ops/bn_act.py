@@ -60,6 +60,10 @@ class _Cfg(NamedTuple):
     eps: float
     axis_name: Optional[str]
     groups: Optional[Tuple[Tuple[int, ...], ...]]
+    #: store the backward-only activation residual as float8_e4m3 x̂
+    #: instead of the full-precision conv output x (round-5 byte-floor
+    #: experiment; see PERF.md round-5 ResNet section)
+    fp8: bool = False
 
 
 def _normalize_groups(axis_index_groups):
@@ -117,6 +121,22 @@ def _apply(x32, r, scale, bias, mean, invstd, relu):
     if relu:
         y = jnp.maximum(y, 0.0)
     return y
+
+
+def _xres_of(x, mean, invstd, cfg: _Cfg):
+    """The backward's activation residual: x itself, or — under
+    ``cfg.fp8`` — x̂ quantized to float8_e4m3. x̂ is zero-mean unit
+    variance per channel BY CONSTRUCTION, so e4m3's dynamic range
+    covers it with no per-channel scale factor; the backward consumes
+    x only through x̂ (both channel sums and the dx term), so nothing
+    else is lost. The expression duplicates _apply's interior on
+    purpose: it fuses into the same normalize pass (reads x once,
+    writes y and x̂₈), costing one fp8 write where the backward then
+    reads fp8 twice instead of the wide dtype twice."""
+    if not cfg.fp8:
+        return x
+    return ((x.astype(jnp.float32) - mean)
+            * invstd).astype(jnp.float8_e4m3fn)
 
 
 def _fwd_common(x, r, scale, bias, cfg: _Cfg):
@@ -267,7 +287,7 @@ def _bwd_pallas(cfg: _Cfg, x, scale, bias, mean, invstd, count, z, dz,
 
 
 def _bwd_core(cfg: _Cfg, x, scale, bias, mean, invstd, count, z, dz,
-              has_residual: bool, r_dtype=None):
+              has_residual: bool, r_dtype=None, dx_dtype=None):
     """Dispatch: jnp two-pass backward (the product path — XLA fuses it
     into exactly one reduce + one elementwise pass per unit). The Pallas
     variant exists behind ``APEX_TPU_BN_PALLAS_BWD=1``: measured on the
@@ -275,18 +295,18 @@ def _bwd_core(cfg: _Cfg, x, scale, bias, mean, invstd, count, z, dz,
     inside spatial) and a pallas custom-call pins default layouts, so
     every operand pays a 400 MB-class layout copy (see PERF.md round 3).
     """
-    if os.environ.get("APEX_TPU_BN_PALLAS_BWD") == "1":
+    if os.environ.get("APEX_TPU_BN_PALLAS_BWD") == "1" and not cfg.fp8:
         c = x.shape[-1]
         rb = _bwd_row_block(x.size // c, c)
         if rb >= 8:
             return _bwd_pallas(cfg, x, scale, bias, mean, invstd, count,
                                z, dz, has_residual, r_dtype, rb)
     return _bwd_jnp(cfg, x, scale, bias, mean, invstd, count, z, dz,
-                    has_residual, r_dtype)
+                    has_residual, r_dtype, dx_dtype)
 
 
 def _bwd_jnp(cfg: _Cfg, x, scale, bias, mean, invstd, count, z, dz,
-             has_residual: bool, r_dtype=None):
+             has_residual: bool, r_dtype=None, dx_dtype=None):
     """The two-pass minimal backward. Reads: (x, g-source) twice; writes
     dx[, dr]. x̂ is recomputed, never re-read.
 
@@ -304,6 +324,9 @@ def _bwd_jnp(cfg: _Cfg, x, scale, bias, mean, invstd, count, z, dz,
     scale32 = scale.astype(jnp.float32)
 
     def xhat_of(xv):
+        if cfg.fp8:
+            # the residual already IS x̂ (fp8); dequantize in-register
+            return xv.astype(jnp.float32)
         return (xv.astype(jnp.float32) - mean_b) * invstd_b
 
     dr = None
@@ -342,7 +365,7 @@ def _bwd_jnp(cfg: _Cfg, x, scale, bias, mean, invstd, count, z, dz,
     g2 = masked(g_src)
     xhat2 = xhat_of(x)
     dx = ((scale32 * invstd).reshape(cshape)
-          * (g2 - k1 - xhat2 * k2)).astype(x.dtype)
+          * (g2 - k1 - xhat2 * k2)).astype(dx_dtype or x.dtype)
     dscale = sum_dy_xhat.astype(scale.dtype)
     dbias = sum_dy.astype(bias.dtype)
     if has_residual:
@@ -368,14 +391,18 @@ def bn_act_train(x, scale, bias, cfg: _Cfg):
 
 def _bn_act_fwd(x, scale, bias, cfg):
     z, mean, var, count, invstd = _fwd_common(x, None, scale, bias, cfg)
-    return (z, mean, var, count), (x, scale, bias, mean, invstd, count)
+    xres = _xres_of(x, mean, invstd, cfg)
+    xtok = jnp.zeros((), x.dtype)       # dx dtype token
+    return (z, mean, var, count), (xres, xtok, scale, bias, mean,
+                                   invstd, count)
 
 
 def _bn_act_bwd(cfg, res, cts):
     dz = cts[0]  # stat cotangents dropped: stats are buffers
-    x, scale, bias, mean, invstd, count = res
-    dx, dscale, dbias = _bwd_core(cfg, x, scale, bias, mean, invstd,
-                                  count, None, dz, has_residual=False)
+    xres, xtok, scale, bias, mean, invstd, count = res
+    dx, dscale, dbias = _bwd_core(cfg, xres, scale, bias, mean, invstd,
+                                  count, None, dz, has_residual=False,
+                                  dx_dtype=xtok.dtype)
     return dx, dscale, dbias
 
 
@@ -399,16 +426,20 @@ def _bn_add_act_fwd(x, r, scale, bias, cfg):
     # conv input) so saving it adds no HBM tensor
     zres = z if cfg.relu else None
     rtok = jnp.zeros((), r.dtype)  # dtype token (residual leaves: arrays)
-    return (z, mean, var, count), (x, scale, bias, mean, invstd, count,
-                                   zres, rtok)
+    xres = _xres_of(x, mean, invstd, cfg)
+    xtok = jnp.zeros((), x.dtype)
+    return (z, mean, var, count), (xres, xtok, scale, bias, mean,
+                                   invstd, count, zres, rtok)
 
 
 def _bn_add_act_bwd(cfg, res, cts):
     dz = cts[0]
-    x, scale, bias, mean, invstd, count, z, rtok = res
-    dx, dr, dscale, dbias = _bwd_core(cfg, x, scale, bias, mean, invstd,
-                                      count, z, dz, has_residual=True,
-                                      r_dtype=rtok.dtype)
+    xres, xtok, scale, bias, mean, invstd, count, z, rtok = res
+    dx, dr, dscale, dbias = _bwd_core(cfg, xres, scale, bias, mean,
+                                      invstd, count, z, dz,
+                                      has_residual=True,
+                                      r_dtype=rtok.dtype,
+                                      dx_dtype=xtok.dtype)
     return dx, dr, dscale, dbias
 
 
@@ -417,9 +448,10 @@ bn_add_act_train.defvjp(_bn_add_act_fwd, _bn_add_act_bwd)
 
 def make_cfg(*, relu: bool, eps: float = 1e-5,
              axis_name: Optional[str] = None,
-             axis_index_groups=None) -> _Cfg:
+             axis_index_groups=None, fp8: bool = False) -> _Cfg:
     return _Cfg(relu=bool(relu), eps=float(eps), axis_name=axis_name,
-                groups=_normalize_groups(axis_index_groups))
+                groups=_normalize_groups(axis_index_groups),
+                fp8=bool(fp8))
 
 
 def bn_act_reference(x, scale, bias, *, residual=None, relu=True,
@@ -457,6 +489,9 @@ class FusedBNAct(nn.Module):
     axis_index_groups: Optional[Sequence[Sequence[int]]] = None
     init_scale: float = 1.0
     dtype: Optional[Any] = None
+    #: fp8 backward-only residuals (or env APEX_TPU_FP8_RESIDUALS=1 at
+    #: trace time); see _Cfg.fp8
+    fp8_residuals: bool = False
 
     @nn.compact
     def __call__(self, x, residual=None, train: bool = True):
@@ -481,8 +516,11 @@ class FusedBNAct(nn.Module):
             return y.astype(x.dtype)
 
         axis = None if self.is_initializing() else self.axis_name
+        fp8 = (self.fp8_residuals
+               or os.environ.get("APEX_TPU_FP8_RESIDUALS") == "1")
         cfg = make_cfg(relu=self.relu, eps=self.epsilon, axis_name=axis,
-                       axis_index_groups=self.axis_index_groups)
+                       axis_index_groups=self.axis_index_groups,
+                       fp8=fp8)
         if residual is None:
             z, mean, var, count = bn_act_train(x, scale, bias, cfg)
         else:

@@ -33,13 +33,19 @@ import numpy as np
 RESNET_STEP_MS = 97.9       # b=256 device-time isolated step
 ICI_BYTES_PER_S = 4.5e11    # v5e per-chip ICI bandwidth class (~450GB/s)
 
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f8": 1, "s32": 4,
-                "u32": 4, "pred": 1, "f64": 8, "s8": 1, "u8": 1}
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
+                "pred": 1, "f64": 8, "s64": 8, "u64": 8, "s16": 2,
+                "u16": 2, "s8": 1, "u8": 1, "f8e4m3fn": 1,
+                "f8e5m2": 1, "f8e4m3fnuz": 1, "f8e5m2fnuz": 1}
 
 _COLL_RE = re.compile(
     r"(all-reduce|reduce-scatter|all-gather|all-to-all|"
     r"collective-permute)(?:-start)?\(")
-_SHAPE_RE = re.compile(r"((?:f|bf|s|u|pred)[0-9]*)\[([0-9,]*)\]")
+# fp8 dtypes print as f8e4m3fn[...] — match the full name, not just
+# the leading letter+digits
+_SHAPE_RE = re.compile(
+    r"((?:pred|bf16|f8e[0-9]m[0-9](?:fn|fnuz)?|f16|f32|f64|"
+    r"[su](?:8|16|32|64)))\[([0-9,]*)\]")
 
 
 def collectives(hlo: str):
@@ -69,7 +75,10 @@ def collectives(hlo: str):
     return out
 
 
-def build_step(mesh, delay_allreduce):
+def build_step(mesh, delay_allreduce, model=None):
+    """The flagship O2+DDP step — ONE definition shared by this
+    script's v5e-64 audit and tests/test_pod_hlo.py's CI assertions,
+    so what CI pins is exactly what the pod evidence compiled."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu import amp, models, ops, parallel
@@ -77,8 +86,9 @@ def build_step(mesh, delay_allreduce):
 
     ddp = parallel.DistributedDataParallel(
         mesh, delay_allreduce=delay_allreduce)
-    model = models.ResNet(stage_sizes=[3, 4, 6, 3],
-                          num_classes=1000, dtype=jnp.bfloat16)
+    if model is None:
+        model = models.ResNet(stage_sizes=[3, 4, 6, 3],
+                              num_classes=1000, dtype=jnp.bfloat16)
     amp_opt = amp.Amp(amp.Policy.from_opt_level("O2"),
                       FusedSGD(lr=0.1, momentum=0.9))
 
@@ -100,18 +110,20 @@ def build_step(mesh, delay_allreduce):
     return step, model, amp_opt
 
 
-def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=128):
+def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=256,
+                   model=None, image_size=224):
     """Lower the full ResNet-50 O2+DDP step over ``mesh`` using only
     avals (no real arrays — works on abstract topology devices)."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu import parallel
 
-    step, model, amp_opt = build_step(mesh, delay_allreduce)
+    step, model, amp_opt = build_step(mesh, delay_allreduce,
+                                      model=model)
 
     # shape-only init on the default backend (tiny arrays, real mesh
     # not needed): we just need the state/batch_stats avals
-    x1 = jnp.ones((2, 224, 224, 3), jnp.float32)
+    x1 = jnp.ones((2, image_size, image_size, 3), jnp.float32)
     variables = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0), x1, train=True))
     params_s, bs_s = variables["params"], variables["batch_stats"]
@@ -120,7 +132,8 @@ def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=128):
             lambda a: jnp.zeros(a.shape, a.dtype), params_s)))
 
     batch = per_chip_batch * n
-    x_s = jax.ShapeDtypeStruct((batch, 224, 224, 3), jnp.float32)
+    x_s = jax.ShapeDtypeStruct((batch, image_size, image_size, 3),
+                               jnp.float32)
     y_s = jax.ShapeDtypeStruct((batch,), jnp.int32)
 
     stepped = jax.jit(jax.shard_map(
@@ -144,7 +157,14 @@ def report(hlo, params_s, n):
               f"{nbytes / 2 ** 20:8.2f} MiB")
         if op in ("all-reduce", "reduce-scatter"):
             total_red += nbytes
-    ici = 2 * (n - 1) / n * total_red
+    # per-op ring factors: all-reduce moves 2(N-1)/N of the buffer,
+    # reduce-scatter and all-gather (N-1)/N each
+    ici = 0.0
+    for op, dt, n_ops, nbytes in colls:
+        if op == "all-reduce":
+            ici += 2 * (n - 1) / n * nbytes
+        elif op in ("reduce-scatter", "all-gather"):
+            ici += (n - 1) / n * nbytes
     t_ms = ici / ICI_BYTES_PER_S * 1e3
     eff = RESNET_STEP_MS / (RESNET_STEP_MS + t_ms)
     print(f"  param bytes (fp32 grads): {grad_bytes / 2 ** 20:.1f} MiB; "

@@ -39,11 +39,28 @@ def main():
     amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9))
     state = amp_opt.init(params)
 
+    # APEX_TPU_REMAT: checkpoint policy over the whole forward — the
+    # round-5 bytes-vs-FLOPs experiment (PERF.md round-5 ResNet section)
+    remat = os.environ.get("APEX_TPU_REMAT")  # "nothing" | "dots"
+
+    def apply_fn(variables, xb, **kw):
+        if not remat:
+            return model.apply(variables, xb, **kw)
+        pol = {"nothing": jax.checkpoint_policies.nothing_saveable,
+               "dots": jax.checkpoint_policies.checkpoint_dots}[remat]
+
+        def inner(mp, bs, xb):
+            return model.apply({"params": mp, "batch_stats": bs}, xb,
+                               train=True, mutable=["batch_stats"])
+
+        return jax.checkpoint(inner, policy=pol)(
+            variables["params"], variables["batch_stats"], xb)
+
     def step(state, batch_stats, xb, yb):
         def loss_fn(mp):
-            logits, mut = model.apply(
-                {"params": mp, "batch_stats": batch_stats}, xb, train=True,
-                mutable=["batch_stats"])
+            logits, mut = apply_fn(
+                {"params": mp, "batch_stats": batch_stats}, xb,
+                train=True, mutable=["batch_stats"])
             loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
             return loss, mut["batch_stats"]
 

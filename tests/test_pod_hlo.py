@@ -26,53 +26,21 @@ from jax.sharding import PartitionSpec as P
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from scripts.pod_comm_budget import collectives
+from scripts.pod_comm_budget import collectives, lower_flagship
 
 
 def _compile_resnet_step(mesh, n, delay_allreduce):
-    # small ResNet keeps CI fast; the collective structure is the same
-    from apex_tpu import amp, models, ops, parallel
-    from apex_tpu.optim import FusedSGD
+    # small ResNet keeps CI fast; the collective structure is the same,
+    # and the step construction is the SAME code the v5e-64 evidence
+    # compiles (scripts/pod_comm_budget.py)
+    from apex_tpu import models
 
-    x1 = jnp.ones((2, 32, 32, 3), jnp.float32)
     model_small = models.ResNet(stage_sizes=[1, 1], num_classes=10,
                                 width=16, dtype=jnp.bfloat16)
-    ddp = parallel.DistributedDataParallel(
-        mesh, delay_allreduce=delay_allreduce)
-    amp_opt = amp.Amp(amp.Policy.from_opt_level("O2"),
-                      FusedSGD(lr=0.1, momentum=0.9))
-
-    def step(state, batch_stats, xb, yb):
-        def loss_fn(mp):
-            logits, mut = model_small.apply(
-                {"params": mp, "batch_stats": batch_stats}, xb,
-                train=True, mutable=["batch_stats"])
-            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
-            return jax.lax.pmean(loss, parallel.DATA_AXIS), \
-                mut["batch_stats"]
-
-        (loss, new_bs), grads, state, finite = amp_opt.backward(
-            state, loss_fn, has_aux=True)
-        grads = ddp.sync(grads)
-        state = amp_opt.apply_gradients(state, grads, finite)
-        return state, new_bs, loss
-
-    variables = jax.eval_shape(
-        lambda: model_small.init(jax.random.PRNGKey(0), x1, train=True))
-    params_s, bs_s = variables["params"], variables["batch_stats"]
-    state_s = jax.eval_shape(
-        lambda: amp_opt.init(jax.tree_util.tree_map(
-            lambda a: jnp.zeros(a.shape, a.dtype), params_s)))
-    x_s = jax.ShapeDtypeStruct((4 * n, 32, 32, 3), jnp.float32)
-    y_s = jax.ShapeDtypeStruct((4 * n,), jnp.int32)
-
-    stepped = jax.jit(jax.shard_map(
-        step, mesh=mesh,
-        in_specs=(P(), P(), P(parallel.DATA_AXIS),
-                  P(parallel.DATA_AXIS)),
-        out_specs=(P(), P(), P()),
-        check_vma=False))
-    hlo = stepped.lower(state_s, bs_s, x_s, y_s).compile().as_text()
+    lowered, params_s = lower_flagship(
+        mesh, n, delay_allreduce=delay_allreduce, model=model_small,
+        image_size=32, per_chip_batch=4)
+    hlo = lowered.compile().as_text()
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params_s))
     n_tensors = len(jax.tree_util.tree_leaves(params_s))
