@@ -146,6 +146,14 @@ def _():
                        .astype(jnp.float32) ** 2)
 
     g = jax.jit(jax.grad(f, argnums=(0, 1, 2)))
+    from apex_tpu.ops._dispatch import use_interpret
+    if use_interpret():
+        # interpret mode lowers pallas_call to plain HLO — the
+        # custom-call/transpose structure only exists on the chip;
+        # still drive the compiled grads (same condition the kernel
+        # dispatch uses, incl. APEX_TPU_FORCE_INTERPRET on a TPU host)
+        jax.block_until_ready(g(q, k, v, bias))
+        return
     hlo = g.lower(q, k, v, bias).compile().as_text()
     n_tr = sum(1 for l in hlo.splitlines() if " transpose(" in l)
     assert n_tr == 0, f"biased attention compiled {n_tr} transposes"

@@ -156,6 +156,27 @@ class TestRingDropout:
             np.testing.assert_allclose(np.asarray(a), np.asarray(e),
                                        atol=2e-4, err_msg=f"d{name}")
 
+    def test_multiblock_shards_match_local(self):
+        """S_local=1024 → two 512-blocks per shard: the per-hop offsets
+        are in BLOCK units (my*nqb, src*nkb with nqb=nkb=2), so this
+        geometry catches offset-unit bugs the single-block case
+        cannot."""
+        mesh = self._mesh2()
+        rng = np.random.RandomState(8)
+        q, k, v = rand_qkv(rng, 1, 2 * 1024, 2, 64)
+        seed = 55
+
+        def ring(q, k, v):
+            return parallel.ring_attention(
+                q, k, v, "data", causal=True, dropout_rate=0.3,
+                dropout_seed=seed)
+
+        got = _run(mesh, ring, q, k, v)
+        ref = A.flash_attention(q, k, v, causal=True, dropout_rate=0.3,
+                                dropout_seed=seed)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5)
+
     def test_unaligned_shard_raises(self):
         mesh = self._mesh2()
         rng = np.random.RandomState(5)
