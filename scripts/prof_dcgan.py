@@ -1,0 +1,58 @@
+"""Per-op profile of the DCGAN multi-loss bench step (VERDICT r4
+item 8; PERF.md round-5 DCGAN section).
+
+Traces the bench's own run — which includes compile, cost analysis,
+and warmup dispatches — so ABSOLUTE totals span more dispatches than
+the timed loop. Everything printed here is therefore normalized
+per scanned step: the per-op ``avg_us`` column is per occurrence
+(one occurrence per scanned step for loop-body ops), and the category
+totals are divided by (module runs × scan length). Category
+percentages are exact regardless.
+
+Usage: python scripts/prof_dcgan.py [--batch N] [--top N]
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    batch, top = 128, 20
+    argv = sys.argv
+    if "--batch" in argv:
+        batch = int(argv[argv.index("--batch") + 1])
+    if "--top" in argv:
+        top = int(argv[argv.index("--top") + 1])
+
+    import bench as B
+    from apex_tpu import prof
+
+    logdir = tempfile.mkdtemp(prefix="apex_tpu_prof_dcgan_")
+    with prof.trace(logdir):
+        img_s, dt, flops_s = B._bench_dcgan(batch, iters=3)
+    peak = prof.device_peak_flops() or float("inf")
+    print(f"batch={batch} img/s={img_s:.0f} ms/step={dt * 1e3:.3f} "
+          f"MFU={flops_s / peak:.3f}")
+
+    import jax
+
+    from apex_tpu.prof import xplane
+    p = xplane.parse_trace(logdir)
+    cats = p.by_category()
+    tot = sum(cats.values())
+    k_scan = 200 if jax.default_backend() == "tpu" else 5  # bench's K
+    steps = max(p.module_runs, 1) * k_scan
+    print(f"traced {p.module_runs} dispatches x K={k_scan} steps; "
+          f"per-step category times:")
+    for k, v in list(cats.items())[:8]:
+        print(f"  {k:20s} {v / steps:9.1f} us/step  "
+              f"{100 * v / tot:5.1f}%")
+    print(p.table(top=top))
+
+
+if __name__ == "__main__":
+    main()
