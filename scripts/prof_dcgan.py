@@ -2,12 +2,12 @@
 item 8; PERF.md round-5 DCGAN section).
 
 Traces the bench's own run — which includes compile, cost analysis,
-and warmup dispatches — so ABSOLUTE totals span more dispatches than
-the timed loop. Everything printed here is therefore normalized
-per scanned step: the per-op ``avg_us`` column is per occurrence
-(one occurrence per scanned step for loop-body ops), and the category
-totals are divided by (module runs × scan length). Category
-percentages are exact regardless.
+init, and warmup dispatches — so ABSOLUTE totals span more dispatches
+than the timed loop. Everything printed is therefore normalized per
+scanned step: the per-op ``avg_us`` column is per occurrence (one per
+scanned step for loop-body ops), and category totals divide by the
+max op-occurrence count (the number of scanned steps actually traced,
+derived from the trace itself).
 
 Usage: python scripts/prof_dcgan.py [--batch N] [--top N]
 """
@@ -38,16 +38,16 @@ def main():
     print(f"batch={batch} img/s={img_s:.0f} ms/step={dt * 1e3:.3f} "
           f"MFU={flops_s / peak:.3f}")
 
-    import jax
-
     from apex_tpu.prof import xplane
     p = xplane.parse_trace(logdir)
     cats = p.by_category()
     tot = sum(cats.values())
-    k_scan = 200 if jax.default_backend() == "tpu" else 5  # bench's K
-    steps = max(p.module_runs, 1) * k_scan
-    print(f"traced {p.module_runs} dispatches x K={k_scan} steps; "
-          f"per-step category times:")
+    # steps executed = the max op occurrence count: a loop-body op runs
+    # once per scanned step, so this needs no knowledge of the bench's
+    # scan length and is immune to init/warmup dispatches in the trace
+    steps = max((o.occurrences for o in p.ops), default=1)
+    print(f"~{steps} scanned steps traced; per-step category times "
+          f"(init-dispatch time included in totals/percentages):")
     for k, v in list(cats.items())[:8]:
         print(f"  {k:20s} {v / steps:9.1f} us/step  "
               f"{100 * v / tot:5.1f}%")

@@ -489,6 +489,27 @@ class TestLegacySurfaces:
                                        np.asarray(p1[k]), rtol=1e-5)
             assert copy[k].dtype == jnp.bfloat16
 
+    def test_legacy_fused_lamb_clip_and_nvlamb_paths(self):
+        """max_grad_norm=0 disables the clip (pure 1/scale); use_nvlamb
+        applies trust ratios even at wd=0 — both mirror the modern
+        surface at scale=1."""
+        from apex_tpu.optim import legacy, FusedLAMB
+
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(16, 8), jnp.float32)}
+        grads = {"w": jnp.asarray(rng.randn(16, 8) * 3.0, jnp.float32)}
+
+        for kw in ({"max_grad_norm": 0.0, "weight_decay": 0.0},
+                   {"use_nvlamb": True, "weight_decay": 0.0},
+                   {"max_grad_norm": 0.5}):
+            lo = legacy.FusedLAMB(lr=1e-2, **kw)
+            p1, _ = lo.step(grads, lo.init(params), params, scale=1.0)
+            modern = FusedLAMB(lr=1e-2, strategy="arena", **kw)
+            p2, _ = modern.step(grads, modern.init(params), params)
+            np.testing.assert_allclose(np.asarray(p1["w"]),
+                                       np.asarray(p2["w"]), rtol=1e-6,
+                                       err_msg=str(kw))
+
 
 class TestFunctionalPatch:
     """O1 raw-op coverage: jnp/lax entry points under auto_cast
