@@ -16,6 +16,9 @@ A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
 - ``apex_tpu.sparsity``  — 2:4 structured sparsity (ASP).
 - ``apex_tpu.prof``      — profiler/trace tooling over jax.profiler + HLO cost
                            analysis.
+- ``apex_tpu.monitor``   — runtime telemetry: in-graph training-health
+                           counters + host-side metrics pipeline (sinks,
+                           step-time/MFU, collective-bytes accounting).
 
 Unlike the reference (an interception-based library over an eager framework),
 apex_tpu expresses the same capabilities as *policies, functional transforms and
@@ -27,9 +30,11 @@ CUDA kernels of the reference are Pallas kernels over a flat parameter arena.
 
 __version__ = "0.1.0"
 
+from apex_tpu import _compat  # noqa: F401  (installs jax API shims first)
 from apex_tpu import amp
 from apex_tpu import arena
 from apex_tpu import fp16_utils
+from apex_tpu import monitor
 from apex_tpu import ops
 from apex_tpu import optim
 from apex_tpu import parallel
@@ -37,5 +42,5 @@ from apex_tpu import prof
 from apex_tpu import reparam
 from apex_tpu import utils
 
-__all__ = ["amp", "arena", "fp16_utils", "ops", "optim", "parallel", "prof",
-           "reparam", "utils", "__version__"]
+__all__ = ["amp", "arena", "fp16_utils", "monitor", "ops", "optim",
+           "parallel", "prof", "reparam", "utils", "__version__"]

@@ -45,7 +45,9 @@ from apex_tpu.amp.scaler import (
     LossScaleConfig, LossScaleState, loss_scale_init, loss_scale_update,
     scale_loss, unscale_grads,
 )
-from apex_tpu.utils import tree_all_finite, tree_cast, tree_select
+from apex_tpu.monitor.metrics import Metrics, metrics_init
+from apex_tpu.utils import global_norm, tree_all_finite, tree_cast, \
+    tree_select
 
 
 class AmpState(NamedTuple):
@@ -57,20 +59,36 @@ class AmpState(NamedTuple):
     ``amp.state_dict`` + optimizer/model state dicts — and because masters
     are fp32, checkpoints are fp32 exactly like the O2 state-dict hook
     guarantees (`apex/amp/_initialize.py:133-142`).
+
+    ``metrics`` is the opt-in telemetry pytree (``Amp(..., monitor=True)``,
+    see apex_tpu.monitor): ``None`` — a leafless pytree node — when
+    monitoring is off, so existing states/checkpoints keep their exact
+    leaf structure.
     """
     step: jax.Array
     params: Any
     opt_state: Any
     scalers: Tuple[Optional[LossScaleState], ...]
+    metrics: Optional[Metrics] = None
 
 
 class Amp:
-    """Bundles a precision policy, an optimizer, and loss scaling."""
+    """Bundles a precision policy, an optimizer, and loss scaling.
 
-    def __init__(self, policy: Policy, tx, *, num_losses: int = 1):
+    ``monitor=True`` threads an :class:`apex_tpu.monitor.Metrics` pytree
+    through the state: backward records loss + scaler events,
+    ``apply_gradients`` records grad/param norms and step/skip counts —
+    all as pure in-graph arithmetic (no extra dispatches, no host syncs;
+    hand ``state.metrics`` to a :class:`apex_tpu.monitor.MetricsLogger`
+    to ship them off-device on an amortized cadence).
+    """
+
+    def __init__(self, policy: Policy, tx, *, num_losses: int = 1,
+                 monitor: bool = False):
         self.policy = policy
         self.tx = tx
         self.num_losses = num_losses
+        self.monitor = monitor
         self.scale_cfg = LossScaleConfig.from_policy_field(policy.loss_scale)
 
     # -- state construction --------------------------------------------------
@@ -93,6 +111,7 @@ class Amp:
             opt_state=self.tx.init(master),
             scalers=tuple(loss_scale_init(self.scale_cfg)
                           for _ in range(self.num_losses)),
+            metrics=metrics_init() if self.monitor else None,
         )
 
     def model_params(self, state: AmpState):
@@ -153,6 +172,7 @@ class Amp:
             return scale_loss(loss, sstate), out
 
         grads, out = jax.grad(scaled, has_aux=True)(state.params)
+        loss_val = out[0] if has_aux else out
         if self.scale_cfg is None:
             grads = tree_cast(grads, jnp.float32)
             if stashed is not None:
@@ -160,20 +180,32 @@ class Amp:
                     lambda s, g: s + g if jnp.issubdtype(
                         jnp.asarray(g).dtype, jnp.floating) else g,
                     stashed, grads)
+            if state.metrics is not None:
+                state = state._replace(
+                    metrics=state.metrics.record_loss(loss_val)._replace(
+                        loss_scale=jnp.float32(1.0)))
             return out, grads, state, finite
         if stashed is None:
             acc, this_finite = unscale_grads(grads, sstate)
         else:
             acc, this_finite = _scaler.unscale_grads_with_stashed(
                 grads, stashed, sstate)
-        new_sstate = loss_scale_update(sstate, this_finite, self.scale_cfg)
+        if state.metrics is not None:
+            new_sstate, metrics = loss_scale_update(
+                sstate, this_finite, self.scale_cfg, metrics=state.metrics)
+            metrics = metrics.record_loss(loss_val)
+        else:
+            new_sstate = loss_scale_update(sstate, this_finite,
+                                           self.scale_cfg)
+            metrics = None
         scalers = tuple(new_sstate if i == loss_id else s
                         for i, s in enumerate(state.scalers))
         if isinstance(finite, bool):
             new_finite = this_finite if finite else jnp.bool_(False)
         else:
             new_finite = jnp.logical_and(finite, this_finite)
-        return out, acc, state._replace(scalers=scalers), new_finite
+        return out, acc, state._replace(scalers=scalers, metrics=metrics), \
+            new_finite
 
     # -- update --------------------------------------------------------------
 
@@ -203,8 +235,21 @@ class Amp:
         else:
             new_step = state.step + jnp.where(grads_finite, 1, 0).astype(
                 jnp.int32)
+        metrics = state.metrics
+        if metrics is not None:
+            # counters advance on the SKIPPED branch too (they are
+            # telemetry, not training state) — so they sit outside the
+            # tree_select above. The grad-norm gauge holds its last
+            # finite value across overflow steps (the event itself is in
+            # overflow/skip counts); garbage-grad norms would poison the
+            # logged stream with inf.
+            fin = jnp.asarray(grads_finite, jnp.bool_)
+            metrics = metrics.count_step(grads_finite).record_norms(
+                grad_norm=jnp.where(fin, global_norm(grads),
+                                    metrics.grad_norm),
+                param_norm=global_norm(committed_params))
         return state._replace(step=new_step, params=committed_params,
-                              opt_state=committed_opt)
+                              opt_state=committed_opt, metrics=metrics)
 
     def step(self, state: AmpState, loss_fn: Callable, *args,
              loss_id: int = 0, has_aux: bool = False, **kwargs):
@@ -241,7 +286,7 @@ class Amp:
 
 def initialize(params, tx, opt_level: str = "O1", *,
                half_dtype=jnp.bfloat16, num_losses: int = 1,
-               verbosity: int = 1,
+               verbosity: int = 1, monitor: bool = False,
                **policy_overrides) -> Tuple[Amp, AmpState]:
     """One-call setup: ``amp_opt, state = amp.initialize(params, tx, "O2")``.
 
@@ -250,6 +295,8 @@ def initialize(params, tx, opt_level: str = "O1", *,
     policy preset (kwarg overrides win), the Amp bundle, and the initial
     state in one step. ``verbosity=1`` prints the selected-properties
     banner on process 0 (`frontend.py:328-356`); 0 is silent.
+    ``monitor=True`` threads the apex_tpu.monitor metrics pytree through
+    the state (see docs/monitoring.md).
     """
     policy = Policy.from_opt_level(opt_level, half_dtype=half_dtype,
                                    **policy_overrides)
@@ -264,7 +311,7 @@ def initialize(params, tx, opt_level: str = "O1", *,
                       "master_weights", "loss_scale"):
             maybe_print(f"{field:<24}: {getattr(policy, field)}",
                         rank0=True)
-    amp_opt = Amp(policy, tx, num_losses=num_losses)
+    amp_opt = Amp(policy, tx, num_losses=num_losses, monitor=monitor)
     return amp_opt, amp_opt.init(params)
 
 

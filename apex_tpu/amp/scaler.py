@@ -137,18 +137,32 @@ def unscale_grads_with_stashed(grads, stashed, state: Optional[LossScaleState],
 
 
 def loss_scale_update(state: Optional[LossScaleState], grads_finite,
-                      cfg: Optional[LossScaleConfig]):
+                      cfg: Optional[LossScaleConfig], *, metrics=None):
     """Advance the scale schedule — entirely on device.
 
     Parity with ``LossScaler.update_scale`` (`apex/amp/scaler.py:197-215`):
     overflow → scale *= backoff (clamped below by ``min_loss_scale``),
     tracker reset; else tracker += 1, and at ``growth_interval`` scale *=
     growth (clamped above by ``max_loss_scale``), tracker reset.
+
+    With an :class:`apex_tpu.monitor.Metrics` pytree passed as
+    ``metrics=``, the schedule's events are counted on-device (overflow /
+    backoff / growth, plus the resulting scale gauge) — the telemetry
+    replacement for the reference's "Gradient overflow. Skipping step"
+    prints (`apex/amp/scaler.py:201-211`) — and ``(new_state, metrics')``
+    is returned instead of just the state. Event arithmetic is pure
+    ``jnp``; it rides the existing step dispatch.
     """
-    if state is None or cfg is None:
-        return state
-    if not cfg.dynamic:
-        return state
+    if state is None or cfg is None or not cfg.dynamic:
+        if metrics is None:
+            return state
+        overflow = jnp.logical_not(
+            jnp.asarray(grads_finite, jnp.bool_)).astype(jnp.int32)
+        metrics = metrics._replace(
+            loss_scale=(jnp.float32(1.0) if state is None
+                        else state.loss_scale),
+            overflow_count=metrics.overflow_count + overflow)
+        return state, metrics
 
     scale = state.loss_scale
     tracker = state.growth_tracker
@@ -169,7 +183,19 @@ def loss_scale_update(state: Optional[LossScaleState], grads_finite,
         grads_finite,
         jnp.where(should_grow, 0, grown_tracker),
         0).astype(jnp.int32)
-    return LossScaleState(loss_scale=new_scale, growth_tracker=new_tracker)
+    new_state = LossScaleState(loss_scale=new_scale,
+                               growth_tracker=new_tracker)
+    if metrics is None:
+        return new_state
+    fin = jnp.asarray(grads_finite, jnp.bool_)
+    overflow = jnp.logical_not(fin).astype(jnp.int32)
+    grew = jnp.logical_and(fin, should_grow).astype(jnp.int32)
+    metrics = metrics._replace(
+        loss_scale=new_scale,
+        overflow_count=metrics.overflow_count + overflow,
+        backoff_count=metrics.backoff_count + overflow,
+        growth_count=metrics.growth_count + grew)
+    return new_state, metrics
 
 
 def select_if_finite(grads_finite, new_tree, old_tree):

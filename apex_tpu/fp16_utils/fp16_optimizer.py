@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
                                  loss_scale_init, loss_scale_update,
                                  scale_loss, unscale_grads)
+from apex_tpu.monitor.metrics import Metrics, metrics_init
 from apex_tpu.utils import global_norm, tree_cast, tree_select
 
 
@@ -40,19 +41,23 @@ class FP16OptState(NamedTuple):
 
     The reference's ``state_dict`` saves exactly this set
     (`fp16_optimizer.py:209-270`): scaler state, overflow flag, inner
-    optimizer state, and the fp32 master groups.
+    optimizer state, and the fp32 master groups. ``metrics`` is the
+    opt-in telemetry pytree (``FP16_Optimizer(..., monitor=True)``;
+    ``None`` adds no leaves, so existing checkpoints round-trip).
     """
     step: jax.Array
     masters: Any                       # fp32 master params
     inner_state: Any                   # wrapped optimizer state
     scaler: Optional[LossScaleState]
+    metrics: Optional[Metrics] = None
 
 
 class FP16_Optimizer:
     def __init__(self, init_optimizer, *, static_loss_scale: float = 1.0,
                  dynamic_loss_scale: bool = False,
                  dynamic_loss_args: Optional[dict] = None,
-                 half_dtype=jnp.float16, verbose: bool = False):
+                 half_dtype=jnp.float16, verbose: bool = False,
+                 monitor: bool = False):
         self.tx = init_optimizer
         self.half_dtype = jnp.dtype(half_dtype)
         if dynamic_loss_scale:
@@ -69,6 +74,7 @@ class FP16_Optimizer:
             self.cfg = LossScaleConfig(init_scale=static_loss_scale,
                                        dynamic=False)
         self.verbose = verbose
+        self.monitor = monitor
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -80,7 +86,8 @@ class FP16_Optimizer:
             step=jnp.int32(0),
             masters=masters,
             inner_state=self.tx.init(masters),
-            scaler=loss_scale_init(self.cfg))
+            scaler=loss_scale_init(self.cfg),
+            metrics=metrics_init() if self.monitor else None)
 
     def model_params(self, state: FP16OptState, like=None):
         """Half-precision view of the masters for the forward pass —
@@ -114,8 +121,16 @@ class FP16_Optimizer:
 
         grads, out = jax.grad(scaled, has_aux=True)(state.masters)
         grads, finite = unscale_grads(grads, sstate)
-        new_scaler = loss_scale_update(sstate, finite, self.cfg)
-        return out, grads, finite, state._replace(scaler=new_scaler)
+        if state.metrics is not None:
+            new_scaler, metrics = loss_scale_update(sstate, finite, self.cfg,
+                                                    metrics=state.metrics)
+            loss_val = out[0] if has_aux else out
+            metrics = metrics.record_loss(loss_val)
+        else:
+            new_scaler = loss_scale_update(sstate, finite, self.cfg)
+            metrics = None
+        return out, grads, finite, state._replace(scaler=new_scaler,
+                                                  metrics=metrics)
 
     # -- utilities -----------------------------------------------------------
 
@@ -148,8 +163,18 @@ class FP16_Optimizer:
             new_step = state.step + (1 if finite else 0)
         else:
             new_step = state.step + jnp.where(finite, 1, 0).astype(jnp.int32)
+        metrics = state.metrics
+        if metrics is not None:
+            # telemetry counters advance even on the skipped branch —
+            # kept outside the tree_select commit above; the grad-norm
+            # gauge holds its last finite value across overflows
+            fin = jnp.asarray(finite, jnp.bool_)
+            metrics = metrics.count_step(finite).record_norms(
+                grad_norm=jnp.where(fin, global_norm(master_grads),
+                                    metrics.grad_norm),
+                param_norm=global_norm(masters))
         return state._replace(step=new_step, masters=masters,
-                              inner_state=inner)
+                              inner_state=inner, metrics=metrics)
 
     # -- checkpoint parity ---------------------------------------------------
 
@@ -172,7 +197,7 @@ class FP16_Optimizer:
             scaler = LossScaleState(
                 loss_scale=jnp.float32(sd["loss_scaler"]["loss_scale"]),
                 growth_tracker=jnp.int32(sd["loss_scaler"]["unskipped"]))
-        return FP16OptState(
+        return state._replace(
             step=jnp.int32(sd.get("step", state.step)),
             masters=sd["fp32_from_fp16"],
             inner_state=sd["optimizer_state_dict"],

@@ -1,0 +1,33 @@
+"""apex_tpu.monitor — runtime training-health telemetry.
+
+Two halves (see docs/monitoring.md):
+
+- **in-graph** (:mod:`~apex_tpu.monitor.metrics`): a :class:`Metrics`
+  pytree of on-device counters/gauges (loss scale, overflow/skip/growth/
+  backoff counts, grad & param norms) threaded through the jitted train
+  step with zero extra dispatches — ``amp.Amp(..., monitor=True)`` and
+  ``FP16_Optimizer(..., monitor=True)`` maintain it automatically;
+- **host-side** (:mod:`~apex_tpu.monitor.logger` /
+  :mod:`~apex_tpu.monitor.sinks`): :class:`MetricsLogger` with pluggable
+  sinks (stdout table / JSONL / CSV), a rolling step-time + throughput +
+  MFU estimator reusing :mod:`apex_tpu.prof`, and amortized device→host
+  flushes; :mod:`~apex_tpu.monitor.collectives` accounts per-step
+  collective bytes from the compiled HLO.
+"""
+
+from apex_tpu.monitor.check import module_count_and_host_ops
+from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
+                                          collective_bytes,
+                                          collective_bytes_from_text)
+from apex_tpu.monitor.logger import MetricsLogger
+from apex_tpu.monitor.metrics import (METRIC_FIELDS, Metrics, metrics_init,
+                                      metrics_to_dict)
+from apex_tpu.monitor.sinks import CSVSink, JSONLSink, Sink, StdoutSink
+
+__all__ = [
+    "Metrics", "metrics_init", "metrics_to_dict", "METRIC_FIELDS",
+    "MetricsLogger",
+    "Sink", "StdoutSink", "JSONLSink", "CSVSink",
+    "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
+    "module_count_and_host_ops",
+]

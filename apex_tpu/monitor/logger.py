@@ -1,0 +1,165 @@
+"""The host half of the telemetry subsystem: buffered fetch + sinks.
+
+``MetricsLogger`` receives the on-device :class:`~apex_tpu.monitor.Metrics`
+snapshot returned by each step and *buffers the device arrays* — nothing
+is fetched until ``flush()`` (every ``flush_every`` records, or at
+``close()``), so the device→host transfer amortizes over N steps and the
+steady-state step loop never blocks on telemetry. With jax's async
+dispatch the ``record()`` call itself costs a list append and a clock
+read.
+
+On top of the in-graph counters the logger derives host-side health:
+
+- rolling **step time** (wall clock between ``record()`` calls) and
+  **throughput** over a sliding window;
+- **MFU**, when the per-step model FLOPs are known — call ``attach()``
+  with the jitted step and example args and they are taken from XLA's
+  cost analysis (reusing :mod:`apex_tpu.prof.hlo`), the peak from
+  :func:`apex_tpu.prof.device_peak_flops` (unknown chips report
+  ``mfu=None``, never a misleading 0 — same contract as
+  ``StepReport.table``);
+- **collective bytes per step** from the compiled HLO (see
+  :mod:`apex_tpu.monitor.collectives`).
+
+Typical wiring::
+
+    logger = monitor.MetricsLogger(
+        sinks=[monitor.StdoutSink(), monitor.JSONLSink("metrics.jsonl")],
+        flush_every=10)
+    logger.attach(train_step, state, batch)     # statics: flops, coll bytes
+    for batch in data:
+        state, loss = train_step(state, batch)  # state carries .metrics
+        logger.record(state.metrics)
+    logger.close()
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from apex_tpu.monitor.metrics import Metrics, metrics_to_dict
+from apex_tpu.monitor.sinks import Sink, StdoutSink
+
+__all__ = ["MetricsLogger"]
+
+
+class MetricsLogger:
+    def __init__(self, sinks: Optional[Sequence[Sink]] = None, *,
+                 flush_every: int = 10, window: int = 50,
+                 peak_flops: Optional[float] = None,
+                 flops_per_step: Optional[float] = None,
+                 collective_bytes_per_step: Optional[int] = None):
+        self.sinks: List[Sink] = (list(sinks) if sinks is not None
+                                  else [StdoutSink()])
+        self.flush_every = max(int(flush_every), 1)
+        self.flops_per_step = flops_per_step
+        self.collective_bytes_per_step = collective_bytes_per_step
+        if peak_flops is None:
+            from apex_tpu.prof.report import device_peak_flops
+            peak_flops = device_peak_flops() or None
+        self.peak_flops = peak_flops
+        # buffered device snapshots + their host receipt times
+        self._buf: List[Metrics] = []
+        self._times: List[float] = []
+        self._last_time: Optional[float] = None
+        # sliding (time) window for throughput; bounded deque
+        self._window = collections.deque(maxlen=max(int(window), 2))
+        self._closed = False
+
+    # -- compile-time statics ------------------------------------------------
+
+    def attach(self, step_fn, *args, **kwargs) -> "MetricsLogger":
+        """Derive per-step statics from the compiled step: model FLOPs
+        (XLA cost analysis) and collective traffic (optimized HLO), from
+        ONE AOT compile of ``step_fn`` — an upfront cost paid once at
+        setup, never per step. Statics the caller already set explicitly
+        (constructor kwargs) are kept, and nothing compiles when both
+        are preset."""
+        from apex_tpu.monitor.collectives import collective_bytes_from_text
+        from apex_tpu.prof import hlo as _hlo
+        if (self.flops_per_step is not None
+                and self.collective_bytes_per_step is not None):
+            return self
+        compiled = _hlo._compile(step_fn, *args, **kwargs)
+        if self.flops_per_step is None:
+            flops = float(_hlo.cost_analysis_of(compiled).get("flops", 0.0))
+            self.flops_per_step = flops if flops > 0 else None
+        if self.collective_bytes_per_step is None:
+            self.collective_bytes_per_step = collective_bytes_from_text(
+                compiled.as_text()).get("total", 0)
+        return self
+
+    # -- per-step path (cheap, never syncs) ----------------------------------
+
+    def record(self, metrics: Metrics, **extra) -> None:
+        """Buffer one device snapshot. ``extra`` keys (host scalars only)
+        are merged into the emitted record at flush."""
+        now = time.perf_counter()
+        self._buf.append((metrics, dict(extra)) if extra else (metrics, None))
+        self._times.append(now)
+        self._window.append(now)
+        if len(self._buf) >= self.flush_every:
+            self.flush()
+
+    # -- amortized fetch + emit ----------------------------------------------
+
+    def _throughput(self) -> Optional[float]:
+        if len(self._window) < 2:
+            return None
+        dt = self._window[-1] - self._window[0]
+        if dt <= 0:
+            return None
+        return (len(self._window) - 1) / dt
+
+    def flush(self) -> None:
+        """One device→host fetch for every buffered snapshot, then emit."""
+        if not self._buf:
+            return
+        buf, times = self._buf, self._times
+        self._buf, self._times = [], []
+        host = jax.device_get([m for m, _ in buf])
+        thru = self._throughput()
+        for (_, extra), m, t in zip(buf, host, times):
+            rec: Dict = metrics_to_dict(m)
+            if self._last_time is None:
+                rec["step_time_ms"] = None
+            else:
+                rec["step_time_ms"] = (t - self._last_time) * 1e3
+            self._last_time = t
+            rec["throughput_steps_per_s"] = thru
+            if thru and self.flops_per_step and self.peak_flops:
+                rec["mfu"] = self.flops_per_step * thru / self.peak_flops
+            else:
+                rec["mfu"] = None
+            rec["collective_bytes"] = self.collective_bytes_per_step
+            rec["wall_time"] = time.time()
+            if extra:
+                rec.update(extra)
+            # non-finite gauges (diverged loss, ...) become null on the
+            # wire: Infinity/NaN are not valid strict JSON, and the
+            # schema contract is finite-or-null (the *event* is already
+            # counted in overflow_count)
+            for k, v in rec.items():
+                if isinstance(v, float) and not math.isfinite(v):
+                    rec[k] = None
+            for sink in self.sinks:
+                sink.emit(rec)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self.flush()
+        for sink in self.sinks:
+            sink.close()
+        self._closed = True
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,96 @@
+"""The in-graph half of the telemetry subsystem: a ``Metrics`` pytree.
+
+The reference surfaces training health as opaque prints from the loss
+scaler ("Gradient overflow.  Skipping step", `apex/amp/scaler.py:201-211`)
+and post-hoc pyprof traces; a live run is a black box. Here the health
+counters are a small pytree of on-device scalars threaded through the
+jitted train step exactly like the loss-scaler state itself: updates are
+pure ``jnp`` arithmetic riding along as an extra step output, so
+monitoring adds **zero extra dispatches and no host syncs** — the host
+only ever sees the values when :class:`apex_tpu.monitor.MetricsLogger`
+flushes, amortized over N steps.
+
+Design rules:
+
+- every field is a scalar ``jax.Array`` (counters i32, gauges f32) — the
+  tree is checkpointable, donate-able, and ``lax.scan``-carryable;
+- ``step`` counts *attempted* optimizer steps (skipped ones included) so
+  a logged stream is strictly monotonic — the committed-step count lives
+  on the train state as before;
+- cumulative counters (overflow/skip/growth/backoff) never reset; rates
+  are a host-side subtraction.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Metrics", "metrics_init", "metrics_to_dict", "METRIC_FIELDS"]
+
+
+class Metrics(NamedTuple):
+    """Training-health counters/gauges — a pure pytree of device scalars."""
+
+    step: jax.Array            # i32: attempted optimizer steps (monotonic)
+    loss: jax.Array            # f32: last (unscaled) loss value
+    loss_scale: jax.Array      # f32: current loss scale (1.0 when unscaled)
+    grad_norm: jax.Array       # f32: global L2 norm of the last grads
+    param_norm: jax.Array      # f32: global L2 norm of the params
+    overflow_count: jax.Array  # i32: cumulative non-finite-grad events
+    skip_count: jax.Array      # i32: cumulative skipped optimizer steps
+    growth_count: jax.Array    # i32: cumulative loss-scale growth events
+    backoff_count: jax.Array   # i32: cumulative loss-scale backoff events
+
+    # -- in-graph update helpers (all pure; no host interaction) -------------
+
+    def record_loss(self, loss) -> "Metrics":
+        return self._replace(loss=jnp.asarray(loss, jnp.float32))
+
+    def record_norms(self, grad_norm=None, param_norm=None) -> "Metrics":
+        m = self
+        if grad_norm is not None:
+            m = m._replace(grad_norm=jnp.asarray(grad_norm, jnp.float32))
+        if param_norm is not None:
+            m = m._replace(param_norm=jnp.asarray(param_norm, jnp.float32))
+        return m
+
+    def count_step(self, grads_finite) -> "Metrics":
+        """Advance the attempt counter; count a skip when not finite."""
+        fin = jnp.asarray(grads_finite, jnp.bool_)
+        skipped = jnp.logical_not(fin).astype(jnp.int32)
+        return self._replace(step=self.step + 1,
+                             skip_count=self.skip_count + skipped)
+
+
+METRIC_FIELDS = Metrics._fields
+
+
+def metrics_init() -> Metrics:
+    """Zeroed metrics — thread through the step like any other state."""
+    return Metrics(
+        step=jnp.int32(0),
+        loss=jnp.float32(0.0),
+        loss_scale=jnp.float32(1.0),
+        grad_norm=jnp.float32(0.0),
+        param_norm=jnp.float32(0.0),
+        overflow_count=jnp.int32(0),
+        skip_count=jnp.int32(0),
+        growth_count=jnp.int32(0),
+        backoff_count=jnp.int32(0),
+    )
+
+
+def metrics_to_dict(m: Metrics) -> dict:
+    """Host-native dict of one (already fetched) metrics snapshot.
+
+    Works on host values only — no jnp calls, so a flush that already
+    did its one bulk ``device_get`` never touches the device again."""
+    import numpy as np
+    out = {}
+    for name, v in zip(Metrics._fields, m):
+        out[name] = (int(v) if np.issubdtype(np.asarray(v).dtype, np.integer)
+                     else float(v))
+    return out

@@ -1,0 +1,120 @@
+"""Pluggable host-side metric sinks: stdout table / JSONL / CSV.
+
+A sink consumes fully materialized host records (plain dicts of python
+numbers, already fetched from device by the logger's flush) — sinks never
+touch jax arrays, so adding one can never add a device sync.
+
+The JSONL wire format is the contract validated by
+``scripts/check_metrics_schema.py``; keep the two in lockstep.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+import sys
+from typing import Dict, List, Optional, TextIO
+
+__all__ = ["Sink", "StdoutSink", "JSONLSink", "CSVSink"]
+
+
+class Sink:
+    """Interface: ``emit`` one record dict per step, ``close`` at teardown."""
+
+    def emit(self, record: Dict) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _fmt(v, width=9):
+    if v is None:
+        return "n/a".rjust(width)
+    if isinstance(v, float):
+        if v == 0 or 1e-3 <= abs(v) < 1e5:
+            return f"{v:.4g}".rjust(width)
+        return f"{v:.2e}".rjust(width)
+    return str(v).rjust(width)
+
+
+class StdoutSink(Sink):
+    """Aligned table line per step, header re-printed every ``header_every``."""
+
+    _COLS = ("step", "loss", "loss_scale", "grad_norm", "skip_count",
+             "step_time_ms", "throughput_steps_per_s", "mfu")
+    _HEADS = ("step", "loss", "scale", "gnorm", "skip", "ms/step",
+              "steps/s", "mfu")
+
+    def __init__(self, stream: Optional[TextIO] = None,
+                 header_every: int = 20):
+        self.stream = stream or sys.stdout
+        self.header_every = header_every
+        self._n = 0
+
+    def emit(self, record: Dict) -> None:
+        if self._n % self.header_every == 0:
+            self.stream.write(
+                " ".join(h.rjust(9) for h in self._HEADS) + "\n")
+        vals = []
+        for c in self._COLS:
+            v = record.get(c)
+            if c == "mfu" and isinstance(v, float):
+                v = f"{v:.1%}"
+                vals.append(v.rjust(9))
+                continue
+            vals.append(_fmt(v))
+        self.stream.write(" ".join(vals) + "\n")
+        self.stream.flush()
+        self._n += 1
+
+
+class JSONLSink(Sink):
+    """One JSON object per line — the machine-readable stream
+    (``scripts/check_metrics_schema.py`` validates it)."""
+
+    def __init__(self, path_or_stream):
+        if isinstance(path_or_stream, (str, os.PathLike)):
+            self.stream: TextIO = open(path_or_stream, "w")
+            self._owns = True
+        else:
+            self.stream = path_or_stream
+            self._owns = False
+
+    def emit(self, record: Dict) -> None:
+        self.stream.write(json.dumps(record) + "\n")
+        self.stream.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self.stream.close()
+
+
+class CSVSink(Sink):
+    """CSV with a header derived from the first record's keys; later
+    records are projected onto those columns (missing → empty)."""
+
+    def __init__(self, path_or_stream):
+        if isinstance(path_or_stream, (str, os.PathLike)):
+            self.stream: TextIO = open(path_or_stream, "w", newline="")
+            self._owns = True
+        else:
+            self.stream = path_or_stream
+            self._owns = False
+        self._writer: Optional[csv.DictWriter] = None
+        self._fields: List[str] = []
+
+    def emit(self, record: Dict) -> None:
+        if self._writer is None:
+            self._fields = list(record.keys())
+            self._writer = csv.DictWriter(self.stream, self._fields,
+                                          extrasaction="ignore")
+            self._writer.writeheader()
+        self._writer.writerow({k: record.get(k, "") for k in self._fields})
+        self.stream.flush()
+
+    def close(self) -> None:
+        if self._owns:
+            self.stream.close()

@@ -766,6 +766,45 @@ def _():
             os.environ["APEX_TPU_BN_PALLAS_BWD"] = old
 
 
+# --- monitor: zero-dispatch telemetry contract -------------------------------
+
+@case("monitor/no-extra-dispatch")
+def _():
+    """The in-graph Metrics pytree must ride the existing step program:
+    monitored and unmonitored toy train steps compile to the same number
+    of HLO modules (one executable each), and the monitored module
+    contains no host traffic (outfeed/infeed/host callbacks) — telemetry
+    leaves the device only when the host logger flushes."""
+    from apex_tpu import amp
+    from apex_tpu.monitor.check import module_count_and_host_ops
+    from apex_tpu.optim import FusedSGD
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def build(monitored):
+        amp_opt, state = amp.initialize(
+            params, FusedSGD(lr=0.1), "O2", half_dtype=jnp.float16,
+            verbosity=0, monitor=monitored)
+
+        def train_step(state, x, y):
+            def loss_fn(p):
+                return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+            state, loss, _ = amp_opt.step(state, loss_fn)
+            return state, loss
+
+        return jax.jit(train_step), state
+
+    mon_step, mon_state = build(True)
+    plain_step, plain_state = build(False)
+    n_mon, host_mon = module_count_and_host_ops(mon_step, mon_state, x, y)
+    n_plain, _ = module_count_and_host_ops(plain_step, plain_state, x, y)
+    assert n_mon == n_plain, (n_mon, n_plain)
+    assert not host_mon, f"monitored step compiled host traffic: {host_mon}"
+
+
 # --- driver ------------------------------------------------------------------
 
 def run(pattern: Optional[str] = None,

@@ -339,6 +339,23 @@ class DistributedDataParallel:
         return {"xla_gpu_all_reduce_combine_threshold_bytes":
                 str(int(self.message_size) * itemsize)}
 
+    # -- telemetry -----------------------------------------------------------
+
+    def collective_bytes(self, step_fn: Callable, *args, **kwargs) -> dict:
+        """Static per-step collective traffic of a (wrapped) step, by
+        opcode, from the compiled HLO — ``{"all-reduce": bytes, ...,
+        "total": bytes}``.
+
+        The accounting the reference could only approximate from its own
+        bucket bookkeeping (`apex/parallel/distributed.py:425-475`); here
+        the compiled program is the ground truth. Compile-time constant:
+        feed it to ``MetricsLogger(collective_bytes_per_step=...)`` (or
+        let ``MetricsLogger.attach`` derive it) so every logged record
+        carries the step's communication volume.
+        """
+        from apex_tpu.monitor.collectives import collective_bytes as _cb
+        return _cb(step_fn, *args, **kwargs)
+
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Wrap ``grad_fn(*a, **k) -> (value, grads)`` so grads come back
         synced — the "model wrapper" usage of the reference where backward

@@ -40,6 +40,16 @@ def compiled_hlo(fn, *args, **kwargs) -> str:
     return _compile(fn, *args, **kwargs).as_text()
 
 
+def cost_analysis_of(compiled) -> Dict:
+    """Raw cost-analysis dict of an already-compiled executable,
+    normalized across jax versions (older jax returns one dict per
+    device as a list)."""
+    ca = compiled.cost_analysis() or {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return ca
+
+
 def cost_analysis(fn, *args, **kwargs) -> Dict[str, float]:
     """XLA's own executable cost analysis, normalized.
 
@@ -47,7 +57,7 @@ def cost_analysis(fn, *args, **kwargs) -> Dict[str, float]:
     0.0). ``fn`` may be a plain callable (jitted here), a jitted fn, or an
     already-lowered/compiled object's owner.
     """
-    ca = _compile(fn, *args, **kwargs).cost_analysis() or {}
+    ca = cost_analysis_of(_compile(fn, *args, **kwargs))
     return {
         "flops": float(ca.get("flops", 0.0)),
         "bytes_accessed": float(ca.get("bytes accessed", 0.0)),
@@ -143,9 +153,16 @@ def op_estimates(fn, *args, top: Optional[int] = None,
             continue
         name = m.group("n").lstrip("%")
         shapes[name] = m.group("shape")
-        parsed.append((name, m.group("shape"), m.group("op"),
-                       [a.strip().split()[-1].lstrip("%")
-                        for a in m.group("args").split(",") if a.strip()],
+        args_text = m.group("args")
+        if "%" in args_text:
+            # older printers inline operand types ("f32[32,64]{1,0} %x"),
+            # whose commas break naive splitting — take the %-prefixed
+            # names directly
+            operands = re.findall(r"%([^\s,)]+)", args_text)
+        else:
+            operands = [a.strip().split()[-1]
+                        for a in args_text.split(",") if a.strip()]
+        parsed.append((name, m.group("shape"), m.group("op"), operands,
                        line))
 
     out: List[OpEstimate] = []

@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import os
+import shutil
 import tempfile
 import time
 from typing import Dict, List, Optional
@@ -83,18 +84,23 @@ class StepReport:
         return self.profile.by_category()
 
     def table(self, top: int = 20) -> str:
+        # unknown device kind (CPU, new chips): mfu() computes 0.0 only
+        # because the peak is unknown — print n/a, not a misleading 0%
+        mfu_s = f"{self.mfu():.1%}" if device_peak_flops() else "n/a"
         head = (f"device={self.profile.device or '(none)'} "
                 f"iters={self.iters} wall/iter={self.wall_us:.0f}us "
                 f"device/iter={self.device_us:.0f}us "
                 f"flops={self.cost['flops']:.3g} "
-                f"bytes={self.cost['bytes_accessed']:.3g}")
+                f"bytes={self.cost['bytes_accessed']:.3g} "
+                f"mfu={mfu_s}")
         cats = "  ".join(f"{k}={v:.0f}us" for k, v in
                          list(self.by_category().items())[:8])
         return "\n".join([head, cats, self.profile.table(top=top)])
 
 
 def profile_step(fn, *args, iters: int = 5, warmup: int = 2,
-                 logdir: Optional[str] = None, **kwargs) -> StepReport:
+                 logdir: Optional[str] = None, keep_trace: bool = False,
+                 **kwargs) -> StepReport:
     """Profile a jittable step function end to end.
 
     Jits (if needed), warms up ``warmup`` calls, then runs ``iters``
@@ -102,8 +108,15 @@ def profile_step(fn, *args, iters: int = 5, warmup: int = 2,
     per-op records. Works with functions returning pytrees; results are
     synced via host fetch of one leaf (block_until_ready is unreliable on
     the experimental axon platform — see bench.py).
+
+    When no ``logdir`` is given a temp dir holds the trace and is
+    **removed after parsing** (every record the report needs is already
+    in the returned ``StepReport``); pass ``keep_trace=True`` to keep it
+    for offline tools (tensorboard, ``python -m apex_tpu.prof``). An
+    explicit ``logdir`` is always the caller's to clean up.
     """
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    own_tmpdir = logdir is None
     logdir = logdir or tempfile.mkdtemp(prefix="apex_tpu_prof_")
 
     def _sync(out):
@@ -112,18 +125,23 @@ def profile_step(fn, *args, iters: int = 5, warmup: int = 2,
             import numpy as np
             np.asarray(jax.device_get(leaves[0]))
 
-    for _ in range(max(warmup, 1)):
-        out = jitted(*args, **kwargs)
-    _sync(out)
-
-    t0 = time.perf_counter()
-    with trace(logdir):
-        for _ in range(iters):
+    try:
+        for _ in range(max(warmup, 1)):
             out = jitted(*args, **kwargs)
         _sync(out)
-    wall = (time.perf_counter() - t0) / iters
 
-    cost = _hlo.cost_analysis(jitted, *args, **kwargs)
-    prof = _xplane.parse_trace(logdir)
+        t0 = time.perf_counter()
+        with trace(logdir):
+            for _ in range(iters):
+                out = jitted(*args, **kwargs)
+            _sync(out)
+        wall = (time.perf_counter() - t0) / iters
+
+        cost = _hlo.cost_analysis(jitted, *args, **kwargs)
+        prof = _xplane.parse_trace(logdir)
+    finally:
+        if own_tmpdir and not keep_trace:
+            shutil.rmtree(logdir, ignore_errors=True)
+            logdir = ""
     return StepReport(profile=prof, cost=cost, wall_us=wall * 1e6,
                       iters=iters, logdir=logdir)

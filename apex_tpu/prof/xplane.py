@@ -21,7 +21,8 @@ import os
 import re
 from typing import Dict, List, Optional
 
-__all__ = ["OpRecord", "TraceProfile", "parse_trace", "latest_xplane"]
+__all__ = ["OpRecord", "TraceProfile", "parse_trace", "latest_xplane",
+           "COLLECTIVE_PREFIXES"]
 
 # HLO instruction text → opcode: "%fusion.3 = f32[8]{0} fusion(...)" → the
 # word after the result shape. Shapes may be tuples "(f32[...], u32[])"
@@ -31,20 +32,33 @@ _OPCODE_RE = re.compile(
     r"^%?(?P<name>[^ ]+) = (?:\((?:[^()]|\([^()]*\))*\)|[^ ]+) "
     r"(?P<opcode>[\w-]+)\(")
 
+# The one canonical list of collective opcode prefixes — longest-prefix
+# entries first so e.g. ragged-all-to-all is not folded into all-to-all.
+# apex_tpu.monitor.collectives buckets traffic by the same tuple; keep
+# trace categorization and live accounting in lockstep here.
+COLLECTIVE_PREFIXES = (
+    "ragged-all-to-all",
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+    "collective-broadcast",
+)
+
 _CATEGORIES = (
     ("convolution", "conv"),
     ("dot", "gemm"),
-    ("all-reduce", "collective"),
-    ("all-gather", "collective"),
-    ("reduce-scatter", "collective"),
-    ("all-to-all", "collective"),
-    ("collective-permute", "collective"),
+) + tuple((p, "collective") for p in COLLECTIVE_PREFIXES) + (
     ("copy", "copy"),
     ("fusion", "fusion"),
     ("custom-call", "custom-call"),
     ("scatter", "scatter"),
     ("reduce", "reduction"),
     ("sort", "sort"),
+    ("dynamic-update-slice", "slice"),     # before dynamic-slice would
+    ("dynamic-slice", "slice"),            # NOT prefix-match it, but keep
+    ("while", "control-flow"),             # the specific one first anyway
 )
 
 

@@ -6,7 +6,9 @@ accelerator is present. Prints ONE JSON line.
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
-and writes BENCH_TABLE.md.
+and writes BENCH_TABLE.md. ``python bench.py --monitor`` drives the
+headline step with live apex_tpu.monitor telemetry (stdout table +
+MONITOR.jsonl).
 
 See PERF.md for the profiling breakdown behind the current number
 (captured with apex_tpu.prof).
@@ -84,7 +86,8 @@ def _scan_device_time(step, carry, const, *, n_carry, ks=_SCAN_KS,
     return max(device_dt, 1e-9), wall_single, last
 
 
-def _resnet_step_builder(batch: int, size: int, opt_level: str = "O2"):
+def _resnet_step_builder(batch: int, size: int, opt_level: str = "O2",
+                         monitor: bool = False):
     from apex_tpu import amp, models, ops
     from apex_tpu.optim import FusedSGD
 
@@ -103,7 +106,8 @@ def _resnet_step_builder(batch: int, size: int, opt_level: str = "O2"):
     variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
-    amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9))
+    amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9),
+                      monitor=monitor)
     state = amp_opt.init(params)
 
     def step(state, batch_stats, xb, yb):
@@ -451,6 +455,32 @@ def run_all():
     print("\n".join(lines))
 
 
+def run_monitor(steps: int = 20, jsonl_path: str = "MONITOR.jsonl"):
+    """`python bench.py --monitor`: drive the headline ResNet step with
+    live telemetry — the apex_tpu.monitor consumer demo. Emits the
+    stdout health table plus a JSONL stream (MONITOR.jsonl) that
+    `scripts/check_metrics_schema.py` validates; flushes amortize the
+    device→host fetch over 5-step windows, so the loop itself keeps the
+    zero-extra-dispatch property of the unmonitored bench."""
+    from apex_tpu import monitor
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch, size = (128, 224) if on_tpu else (8, 64)
+    step, (state, batch_stats), (x, y) = _resnet_step_builder(
+        batch, size, monitor=True)
+    jstep = jax.jit(step)
+    logger = monitor.MetricsLogger(
+        sinks=[monitor.StdoutSink(), monitor.JSONLSink(jsonl_path)],
+        flush_every=5)
+    logger.attach(jstep, state, batch_stats, x, y)
+    for _ in range(steps):
+        state, batch_stats, _loss = jstep(state, batch_stats, x, y)
+        logger.record(state.metrics, images_per_step=batch)
+    logger.close()
+    print(f"wrote {jsonl_path} "
+          f"(validate: python scripts/check_metrics_schema.py {jsonl_path})")
+
+
 def main():
     from apex_tpu import models, prof
 
@@ -509,5 +539,7 @@ def main():
 if __name__ == "__main__":
     if "--all" in sys.argv:
         run_all()
+    elif "--monitor" in sys.argv:
+        run_monitor()
     else:
         main()

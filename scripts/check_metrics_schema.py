@@ -1,0 +1,113 @@
+#!/usr/bin/env python
+"""Validate a metrics JSONL stream emitted by apex_tpu.monitor.JSONLSink.
+
+The wire-format contract (keep in lockstep with
+``apex_tpu/monitor/sinks.py`` / ``logger.py``):
+
+- every line is a standalone JSON object;
+- the REQUIRED keys are present on every line;
+- ``step`` is a strictly increasing integer (the in-graph counter
+  counts *attempted* steps, so the stream is monotonic even across
+  overflow-skipped updates);
+- counters are non-negative integers;
+- every numeric value is finite — Infinity/NaN never reach the wire
+  (the logger nulls non-finite gauges); ``null`` is allowed only for
+  the NULLABLE gauges (first-record step time, unknown-chip MFU, ...).
+
+Pure stdlib on purpose: CI and log-shipping hosts can run it without
+jax. Exit status 0 = valid, 1 = violations (printed one per line),
+2 = usage/IO error.
+
+Usage: python scripts/check_metrics_schema.py METRICS.jsonl
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import List
+
+REQUIRED = (
+    "step", "loss", "loss_scale", "grad_norm", "param_norm",
+    "overflow_count", "skip_count", "growth_count", "backoff_count",
+    "step_time_ms", "throughput_steps_per_s", "mfu",
+)
+COUNTERS = ("step", "overflow_count", "skip_count", "growth_count",
+            "backoff_count")
+NULLABLE = ("step_time_ms", "throughput_steps_per_s", "mfu",
+            "collective_bytes", "loss", "grad_norm", "param_norm")
+
+
+def check_lines(lines) -> List[str]:
+    """All schema violations in an iterable of JSONL lines (empty = ok)."""
+    errors: List[str] = []
+    prev_step = None
+    n_records = 0
+    for i, raw in enumerate(lines, start=1):
+        line = raw.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError as e:
+            errors.append(f"line {i}: not valid JSON ({e})")
+            continue
+        if not isinstance(rec, dict):
+            errors.append(f"line {i}: not a JSON object")
+            continue
+        n_records += 1
+        for key in REQUIRED:
+            if key not in rec:
+                errors.append(f"line {i}: missing required key {key!r}")
+        for key, v in rec.items():
+            if v is None:
+                if key not in NULLABLE:
+                    errors.append(f"line {i}: {key!r} is null "
+                                  f"(only {NULLABLE} may be)")
+                continue
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            if not math.isfinite(v):
+                errors.append(f"line {i}: {key!r} is non-finite ({v!r})")
+        for key in COUNTERS:
+            v = rec.get(key)
+            if v is None or key not in rec:
+                continue
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"line {i}: counter {key!r} must be a "
+                              f"non-negative int, got {v!r}")
+        step = rec.get("step")
+        if isinstance(step, int) and not isinstance(step, bool):
+            if prev_step is not None and step <= prev_step:
+                errors.append(f"line {i}: step {step} not greater than "
+                              f"previous step {prev_step}")
+            prev_step = step
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 2
+    try:
+        with open(argv[0]) as f:
+            errors = check_lines(f)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for e in errors:
+        print(e, file=sys.stderr)
+    if errors:
+        print(f"{argv[0]}: INVALID ({len(errors)} violations)",
+              file=sys.stderr)
+        return 1
+    print(f"{argv[0]}: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -13,8 +13,10 @@ environment variables is not enough — we override the config directly.
 import jax
 import pytest
 
+from apex_tpu import _compat  # noqa: F401  (jax API shims for older releases)
+
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+_compat.request_cpu_devices(8)
 # Tests compare against fp32 references; keep matmuls at full fp32 precision.
 jax.config.update("jax_default_matmul_precision", "highest")
 

@@ -269,8 +269,8 @@ class TestOverflowInjection:
             state, bs = jstep(state, bs, xs[i], ys[i])
         # round-trip through host numpy (what a checkpointer does)
         saved = jax.tree_util.tree_map(
-            lambda x: jnp.asarray(np.asarray(x)), (state, bs),
-            is_leaf=lambda x: x is None)
+            lambda x: None if x is None else jnp.asarray(np.asarray(x)),
+            (state, bs), is_leaf=lambda x: x is None)
         restored_state, restored_bs = saved
         for i in range(3, STEPS):
             state, bs = jstep(state, bs, xs[i], ys[i])

@@ -21,8 +21,9 @@ import pytest
 _CHILD = textwrap.dedent("""
     import os, sys
     import jax
+    from apex_tpu import _compat
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    _compat.request_cpu_devices(2)
 
     from apex_tpu.parallel.launch import distributed_init
 
@@ -122,7 +123,8 @@ def test_two_process_ddp_step(tmp_path):
                 and "Mismatch" not in joined
                 and any(s in joined for s in
                         ("UNAVAILABLE", "DEADLINE_EXCEEDED",
-                         "Permission denied", "unreachable"))):
+                         "Permission denied", "unreachable",
+                         "aren't implemented on the CPU backend"))):
             pytest.skip(f"cluster bring-up unsupported here:\n{joined}")
         pytest.fail(f"child exit codes {codes}:\n{joined}")
     assert all("OK rank=" in o for o in outs), joined
