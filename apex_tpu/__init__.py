@@ -19,6 +19,9 @@ A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
 - ``apex_tpu.monitor``   — runtime telemetry: in-graph training-health
                            counters + host-side metrics pipeline (sinks,
                            step-time/MFU, collective-bytes accounting).
+- ``apex_tpu.trace``     — distributed tracing + flight recorder: span-level
+                           step timelines (Chrome-trace/Perfetto export),
+                           crash dumps, hang watchdog, NaN provenance.
 
 Unlike the reference (an interception-based library over an eager framework),
 apex_tpu expresses the same capabilities as *policies, functional transforms and
@@ -40,7 +43,8 @@ from apex_tpu import optim
 from apex_tpu import parallel
 from apex_tpu import prof
 from apex_tpu import reparam
+from apex_tpu import trace
 from apex_tpu import utils
 
 __all__ = ["amp", "arena", "fp16_utils", "monitor", "ops", "optim",
-           "parallel", "prof", "reparam", "utils", "__version__"]
+           "parallel", "prof", "reparam", "trace", "utils", "__version__"]

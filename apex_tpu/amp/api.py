@@ -46,6 +46,8 @@ from apex_tpu.amp.scaler import (
     scale_loss, unscale_grads,
 )
 from apex_tpu.monitor.metrics import Metrics, metrics_init
+from apex_tpu.trace.debug_nans import nan_probe
+from apex_tpu.trace.spans import span as trace_span
 from apex_tpu.utils import global_norm, tree_all_finite, tree_cast, \
     tree_select
 
@@ -164,14 +166,20 @@ class Amp:
         """
         sstate = state.scalers[loss_id]
 
+        # built-in forensic spans: "amp/fwd" scopes the forward ops in
+        # xplane traces and anchors the NaN-provenance probes; the
+        # probes are identity unless trace.debug_nans is on (the
+        # trace/no-extra-dispatch contract)
         def scaled(p):
             mp = self.policy.cast_params(p)
             with policy_scope(self.policy):
-                out = loss_fn(mp, *args, **kwargs)
-            loss = out[0] if has_aux else out
+                with trace_span("amp/fwd"):
+                    out = loss_fn(mp, *args, **kwargs)
+            loss = nan_probe("amp/fwd", out[0] if has_aux else out)
             return scale_loss(loss, sstate), out
 
         grads, out = jax.grad(scaled, has_aux=True)(state.params)
+        grads = nan_probe("amp/bwd", grads)
         loss_val = out[0] if has_aux else out
         if self.scale_cfg is None:
             grads = tree_cast(grads, jnp.float32)
@@ -185,11 +193,13 @@ class Amp:
                     metrics=state.metrics.record_loss(loss_val)._replace(
                         loss_scale=jnp.float32(1.0)))
             return out, grads, state, finite
-        if stashed is None:
-            acc, this_finite = unscale_grads(grads, sstate)
-        else:
-            acc, this_finite = _scaler.unscale_grads_with_stashed(
-                grads, stashed, sstate)
+        with trace_span("amp/unscale"):
+            if stashed is None:
+                acc, this_finite = unscale_grads(grads, sstate)
+            else:
+                acc, this_finite = _scaler.unscale_grads_with_stashed(
+                    grads, stashed, sstate)
+        acc = nan_probe("amp/unscale", acc)
         if state.metrics is not None:
             new_sstate, metrics = loss_scale_update(
                 sstate, this_finite, self.scale_cfg, metrics=state.metrics)
@@ -219,15 +229,19 @@ class Amp:
         Fused apex_tpu optimizers expose ``step`` (new params directly, one
         arena kernel); optax transforms go through ``update`` + tree add.
         """
-        if hasattr(self.tx, "step") and callable(getattr(self.tx, "step")):
-            new_params, new_opt_state = self.tx.step(
-                grads, state.opt_state, state.params)
-        else:
-            updates, new_opt_state = self.tx.update(
-                grads, state.opt_state, state.params)
-            new_params = jax.tree_util.tree_map(
-                lambda p, u: (p + u.astype(p.dtype)), state.params, updates)
-        committed_params = tree_select(grads_finite, new_params, state.params)
+        with trace_span("amp/update"):
+            if hasattr(self.tx, "step") and callable(
+                    getattr(self.tx, "step")):
+                new_params, new_opt_state = self.tx.step(
+                    grads, state.opt_state, state.params)
+            else:
+                updates, new_opt_state = self.tx.update(
+                    grads, state.opt_state, state.params)
+                new_params = jax.tree_util.tree_map(
+                    lambda p, u: (p + u.astype(p.dtype)), state.params,
+                    updates)
+        committed_params = nan_probe("amp/update", tree_select(
+            grads_finite, new_params, state.params))
         committed_opt = tree_select(grads_finite, new_opt_state,
                                     state.opt_state)
         if isinstance(grads_finite, bool):

@@ -33,6 +33,8 @@ from apex_tpu.amp.scaler import (LossScaleConfig, LossScaleState,
                                  loss_scale_init, loss_scale_update,
                                  scale_loss, unscale_grads)
 from apex_tpu.monitor.metrics import Metrics, metrics_init
+from apex_tpu.trace.debug_nans import nan_probe
+from apex_tpu.trace.spans import span as trace_span
 from apex_tpu.utils import global_norm, tree_cast, tree_select
 
 
@@ -113,14 +115,20 @@ class FP16_Optimizer:
         """
         sstate = state.scaler
 
+        # same forensic spans/probes as amp (see docs/tracing.md):
+        # no-ops unless xplane-traced / trace.debug_nans is enabled
         def scaled(masters):
             mp = tree_cast(masters, self.half_dtype)
-            out = loss_fn(mp, *args, **kwargs)
-            loss = out[0] if has_aux else out
+            with trace_span("fp16/fwd"):
+                out = loss_fn(mp, *args, **kwargs)
+            loss = nan_probe("fp16/fwd", out[0] if has_aux else out)
             return scale_loss(loss, sstate), out
 
         grads, out = jax.grad(scaled, has_aux=True)(state.masters)
-        grads, finite = unscale_grads(grads, sstate)
+        grads = nan_probe("fp16/bwd", grads)
+        with trace_span("fp16/unscale"):
+            grads, finite = unscale_grads(grads, sstate)
+        grads = nan_probe("fp16/unscale", grads)
         if state.metrics is not None:
             new_scaler, metrics = loss_scale_update(sstate, finite, self.cfg,
                                                     metrics=state.metrics)
@@ -149,15 +157,18 @@ class FP16_Optimizer:
     def step(self, state: FP16OptState, master_grads, finite) -> FP16OptState:
         """Inner-optimizer step on the masters, skipped on overflow
         (`fp16_optimizer.py:272-332`: "OVERFLOW! Skipping step")."""
-        if hasattr(self.tx, "step") and callable(self.tx.step):
-            new_masters, new_inner = self.tx.step(
-                master_grads, state.inner_state, state.masters)
-        else:                                     # optax transform
-            updates, new_inner = self.tx.update(
-                master_grads, state.inner_state, state.masters)
-            new_masters = jax.tree_util.tree_map(
-                lambda p, u: p + u.astype(p.dtype), state.masters, updates)
-        masters = tree_select(finite, new_masters, state.masters)
+        with trace_span("fp16/update"):
+            if hasattr(self.tx, "step") and callable(self.tx.step):
+                new_masters, new_inner = self.tx.step(
+                    master_grads, state.inner_state, state.masters)
+            else:                                 # optax transform
+                updates, new_inner = self.tx.update(
+                    master_grads, state.inner_state, state.masters)
+                new_masters = jax.tree_util.tree_map(
+                    lambda p, u: p + u.astype(p.dtype), state.masters,
+                    updates)
+        masters = nan_probe("fp16/update", tree_select(
+            finite, new_masters, state.masters))
         inner = tree_select(finite, new_inner, state.inner_state)
         if isinstance(finite, bool):
             new_step = state.step + (1 if finite else 0)

@@ -35,6 +35,7 @@ Typical wiring::
 
 from __future__ import annotations
 
+import atexit
 import collections
 import math
 import time
@@ -49,16 +50,28 @@ __all__ = ["MetricsLogger"]
 
 
 class MetricsLogger:
+    """See the module docstring. The logger is a context manager and
+    registers itself with ``atexit``, so a crashed run never loses its
+    buffered tail: ``__exit__`` flushes on exceptions too, and an
+    un-``close()``d logger (hard ``sys.exit``, unhandled error above the
+    ``with``) is flushed at interpreter exit. ``trace_sink`` is the
+    trace-event channel — host-side span/step/crash events from
+    :mod:`apex_tpu.trace` pass straight through ``record_event`` to it,
+    never mixing with the metrics wire format.
+    """
+
     def __init__(self, sinks: Optional[Sequence[Sink]] = None, *,
                  flush_every: int = 10, window: int = 50,
                  peak_flops: Optional[float] = None,
                  flops_per_step: Optional[float] = None,
-                 collective_bytes_per_step: Optional[int] = None):
+                 collective_bytes_per_step: Optional[int] = None,
+                 trace_sink: Optional[Sink] = None):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
         self.flush_every = max(int(flush_every), 1)
         self.flops_per_step = flops_per_step
         self.collective_bytes_per_step = collective_bytes_per_step
+        self.trace_sink = trace_sink
         if peak_flops is None:
             from apex_tpu.prof.report import device_peak_flops
             peak_flops = device_peak_flops() or None
@@ -70,6 +83,9 @@ class MetricsLogger:
         # sliding (time) window for throughput; bounded deque
         self._window = collections.deque(maxlen=max(int(window), 2))
         self._closed = False
+        # crash-safe tail: flush whatever is buffered at interpreter
+        # exit if the run never reached close()
+        atexit.register(self._atexit_close)
 
     # -- compile-time statics ------------------------------------------------
 
@@ -150,16 +166,39 @@ class MetricsLogger:
             for sink in self.sinks:
                 sink.emit(rec)
 
+    # -- trace-event channel -------------------------------------------------
+
+    def record_event(self, event: Dict) -> None:
+        """Emit one host-side trace event (``kind="span"|"step"|...``)
+        through the trace-event channel — a plain-dict pass-through, no
+        device access, no buffering (events are rare and forensic;
+        losing them to a crash would defeat the point). Wire a Tracer
+        with ``tracer.subscribe(lambda st: logger.record_event(
+        st.to_event(rank)))`` to stream the step timeline live."""
+        if self.trace_sink is not None and not self._closed:
+            self.trace_sink.emit(dict(event))
+
     def close(self) -> None:
         if self._closed:
             return
         self.flush()
         for sink in self.sinks:
             sink.close()
+        if self.trace_sink is not None:
+            self.trace_sink.close()
         self._closed = True
+        atexit.unregister(self._atexit_close)
+
+    def _atexit_close(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass          # a dead backend at exit must not mask the exit
 
     def __enter__(self) -> "MetricsLogger":
         return self
 
     def __exit__(self, *exc) -> None:
+        # flushes buffered rows on the exception path too — the tail of
+        # a crashed run's metrics reaches the sinks before unwind
         self.close()

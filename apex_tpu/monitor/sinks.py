@@ -73,7 +73,13 @@ class StdoutSink(Sink):
 
 class JSONLSink(Sink):
     """One JSON object per line — the machine-readable stream
-    (``scripts/check_metrics_schema.py`` validates it)."""
+    (``scripts/check_metrics_schema.py`` validates it).
+
+    Doubles as the **trace-event channel** sink: pass one as
+    ``MetricsLogger(trace_sink=...)`` and span/step timeline events from
+    :mod:`apex_tpu.trace` stream to it (validate with
+    ``check_metrics_schema.py --kind trace``).
+    """
 
     def __init__(self, path_or_stream):
         if isinstance(path_or_stream, (str, os.PathLike)):

@@ -805,6 +805,47 @@ def _():
     assert not host_mon, f"monitored step compiled host traffic: {host_mon}"
 
 
+# --- trace: span/probe zero-dispatch contract --------------------------------
+
+@case("trace/no-extra-dispatch")
+def _():
+    """Spans and NaN probes with trace.debug_nans OFF must leave the
+    compiled program identical to an unannotated twin: same HLO module
+    count, no host traffic. With the mode ON the probes must actually
+    appear (host callbacks in the HLO) — proving the guard flips real
+    dispatch structure, not a no-op."""
+    from apex_tpu import trace
+    from apex_tpu.monitor.check import module_count_and_host_ops
+
+    x = _rand((16, 32), 0)
+    w = _rand((32, 8), 1, scale=0.1)
+
+    def plain(w, x):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(h * h)
+
+    def traced(w, x):
+        with trace.span("fwd"):
+            h = jnp.tanh(x @ w)
+        h = trace.nan_probe("fwd", h)
+        with trace.span("loss"):
+            return trace.nan_probe("loss", jnp.sum(h * h))
+
+    n_t, host_t = module_count_and_host_ops(jax.jit(traced), w, x)
+    n_p, _ = module_count_and_host_ops(jax.jit(plain), w, x)
+    assert n_t == n_p, (n_t, n_p)
+    assert not host_t, f"passive spans compiled host traffic: {host_t}"
+
+    with trace.debug_nans():
+        # the flag is trace-time and jax caches traces per function
+        # object — drop the off-mode trace before recompiling
+        jax.clear_caches()
+        _, host_on = module_count_and_host_ops(jax.jit(traced), w, x)
+    assert host_on, "debug_nans probes missing from the compiled HLO"
+    trace.reset_nan_state()
+    jax.clear_caches()
+
+
 # --- driver ------------------------------------------------------------------
 
 def run(pattern: Optional[str] = None,

@@ -19,8 +19,8 @@ from apex_tpu.parallel.distributed import (
 )
 from apex_tpu.parallel.larc import LARC, larc_rewrite_grads
 from apex_tpu.parallel.launch import (
-    distributed_init, is_distributed, process_index, process_count,
-    maybe_print,
+    distributed_init, enable_crash_dumps, is_distributed, process_index,
+    process_count, maybe_print,
 )
 from apex_tpu.parallel.ring import ring_attention, ulysses_attention
 from apex_tpu.parallel.sync_batchnorm import (
@@ -35,8 +35,8 @@ __all__ = [
     "DistributedDataParallel", "Reducer", "sync_gradients",
     "flat_all_reduce", "flat_tree_all_reduce", "replicate",
     "LARC", "larc_rewrite_grads",
-    "distributed_init", "is_distributed", "process_index", "process_count",
-    "maybe_print",
+    "distributed_init", "enable_crash_dumps", "is_distributed",
+    "process_index", "process_count", "maybe_print",
     "ring_attention", "ulysses_attention",
     "SyncBatchNorm", "sync_batch_norm", "sync_moments",
     "syncbn_stats_groups", "convert_sync_batchnorm",

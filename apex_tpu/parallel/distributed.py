@@ -210,16 +210,28 @@ class DistributedDataParallel:
         """Sync a gradient pytree (call inside the wrapped step). Honors
         ``no_sync`` — the `_disable_allreduce` flag
         (`apex/parallel/distributed.py:566-570`) — and ``delay_allreduce``
-        (one flat fused reduce per dtype, the `allreduce_fallback` path)."""
+        (one flat fused reduce per dtype, the `allreduce_fallback` path).
+
+        Runs under a ``kind="collective"`` trace span so the psums are
+        scoped ``ddp/sync_gradients`` in xplane traces and HLO dumps —
+        that attribution is what survives into the compiled program.
+        The span itself executes at trace time (this code runs inside
+        the user's jitted step), so *runtime* in-flight-collective
+        forensics come from host-side collective spans around the
+        blocking point, e.g. ``with trace.span("allreduce-wait",
+        kind="collective"): jax.block_until_ready(grads)`` — see
+        docs/tracing.md."""
         if not self._sync_enabled:
             return grads
+        from apex_tpu.trace.spans import span as _span
         fn = flat_tree_all_reduce if self.delay_allreduce else \
             sync_gradients
-        return fn(
-            grads, self.axis_name,
-            gradient_average=self.gradient_average,
-            gradient_predivide_factor=self.gradient_predivide_factor,
-            allreduce_always_fp32=self.allreduce_always_fp32)
+        with _span("ddp/sync_gradients", kind="collective"):
+            return fn(
+                grads, self.axis_name,
+                gradient_average=self.gradient_average,
+                gradient_predivide_factor=self.gradient_predivide_factor,
+                allreduce_always_fp32=self.allreduce_always_fp32)
 
     def no_sync(self):
         """Context manager: steps wrapped while active skip gradient

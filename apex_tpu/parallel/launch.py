@@ -21,7 +21,7 @@ from typing import Optional
 import jax
 
 __all__ = ["distributed_init", "is_distributed", "process_index",
-           "process_count", "maybe_print"]
+           "process_count", "maybe_print", "enable_crash_dumps"]
 
 _initialized = False
 
@@ -62,6 +62,34 @@ def distributed_init(coordinator_address: Optional[str] = None,
         process_id=process_id,
         local_device_ids=local_device_ids)
     _initialized = True
+
+
+def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
+                       capacity: int = 64,
+                       hang_deadline_s: Optional[float] = None):
+    """One-call forensics bring-up for (multi-host) launches.
+
+    Builds a :class:`apex_tpu.trace.Tracer`, a per-rank
+    :class:`~apex_tpu.trace.FlightRecorder` (``path`` gets
+    ``trace.rank_path`` applied on multi-process runs, so every rank of
+    a pod dumps to its own file) with the excepthook/SIGTERM/atexit
+    handlers installed, and — when ``hang_deadline_s`` is set — a
+    started :class:`~apex_tpu.trace.HangWatchdog`. Call after
+    :func:`distributed_init` so rank resolution sees the cluster.
+
+    Returns ``(tracer, recorder, watchdog-or-None)``; enter the tracer
+    around the train loop and wrap steps in ``trace.step()`` /
+    ``trace.span`` so dumps carry span timelines (docs/tracing.md).
+    """
+    from apex_tpu import trace as _trace
+    tracer = _trace.Tracer()
+    recorder = _trace.FlightRecorder(path, capacity=capacity,
+                                     tracer=tracer).install()
+    watchdog = None
+    if hang_deadline_s:
+        watchdog = _trace.HangWatchdog(hang_deadline_s, recorder=recorder,
+                                       tracer=tracer).start()
+    return tracer, recorder, watchdog
 
 
 def is_distributed() -> bool:

@@ -47,12 +47,19 @@ def annotate(name: str) -> Callable:
     CPU timeline; the named scope attributes its compiled ops on the
     device timeline. Together these cover what a single NVTX range did in
     the reference (`apex/pyprof/nvtx/nvmarker.py:151-163`).
+
+    Implemented over :class:`apex_tpu.trace.span`, so annotated
+    functions additionally land in the active ``trace.Tracer`` step
+    timeline (and flight-recorder dumps) whenever one is entered — the
+    profiling and forensic annotation layers are the same spans.
     """
 
     def deco(fn: Callable) -> Callable:
+        from apex_tpu.trace.spans import span as _span
+
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
-            with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            with _span(name):
                 return fn(*args, **kwargs)
 
         return wrapped

@@ -32,6 +32,15 @@ _OPCODE_RE = re.compile(
     r"^%?(?P<name>[^ ]+) = (?:\((?:[^()]|\([^()]*\))*\)|[^ ]+) "
     r"(?P<opcode>[\w-]+)\(")
 
+# named-scope path in HLO op metadata: metadata={op_name="jit(f)/amp/fwd/..."}
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+
+# transform-wrapper path components jax interleaves with user scopes —
+# dropped by by_scope() so "jit(step)/transpose(jvp(amp))/fwd" and
+# "jit(step)/amp/fwd" aggregate under the same user-named key
+_TRANSFORM_WRAPPERS = ("jit(", "transpose(", "jvp(", "vmap(", "pmap(",
+                      "shard_map(", "scan(", "while(", "remat(")
+
 # The one canonical list of collective opcode prefixes — longest-prefix
 # entries first so e.g. ragged-all-to-all is not folded into all-to-all.
 # apex_tpu.monitor.collectives buckets traffic by the same tuple; keep
@@ -102,6 +111,29 @@ class TraceProfile:
         out: Dict[str, float] = {}
         for r in self.ops:
             out[r.category] = out.get(r.category, 0.0) + r.total_us
+        return dict(sorted(out.items(), key=lambda kv: -kv[1]))
+
+    def by_scope(self, depth: int = 2) -> Dict[str, float]:
+        """Device time per named-scope prefix (``trace.span`` names).
+
+        HLO op metadata carries the full scope path each op was traced
+        under (``op_name="jit(step)/amp/fwd/conv"``); this aggregates
+        ``total_us`` by the first ``depth`` path components after the
+        ``jit(...)`` / transform wrappers — so ``trace.span("amp/fwd")``
+        spans show up here with their *measured device* time, the
+        counterpart of the tracer's host wall-clock timeline. Ops with
+        no scope metadata land under ``"(unscoped)"``.
+        """
+        out: Dict[str, float] = {}
+        for r in self.ops:
+            m = _OP_NAME_RE.search(r.hlo)
+            if m:
+                parts = [p for p in m.group(1).split("/")
+                         if not p.startswith(_TRANSFORM_WRAPPERS)]
+                key = "/".join(parts[:depth]) if parts else "(unscoped)"
+            else:
+                key = "(unscoped)"
+            out[key] = out.get(key, 0.0) + r.total_us
         return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
     def table(self, top: int = 20) -> str:

@@ -1,0 +1,45 @@
+"""apex_tpu.trace — distributed tracing + flight recorder.
+
+The forensic layer over :mod:`apex_tpu.monitor` (which tells you *that*
+training is unhealthy) and :mod:`apex_tpu.prof` (post-hoc device
+profiles): span-level step timelines, crash dumps, hang detection, and
+NaN provenance, designed so a wedged multi-host run is diagnosable from
+artifacts. See docs/tracing.md. Four pieces:
+
+- **spans** (:mod:`~apex_tpu.trace.spans`): ``trace.span("fwd")``
+  context manager/decorator layering ``jax.named_scope`` +
+  ``jax.profiler.TraceAnnotation`` (device attribution via xplane) over
+  a host wall-clock timeline per step (:class:`Tracer`), exported as
+  Chrome-trace JSON (Perfetto-loadable) and a :class:`StepTimeline`
+  table;
+- **flight recorder** (:mod:`~apex_tpu.trace.recorder`): bounded ring of
+  the last N step records (span timings, Metrics snapshot, loss scale,
+  collective bytes, rank/host ids) with chained ``sys.excepthook`` /
+  ``SIGTERM`` / ``atexit`` handlers that dump a JSONL crash report —
+  rank, last-completed span, in-flight collective — on abnormal exit;
+- **hang watchdog** (:mod:`~apex_tpu.trace.watchdog`): a daemon thread
+  that fires when no step completes within a deadline, dumping all
+  Python thread stacks plus the flight record and tagging the silent
+  rank;
+- **NaN provenance** (:mod:`~apex_tpu.trace.debug_nans`): opt-in
+  ``debug_nans`` mode adding ``jax.debug.callback`` finiteness probes
+  per span; the off path is bit-identical compiled HLO (the
+  ``trace/no-extra-dispatch`` compile-check case).
+"""
+
+from apex_tpu.trace.debug_nans import (debug_nans, debug_nans_enabled,
+                                       first_nan, nan_probe,
+                                       reset_nan_state)
+from apex_tpu.trace.recorder import FlightRecorder, StepRecord, rank_path
+from apex_tpu.trace.spans import (SpanEvent, StepTimeline, StepTrace,
+                                  Tracer, current_tracer, span, step)
+from apex_tpu.trace.watchdog import HangWatchdog
+
+__all__ = [
+    "span", "step", "Tracer", "SpanEvent", "StepTrace", "StepTimeline",
+    "current_tracer",
+    "FlightRecorder", "StepRecord", "rank_path",
+    "HangWatchdog",
+    "debug_nans", "debug_nans_enabled", "nan_probe", "first_nan",
+    "reset_nan_state",
+]

@@ -1,0 +1,127 @@
+"""NaN provenance: name the first span whose outputs went non-finite.
+
+``jax.config.jax_debug_nans`` localizes a NaN to an *HLO op*, at the
+cost of disabling async dispatch and rerunning un-jitted. This mode is
+coarser and cheaper: opt-in per-span finiteness probes
+(``jax.debug.callback``) that ride the compiled step and report, on the
+host, the first *span* ("amp/bwd", "fp16/unscale", your own
+``@trace.span`` functions) whose outputs contained a NaN/Inf — enough to
+know which phase of the step to bisect, from a live or crashed run.
+
+Contract (the ``trace/no-extra-dispatch`` compile-check case): with the
+mode OFF, :func:`nan_probe` returns its input untouched — the compiled
+program is bit-identical to an unprobed one, zero extra dispatches or
+host traffic. The flag is read at *trace* time, so enable it BEFORE the
+step first compiles (or use ``jax.clear_caches()`` / a fresh jit) — the
+same build-per-flag caveat as ``DistributedDataParallel.no_sync``.
+
+Usage::
+
+    with trace.debug_nans():
+        jstep = jax.jit(step)              # compiled WITH probes
+        for batch in data:
+            state, loss = jstep(state, batch)
+            hit = trace.first_nan()
+            if hit is not None:
+                raise FloatingPointError(f"non-finite in {hit['span']}")
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["debug_nans", "debug_nans_enabled", "nan_probe", "first_nan",
+           "reset_nan_state"]
+
+_enabled = False
+_lock = threading.Lock()
+#: first non-finite report since the last reset: {"span": name, "count": n}
+_first: Optional[Dict[str, Any]] = None
+_probe_serial = 0
+
+
+def debug_nans_enabled() -> bool:
+    return _enabled
+
+
+@contextlib.contextmanager
+def debug_nans(enable: bool = True):
+    """Enable per-span finiteness probes for steps traced inside."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(enable)
+    try:
+        yield
+    finally:
+        _enabled = prev
+
+
+def reset_nan_state() -> None:
+    """Forget any recorded non-finite hit (e.g. at each step boundary)."""
+    global _first
+    with _lock:
+        _first = None
+
+
+def first_nan() -> Optional[Dict[str, Any]]:
+    """The first recorded non-finite span since the last reset, or None.
+
+    ``{"span": name, "order": probe-serial}`` — "first" means the
+    earliest probe (in program order) that observed a non-finite value;
+    with jax's async dispatch the callback may land after the step call
+    returns, so fetch a step output (or ``jax.block_until_ready``)
+    before trusting a ``None``.
+    """
+    with _lock:
+        return dict(_first) if _first is not None else None
+
+
+def _report(name: str, order: int, ok) -> None:
+    global _first
+    if bool(ok):
+        return
+    with _lock:
+        # program order, not callback arrival order, decides "first"
+        if _first is None or order < _first["order"]:
+            _first = {"span": name, "order": order}
+
+
+def _make_cb(name: str, order: int):
+    # name/order are trace-time statics — closed over, since
+    # jax.debug.callback only ships array arguments to the host
+    def cb(ok):
+        _report(name, order, ok)
+    return cb
+
+
+def nan_probe(name: str, tree: Any) -> Any:
+    """Probe a pytree for finiteness under the debug_nans mode.
+
+    Mode off (the default): returns ``tree`` unchanged — adds nothing to
+    the program. Mode on: reduces every inexact leaf to one ``all
+    finite`` scalar and attaches a ``jax.debug.callback`` that records
+    this span's name on the host when the check fails. The value itself
+    passes through either way, so probes drop into any expression:
+    ``grads = nan_probe("amp/bwd", grads)``.
+    """
+    if not _enabled:
+        return tree
+    global _probe_serial
+    leaves = [l for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")
+              and jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact)]
+    if not leaves:
+        return tree
+    ok = jnp.bool_(True)
+    for l in leaves:
+        # isfinite in the leaf's own dtype: a float32 downcast would
+        # overflow finite float64 values (x64 mode) into false positives
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(l)))
+    _probe_serial += 1
+    jax.debug.callback(_make_cb(name, _probe_serial), ok)
+    return tree

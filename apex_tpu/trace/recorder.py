@@ -1,0 +1,355 @@
+"""Flight recorder: a bounded ring of step records + crash dumps.
+
+A wedged or dead 256-chip job must be diagnosable from artifacts, not
+reproduction. The recorder keeps the last N completed steps — span
+timings, the :class:`apex_tpu.monitor.Metrics` snapshot (buffered as
+device arrays, fetched only at dump time, so recording never syncs),
+loss scale, collective bytes, rank/host ids — and writes a JSONL crash
+report on any abnormal exit:
+
+- unhandled exception (``sys.excepthook``, chained to the previous hook);
+- SIGTERM (the preemption signal on managed clusters; previous handler
+  chained);
+- ``atexit`` as a safety net, only when an exception/signal was seen but
+  no dump was written (a clean exit writes nothing).
+
+The dump is one header line (``kind="crash"``: reason, rank, hostname,
+pid, last-completed span, in-flight spans, in-flight collective,
+exception + traceback) followed by one ``kind="step"`` line per buffered
+step — the schema ``scripts/check_metrics_schema.py --kind trace``
+validates. On multi-host runs every rank records independently;
+:func:`rank_path` (used automatically for directory paths) keeps the
+files apart so post-mortem tooling can diff ranks.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from apex_tpu.trace.spans import StepTrace, Tracer
+
+__all__ = ["FlightRecorder", "StepRecord", "rank_path"]
+
+
+def _rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", "0"))
+
+
+def _process_count() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("WORLD_SIZE", "1"))
+
+
+def rank_path(path: str, rank: Optional[int] = None) -> str:
+    """Per-rank dump path: ``crash.jsonl`` → ``crash.rank0.jsonl``.
+
+    Identity on single-process runs, so local scripts get the filename
+    they asked for; ranked on multi-process runs (or when ``rank`` is
+    given) so N hosts never clobber one file.
+    """
+    if rank is None:
+        if _process_count() <= 1:
+            return path
+        rank = _rank()
+    root, ext = os.path.splitext(path)
+    return f"{root}.rank{rank}{ext or '.jsonl'}"
+
+
+class StepRecord:
+    """One ring-buffer entry. Metrics stay device-side until dump()."""
+
+    __slots__ = ("step", "dur_ms", "spans", "metrics", "extra", "wall_time")
+
+    def __init__(self, step, dur_ms, spans, metrics, extra):
+        self.step = step
+        self.dur_ms = dur_ms
+        self.spans = spans            # [(name, dur_ms)]
+        self.metrics = metrics        # monitor.Metrics (device) or None
+        self.extra = extra            # host scalars (loss scale override, ...)
+        self.wall_time = time.time()
+
+    def to_event(self, rank: int, fetch_metrics: bool = True) -> Dict:
+        """``fetch_metrics=False`` skips the device fetch — required on
+        the hang path, where a device_get against the wedged runtime
+        would block the watchdog thread forever."""
+        rec: Dict[str, Any] = {
+            "kind": "step", "step": self.step, "rank": rank,
+            "dur_ms": self.dur_ms, "wall_time": self.wall_time,
+            "spans": [{"name": n, "dur_ms": round(d, 4)}
+                      for n, d in self.spans],
+        }
+        if self.metrics is not None and not fetch_metrics:
+            rec["metrics"] = None
+            rec["metrics_error"] = "not fetched (hung runtime)"
+        elif self.metrics is not None:
+            from apex_tpu.monitor.metrics import metrics_to_dict
+            try:
+                m = metrics_to_dict(jax.device_get(self.metrics))
+                # strict-JSON contract: non-finite gauges become null,
+                # same as MetricsLogger.flush
+                import math
+                for k, v in m.items():
+                    if isinstance(v, float) and not math.isfinite(v):
+                        m[k] = None
+                rec["metrics"] = m
+                if m.get("loss_scale") is not None:
+                    rec["loss_scale"] = m["loss_scale"]
+            except Exception as e:           # dead runtime mid-crash
+                rec["metrics"] = None
+                rec["metrics_error"] = repr(e)[:200]
+        if self.extra:
+            rec.update(self.extra)
+        return rec
+
+
+class FlightRecorder:
+    """Ring buffer of the last ``capacity`` steps + crash-dump handlers.
+
+    ::
+
+        recorder = trace.FlightRecorder("dumps/crash.jsonl", capacity=64)
+        recorder.install()                  # excepthook / SIGTERM / atexit
+        tracer = trace.Tracer(on_step=recorder.on_step)
+        ...
+        recorder.record(step=i, metrics=state.metrics)   # or via tracer
+
+    ``collective_bytes``/``extra`` statics attach to every subsequent
+    record (e.g. from ``MetricsLogger.attach`` /
+    ``ddp.collective_bytes``). Directory paths get :func:`rank_path`
+    applied so multi-host runs dump per rank.
+    """
+
+    def __init__(self, path: str = "apex_tpu_crash.jsonl", *,
+                 capacity: int = 64, tracer: Optional[Tracer] = None,
+                 collective_bytes: Optional[int] = None):
+        self.path = rank_path(path)
+        self.capacity = max(int(capacity), 1)
+        self._ring: "collections.deque[StepRecord]" = collections.deque(
+            maxlen=self.capacity)
+        self.tracer = tracer
+        if tracer is not None:
+            tracer.subscribe(self.on_step)
+        self.collective_bytes = collective_bytes
+        self.extra_statics: Dict[str, Any] = {}
+        self._installed = False
+        self._dumped = False
+        self._abnormal_seen = False
+        self._last_completed_span: Optional[str] = None
+        self._prev_excepthook = None
+        self._prev_sigterm = None
+        self._pending = None          # (metrics, extra) for the open step
+        # RLock, not Lock: the SIGTERM handler runs on the main thread
+        # and calls dump() -> lock; if the signal lands while record()
+        # holds the lock on that same thread, a plain Lock deadlocks the
+        # handler forever (and the process then ignores SIGTERM)
+        self._lock = threading.RLock()
+
+    # -- recording -----------------------------------------------------------
+
+    def on_step(self, st: StepTrace) -> None:
+        """Tracer subscriber: fold a finished StepTrace into the ring."""
+        pending, self._pending = self._pending, None
+        metrics, extra = pending if pending is not None else (None, {})
+        if st.aborted:
+            extra = dict(extra, aborted=True)
+        self.record(step=st.step, dur_ms=st.dur_ms,
+                    spans=[(s.name, s.dur_ms) for s in st.spans],
+                    metrics=metrics, **extra)
+        completed = [s for s in st.spans if not s.aborted]
+        if completed:
+            self._last_completed_span = completed[-1].name
+
+    def record(self, *, step: Optional[int] = None,
+               dur_ms: Optional[float] = None,
+               spans: Optional[List] = None,
+               metrics=None, **extra) -> None:
+        """Append one step record (never fetches from device)."""
+        merged = dict(self.extra_statics)
+        if self.collective_bytes is not None:
+            merged["collective_bytes"] = self.collective_bytes
+        merged.update(extra)
+        with self._lock:
+            self._ring.append(StepRecord(step, dur_ms, spans or [],
+                                         metrics, merged))
+
+    def record_metrics(self, metrics, **extra) -> None:
+        """Attach a Metrics snapshot to the current step — call next to
+        ``MetricsLogger.record``, inside or right after the
+        ``trace.step()`` block; costs a slot write, no sync. Inside an
+        open step the snapshot is held pending and folded into that
+        step's record when it completes; otherwise it attaches to the
+        latest ring entry (or starts one)."""
+        if (self.tracer is not None
+                and self.tracer._current is not None):
+            self._pending = (metrics, dict(extra))
+            return
+        with self._lock:
+            if self._ring and self._ring[-1].metrics is None:
+                self._ring[-1].metrics = metrics
+                if extra:
+                    self._ring[-1].extra.update(extra)
+                return
+        self.record(metrics=metrics, **extra)
+
+    @property
+    def last_completed_span(self) -> Optional[str]:
+        if self.tracer is not None and self.tracer.last_completed_span:
+            return self.tracer.last_completed_span
+        return self._last_completed_span
+
+    # -- crash handlers ------------------------------------------------------
+
+    def install(self) -> "FlightRecorder":
+        """Install excepthook/SIGTERM/atexit handlers (all chained)."""
+        if self._installed:
+            return self
+        self._prev_excepthook = sys.excepthook
+        sys.excepthook = self._excepthook
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._sigterm)
+        except ValueError:        # not the main thread
+            self._prev_sigterm = None
+        atexit.register(self._atexit)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        if sys.excepthook is self._excepthook:
+            sys.excepthook = self._prev_excepthook or sys.__excepthook__
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+        atexit.unregister(self._atexit)
+        self._installed = False
+
+    def _excepthook(self, etype, value, tb) -> None:
+        self._abnormal_seen = True
+        try:
+            self.dump(reason="exception", exc=(etype, value, tb))
+        finally:
+            (self._prev_excepthook or sys.__excepthook__)(etype, value, tb)
+
+    def _sigterm(self, signum, frame) -> None:
+        self._abnormal_seen = True
+        self.dump(reason="signal:SIGTERM")
+        prev = self._prev_sigterm
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def _atexit(self) -> None:
+        # safety net only: an abnormal path was seen but no dump landed
+        # (e.g. the excepthook itself died). Clean exits write nothing.
+        if self._abnormal_seen and not self._dumped:
+            self.dump(reason="atexit-after-abnormal")
+
+    # -- the dump ------------------------------------------------------------
+
+    def header(self, reason: str, exc=None) -> Dict:
+        hdr: Dict[str, Any] = {
+            "kind": "crash", "reason": reason,
+            "rank": _rank(), "process_count": _process_count(),
+            "hostname": socket.gethostname(), "pid": os.getpid(),
+            "wall_time": time.time(),
+            "last_completed_span": self.last_completed_span,
+            "in_flight_spans": (self.tracer.open_spans
+                                if self.tracer is not None else []),
+            "in_flight_collective": (self.tracer.in_flight_collective
+                                     if self.tracer is not None else None),
+            "n_steps_recorded": len(self._ring),
+        }
+        from apex_tpu.trace.debug_nans import first_nan
+        hit = first_nan()
+        if hit is not None:
+            hdr["first_nan_span"] = hit["span"]
+        if exc is not None:
+            etype, value, tb = exc
+            hdr["exception"] = "".join(
+                traceback.format_exception_only(etype, value))[:2000].strip()
+            hdr["traceback"] = [l.rstrip() for l in
+                                traceback.format_tb(tb, limit=40)]
+        return hdr
+
+    def _fetch_metrics_bounded(self, records: List[StepRecord],
+                               timeout_s: float = 5.0) -> bool:
+        """device_get every buffered Metrics snapshot with a bounded
+        wait, replacing them in-place with host values. Returns False on
+        timeout/error — a crash can leave the runtime wedged on a dead
+        collective, and an unbounded device_get there would hang the
+        crash handler and lose the whole dump (the very artifact this
+        class exists to produce)."""
+        idx = [i for i, r in enumerate(records) if r.metrics is not None]
+        if not idx:
+            return True
+        box: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def work():
+            try:
+                box["host"] = jax.device_get(
+                    [records[i].metrics for i in idx])
+            except Exception as e:
+                box["err"] = e
+            done.set()
+
+        threading.Thread(target=work, daemon=True,
+                         name="apex_tpu.trace.dump-fetch").start()
+        if not done.wait(timeout_s) or "host" not in box:
+            return False
+        for i, host in zip(idx, box["host"]):
+            records[i].metrics = host
+        return True
+
+    def dump_records(self, f, rank: int, fetch_metrics: bool = True,
+                     records: Optional[List[StepRecord]] = None) -> None:
+        """Serialize the ring (one ``kind="step"`` line each) to an open
+        file — the one implementation behind both the crash dump and the
+        watchdog's hang dump."""
+        if records is None:
+            with self._lock:
+                records = list(self._ring)
+        for rec in records:
+            f.write(json.dumps(rec.to_event(
+                rank, fetch_metrics=fetch_metrics)) + "\n")
+
+    def dump(self, reason: str = "manual", exc=None,
+             path: Optional[str] = None) -> str:
+        """Write the crash report; returns the path written."""
+        out = path or self.path
+        d = os.path.dirname(out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        rank = _rank()
+        with self._lock:
+            records = list(self._ring)
+        fetched = self._fetch_metrics_bounded(records)
+        with open(out, "w") as f:
+            f.write(json.dumps(self.header(reason, exc)) + "\n")
+            self.dump_records(f, rank, fetch_metrics=fetched,
+                              records=records)
+        self._dumped = True
+        return out

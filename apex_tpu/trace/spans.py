@@ -1,0 +1,416 @@
+"""Span API + per-step host timeline — the forensic half of annotation.
+
+:mod:`apex_tpu.prof.annotate` puts names *into the compiled program*
+(``jax.named_scope``) so xplane traces attribute device time per scope.
+This module adds the host half the flight recorder and hang watchdog
+need: ``span("fwd")`` is a context manager / decorator that layers the
+same in-graph scope + ``jax.profiler.TraceAnnotation`` AND records a
+wall-clock (begin, duration) event into the active :class:`Tracer`'s
+per-step timeline. The timeline is emitted two ways:
+
+- :meth:`Tracer.chrome_trace` / :meth:`Tracer.write_chrome_trace` —
+  Chrome-trace-format JSON (``{"traceEvents": [...]}``) that loads in
+  Perfetto / ``chrome://tracing``;
+- :meth:`Tracer.timeline` — a :class:`StepTimeline` table (one row per
+  step, one column per span) plus ``kind="span"``/``kind="step"`` JSONL
+  events for the monitor trace-event channel
+  (``scripts/check_metrics_schema.py --kind trace`` validates them).
+
+Passive by default: with no Tracer entered, ``span`` costs one global
+read plus the named-scope enter (no ops added to the compiled program —
+asserted by the ``trace/no-extra-dispatch`` compile check). Spans inside
+a jitted function execute at *trace time* only; their host durations
+attribute compile/trace cost (useful on step 0), while their named
+scopes attribute device time on every step via xplane. Host-side spans
+around the dispatch measure wall clock per step — remember jax dispatch
+is async, so wrap the sync point (e.g. the host fetch) in its own span.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+__all__ = ["span", "step", "Tracer", "SpanEvent", "StepTrace",
+           "StepTimeline", "current_tracer"]
+
+# active Tracer stack (innermost last). Thread-local so a watchdog /
+# helper thread entering its own tracer never corrupts the train loop's.
+_tls = threading.local()
+
+
+def _stack() -> List["Tracer"]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_tracer() -> Optional["Tracer"]:
+    """The innermost active Tracer on this thread, or None (passive)."""
+    st = _stack()
+    return st[-1] if st else None
+
+
+class SpanEvent:
+    """One span occurrence: name, begin time, duration.
+
+    ``aborted`` marks a span unwound by an exception — it was in flight,
+    not completed, when the step died (the duration then measures begin
+    → unwind)."""
+
+    __slots__ = ("name", "kind", "t_start", "dur_ms", "depth", "aborted")
+
+    def __init__(self, name: str, kind: str, t_start: float,
+                 dur_ms: float, depth: int, aborted: bool = False):
+        self.name = name
+        self.kind = kind          # "span" | "collective"
+        self.t_start = t_start    # perf_counter seconds (trace-relative)
+        self.dur_ms = dur_ms
+        self.depth = depth        # nesting depth inside the step
+        self.aborted = aborted
+
+    def to_event(self, step: Optional[int], rank: int) -> Dict:
+        ev = {"kind": "span", "name": self.name, "span_kind": self.kind,
+              "step": step, "rank": rank, "t_ms": self.t_start * 1e3,
+              "dur_ms": self.dur_ms, "depth": self.depth}
+        if self.aborted:
+            ev["aborted"] = True
+        return ev
+
+
+class StepTrace:
+    """The span timeline of one step (plus whatever rides along)."""
+
+    def __init__(self, step: Optional[int], t_start: float):
+        self.step = step
+        self.t_start = t_start
+        self.dur_ms: Optional[float] = None
+        self.spans: List[SpanEvent] = []
+        self.aborted = False
+
+    def span_ms(self) -> Dict[str, float]:
+        """Total duration per span name (summed over occurrences)."""
+        out: Dict[str, float] = {}
+        for s in self.spans:
+            out[s.name] = out.get(s.name, 0.0) + s.dur_ms
+        return out
+
+    def to_event(self, rank: int) -> Dict:
+        ev = {"kind": "step", "step": self.step, "rank": rank,
+              "t_ms": self.t_start * 1e3, "dur_ms": self.dur_ms,
+              "spans": [{"name": s.name, "dur_ms": round(s.dur_ms, 4)}
+                        for s in self.spans]}
+        if self.aborted:
+            ev["aborted"] = True
+        return ev
+
+
+class StepTimeline:
+    """Tabular view of a list of StepTraces: steps x span columns."""
+
+    def __init__(self, steps: List[StepTrace]):
+        self.steps = steps
+
+    def columns(self) -> List[str]:
+        cols: List[str] = []
+        for st in self.steps:
+            for s in st.spans:
+                if s.name not in cols:
+                    cols.append(s.name)
+        return cols
+
+    def table(self, width: int = 12) -> str:
+        cols = self.columns()
+        heads = ["step", "total_ms"] + cols
+        lines = [" ".join(h[-width:].rjust(width) for h in heads)]
+        for st in self.steps:
+            per = st.span_ms()
+            row = [str(st.step if st.step is not None else "-"),
+                   f"{st.dur_ms:.2f}" if st.dur_ms is not None else "n/a"]
+            row += [f"{per[c]:.2f}" if c in per else "-" for c in cols]
+            lines.append(" ".join(v.rjust(width) for v in row))
+        return "\n".join(lines)
+
+
+def _rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+class Tracer:
+    """Collects StepTraces from ``span``/``step`` used under it.
+
+    ::
+
+        tracer = trace.Tracer()
+        with tracer:
+            for batch in data:
+                with trace.step():
+                    with trace.span("dispatch"):
+                        state, loss = train_step(state, batch)
+                    with trace.span("fetch"):
+                        logger.record(state.metrics)
+        tracer.write_chrome_trace("timeline.json")
+        print(tracer.timeline().table())
+
+    ``on_step`` (a callable taking the finished StepTrace) is the fan-out
+    hook: the flight recorder and the hang watchdog both subscribe
+    through it, as can :meth:`apex_tpu.monitor.MetricsLogger.record_event`
+    via :meth:`step_event`. ``max_steps`` bounds the retained timeline
+    (older steps drop off; forensic retention belongs to the
+    FlightRecorder's ring buffer).
+    """
+
+    def __init__(self, *, max_steps: int = 1024,
+                 on_step: Optional[Callable[[StepTrace], None]] = None):
+        self.max_steps = max(int(max_steps), 1)
+        self._on_step: List[Callable[[StepTrace], None]] = (
+            [on_step] if on_step else [])
+        self.steps: List[StepTrace] = []
+        self._t0 = time.perf_counter()
+        self._step_count = 0
+        self._current: Optional[StepTrace] = None
+        self._open: List[Any] = []     # (name, kind, t_begin) stack
+        self.last_completed_span: Optional[str] = None
+        # spans unwound by an exception since the last step began: they
+        # were IN FLIGHT when the step died (the unwind closes the
+        # context managers, so open_spans alone would read empty by the
+        # time a crash handler looks) — innermost first, (name, kind)
+        self.aborted_spans: List[Any] = []
+        self._lock = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self) -> "Tracer":
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        st = _stack()
+        if self in st:
+            st.remove(self)
+
+    def subscribe(self, fn: Callable[[StepTrace], None]) -> None:
+        self._on_step.append(fn)
+
+    # -- step boundaries -----------------------------------------------------
+
+    def begin_step(self, step: Optional[int] = None) -> StepTrace:
+        if step is None:
+            step = self._step_count
+        self._step_count = step + 1
+        self.aborted_spans = []
+        self._current = StepTrace(step, time.perf_counter() - self._t0)
+        return self._current
+
+    def end_step(self, aborted: bool = False) -> Optional[StepTrace]:
+        st = self._current
+        if st is None:
+            return None
+        st.dur_ms = (time.perf_counter() - self._t0 - st.t_start) * 1e3
+        st.aborted = aborted
+        self._current = None
+        if not aborted:
+            # the step completed: any span unwound by a caught-and-
+            # recovered exception inside it is no longer in flight
+            self.aborted_spans = []
+        with self._lock:
+            self.steps.append(st)
+            if len(self.steps) > self.max_steps:
+                del self.steps[:len(self.steps) - self.max_steps]
+        for fn in list(self._on_step):
+            try:
+                fn(st)
+            except Exception:
+                pass          # observers never break the train loop
+        return st
+
+    # -- span recording (called by the span context manager) -----------------
+
+    def _span_begin(self, name: str, kind: str) -> None:
+        self._open.append((name, kind, time.perf_counter() - self._t0))
+
+    def _span_end(self, aborted: bool = False) -> None:
+        if not self._open:
+            return
+        name, kind, t0 = self._open.pop()
+        now = time.perf_counter() - self._t0
+        ev = SpanEvent(name, kind, t0, (now - t0) * 1e3,
+                       depth=len(self._open), aborted=aborted)
+        if aborted:
+            # an exception unwound this span — it was in flight, not
+            # completed; keep it visible to crash handlers
+            self.aborted_spans.append((name, kind))
+        else:
+            self.last_completed_span = name
+        target = self._current
+        if target is not None:
+            target.spans.append(ev)
+
+    @property
+    def open_spans(self) -> List[str]:
+        """Names of in-flight spans, outermost first: still-open ones
+        plus any already unwound by the in-progress exception."""
+        return ([name for name, _, _ in self._open]
+                + [name for name, _ in reversed(self.aborted_spans)])
+
+    @property
+    def in_flight_collective(self) -> Optional[str]:
+        """Deepest in-flight span tagged ``kind="collective"``, if any
+        (exception-unwound collectives included)."""
+        for name, kind in self.aborted_spans:
+            if kind == "collective":
+                return name
+        for name, kind, _ in reversed(self._open):
+            if kind == "collective":
+                return name
+        return None
+
+    # -- exports -------------------------------------------------------------
+
+    def timeline(self) -> StepTimeline:
+        with self._lock:
+            return StepTimeline(list(self.steps))
+
+    def step_events(self, rank: Optional[int] = None) -> List[Dict]:
+        """``kind="step"`` JSONL events for every retained step."""
+        r = _rank() if rank is None else rank
+        with self._lock:
+            return [st.to_event(r) for st in self.steps]
+
+    def span_events(self, rank: Optional[int] = None) -> List[Dict]:
+        """Flat ``kind="span"`` JSONL events for every retained span."""
+        r = _rank() if rank is None else rank
+        out: List[Dict] = []
+        with self._lock:
+            for st in self.steps:
+                out.extend(s.to_event(st.step, r) for s in st.spans)
+        return out
+
+    def chrome_trace(self, rank: Optional[int] = None) -> Dict:
+        """Chrome-trace-format dict (loads in Perfetto/chrome://tracing).
+
+        One complete-duration ("ph": "X") event per span plus one per
+        step; pid is the process rank so multi-host dumps merge into one
+        per-rank-track view.
+        """
+        r = _rank() if rank is None else rank
+        events: List[Dict] = []
+        with self._lock:
+            for st in self.steps:
+                if st.dur_ms is not None:
+                    events.append({
+                        "name": f"step {st.step}", "ph": "X", "cat": "step",
+                        "ts": st.t_start * 1e6, "dur": st.dur_ms * 1e3,
+                        "pid": r, "tid": 0,
+                        "args": {"step": st.step}})
+                for s in st.spans:
+                    events.append({
+                        "name": s.name, "ph": "X", "cat": s.kind,
+                        "ts": s.t_start * 1e6, "dur": s.dur_ms * 1e3,
+                        "pid": r, "tid": 1 + s.depth,
+                        "args": {"step": st.step}})
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metadata": {"producer": "apex_tpu.trace", "rank": r}}
+
+    def write_chrome_trace(self, path: str,
+                           rank: Optional[int] = None) -> str:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(rank), f)
+        return path
+
+
+class span:
+    """``with trace.span("fwd"): ...`` / ``@trace.span("fwd")``.
+
+    Layers, innermost to outermost:
+
+    - ``jax.named_scope(name)`` — names the HLO ops traced inside, so the
+      span shows up in xplane device traces and HLO dumps;
+    - ``jax.profiler.TraceAnnotation(name)`` — a host-timeline range for
+      the profiler;
+    - a wall-clock event in the active :class:`Tracer` (if any).
+
+    ``kind="collective"`` tags the span for the flight recorder's
+    in-flight-collective forensics (see
+    ``DistributedDataParallel.sync``). As a decorator, when
+    :func:`apex_tpu.trace.debug_nans` mode is on, the wrapped function's
+    outputs are additionally probed for finiteness and this span's name
+    is reported as NaN provenance (see :mod:`apex_tpu.trace.debug_nans`).
+    """
+
+    def __init__(self, name: str, *, kind: str = "span"):
+        self.name = name
+        self.kind = kind
+        self._scope = None
+        self._annot = None
+        self._tracer: Optional[Tracer] = None
+
+    def __enter__(self) -> "span":
+        self._tracer = current_tracer()
+        if self._tracer is not None:
+            self._tracer._span_begin(self.name, self.kind)
+        self._annot = jax.profiler.TraceAnnotation(self.name)
+        self._annot.__enter__()
+        self._scope = jax.named_scope(self.name)
+        self._scope.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._scope is not None:
+            self._scope.__exit__(*exc)
+            self._scope = None
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+            self._annot = None
+        if self._tracer is not None:
+            self._tracer._span_end(aborted=bool(exc and exc[0]))
+            self._tracer = None
+
+    def __call__(self, fn: Callable) -> Callable:
+        from apex_tpu.trace.debug_nans import nan_probe
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with span(self.name, kind=self.kind):
+                out = fn(*args, **kwargs)
+            return nan_probe(self.name, out)
+
+        return wrapped
+
+
+class step:
+    """``with trace.step(): ...`` — delimits one train step's timeline.
+
+    Nested ``span``s land in this step's StepTrace; on exit the finished
+    StepTrace fans out to the tracer's subscribers (flight recorder,
+    watchdog heartbeat, metric-logger trace channel). A no-op when no
+    Tracer is active.
+    """
+
+    def __init__(self, step: Optional[int] = None):
+        self._step = step
+        self._tracer: Optional[Tracer] = None
+
+    def __enter__(self) -> "step":
+        self._tracer = current_tracer()
+        if self._tracer is not None:
+            self._tracer.begin_step(self._step)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._tracer is not None:
+            self._tracer.end_step(aborted=bool(exc and exc[0]))
+            self._tracer = None

@@ -8,7 +8,12 @@ accelerator is present. Prints ONE JSON line.
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
 and writes BENCH_TABLE.md. ``python bench.py --monitor`` drives the
 headline step with live apex_tpu.monitor telemetry (stdout table +
-MONITOR.jsonl).
+MONITOR.jsonl). ``python bench.py --trace`` runs a short traced loop
+with apex_tpu.trace spans + flight recorder, emitting a
+Perfetto-loadable Chrome trace (TRACE.json), a trace-event JSONL stream
+(TRACE_EVENTS.jsonl — validate with
+``scripts/check_metrics_schema.py --kind trace``), and the per-step
+span timeline table.
 
 See PERF.md for the profiling breakdown behind the current number
 (captured with apex_tpu.prof).
@@ -481,6 +486,52 @@ def run_monitor(steps: int = 20, jsonl_path: str = "MONITOR.jsonl"):
           f"(validate: python scripts/check_metrics_schema.py {jsonl_path})")
 
 
+def run_trace(steps: int = 3, chrome_path: str = "TRACE.json",
+              events_path: str = "TRACE_EVENTS.jsonl"):
+    """`python bench.py --trace`: the apex_tpu.trace consumer demo — a
+    short ResNet loop under a Tracer with host spans per phase, a flight
+    recorder wired through the tracer, and the monitor trace-event
+    channel streaming the step timeline. Artifacts: Chrome-trace JSON
+    (loads in Perfetto / chrome://tracing), a trace-event JSONL stream,
+    and the StepTimeline table on stdout."""
+    from apex_tpu import monitor, trace
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch, size = (128, 224) if on_tpu else (8, 64)
+    step, (state, batch_stats), (x, y) = _resnet_step_builder(
+        batch, size, monitor=True)
+    jstep = jax.jit(step)
+
+    tracer = trace.Tracer()
+    recorder = trace.FlightRecorder("TRACE_CRASH.jsonl",
+                                    tracer=tracer).install()
+    logger = monitor.MetricsLogger(
+        sinks=[monitor.StdoutSink()],
+        trace_sink=monitor.JSONLSink(events_path), flush_every=steps)
+    rank = 0
+    tracer.subscribe(lambda st: logger.record_event(st.to_event(rank)))
+
+    with tracer:
+        for i in range(steps):
+            with trace.step(i):
+                with trace.span("dispatch"):
+                    state, batch_stats, loss = jstep(state, batch_stats,
+                                                     x, y)
+                with trace.span("fetch"):
+                    # sync point: materialize the loss so the span
+                    # timeline measures real step time, not async submit
+                    float(np.asarray(loss))
+                logger.record(state.metrics, images_per_step=batch)
+                recorder.record_metrics(state.metrics)
+    logger.close()
+    recorder.uninstall()
+    tracer.write_chrome_trace(chrome_path)
+    print(tracer.timeline().table())
+    print(f"wrote {chrome_path} (load in Perfetto) and {events_path} "
+          f"(validate: python scripts/check_metrics_schema.py "
+          f"--kind trace {events_path})")
+
+
 def main():
     from apex_tpu import models, prof
 
@@ -541,5 +592,7 @@ if __name__ == "__main__":
         run_all()
     elif "--monitor" in sys.argv:
         run_monitor()
+    elif "--trace" in sys.argv:
+        run_trace()
     else:
         main()

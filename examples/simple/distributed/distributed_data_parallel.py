@@ -13,10 +13,18 @@ to stdout/JSONL on an amortized flush cadence, and the per-step
 collective traffic is read off the compiled HLO via
 ``ddp.collective_bytes`` — live telemetry with zero extra dispatches.
 
+Also the minimal apex_tpu.trace consumer: ``--crash-dumps DIR`` installs
+the per-rank flight recorder + hang watchdog
+(``parallel.enable_crash_dumps``), wraps each step in
+``trace.step``/``trace.span`` so dumps carry the span timeline, and
+writes a Perfetto-loadable Chrome trace at the end — a wedged or dead
+run leaves per-rank JSONL forensics instead of nothing.
+
 Run (any host, any chip count — falls back to a virtual CPU mesh):
 
     python distributed_data_parallel.py [--steps 500]
                                         [--metrics-jsonl metrics.jsonl]
+                                        [--crash-dumps dumps/]
 """
 
 import argparse
@@ -34,7 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from apex_tpu import amp, monitor, parallel
+from apex_tpu import amp, monitor, parallel, trace
 from apex_tpu.optim import FusedSGD
 
 
@@ -46,7 +54,26 @@ def main():
                         help="also stream metrics to this JSONL file")
     parser.add_argument("--log-every", default=50, type=int,
                         help="flush cadence of the metrics logger")
+    parser.add_argument("--crash-dumps", default=None, type=str,
+                        help="directory for per-rank flight-recorder / "
+                             "watchdog dumps + a Chrome trace")
+    parser.add_argument("--hang-deadline", default=300.0, type=float,
+                        help="watchdog deadline (s) when --crash-dumps "
+                             "is set")
     args = parser.parse_args()
+
+    # FOR DISTRIBUTED: form the cluster first (no-op single-process;
+    # honors MASTER_ADDR/RANK/WORLD_SIZE) — rank resolution below (per-
+    # rank dump paths, mesh over the global device set) depends on it.
+    parallel.distributed_init()
+
+    # FORENSICS: flight recorder (excepthook/SIGTERM/atexit crash dumps)
+    # + hang watchdog, one file per rank; the tracer feeds both.
+    tracer, recorder = trace.Tracer(), None
+    if args.crash_dumps:
+        tracer, recorder, _wd = parallel.enable_crash_dumps(
+            os.path.join(args.crash_dumps, "crash.jsonl"),
+            hang_deadline_s=args.hang_deadline)
 
     # FOR DISTRIBUTED: one mesh over every available device; the same
     # script is SPMD across a pod once distributed_init() has run.
@@ -113,10 +140,20 @@ def main():
     print(f"collective traffic/step: {logger.collective_bytes_per_step} "
           "bytes")
 
-    for _ in range(args.steps):
-        state, loss = spmd_step(state, x, y)
-        logger.record(state.metrics)
+    with tracer:
+        for i in range(args.steps):
+            with trace.step(i):
+                with trace.span("dispatch"):
+                    state, loss = spmd_step(state, x, y)
+                logger.record(state.metrics)
+                if recorder is not None:
+                    recorder.record_metrics(state.metrics)
     logger.close()
+    if args.crash_dumps:
+        path = trace.rank_path(
+            os.path.join(args.crash_dumps, "timeline.json"))
+        tracer.write_chrome_trace(path)
+        print("span timeline ->", path)
     print("final loss = ", float(loss))
 
 

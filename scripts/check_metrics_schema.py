@@ -1,7 +1,11 @@
 #!/usr/bin/env python
-"""Validate a metrics JSONL stream emitted by apex_tpu.monitor.JSONLSink.
+"""Validate apex_tpu observability JSONL streams.
 
-The wire-format contract (keep in lockstep with
+Two wire formats share a validator core (finite-or-null numbers, typed
+counters, JSON-object-per-line):
+
+``--kind metrics`` (default) — the stream emitted by
+``apex_tpu.monitor.JSONLSink`` (keep in lockstep with
 ``apex_tpu/monitor/sinks.py`` / ``logger.py``):
 
 - every line is a standalone JSON object;
@@ -14,11 +18,19 @@ The wire-format contract (keep in lockstep with
   (the logger nulls non-finite gauges); ``null`` is allowed only for
   the NULLABLE gauges (first-record step time, unknown-chip MFU, ...).
 
+``--kind trace`` — the trace-event / crash-dump / watchdog stream from
+``apex_tpu.trace`` (keep in lockstep with ``apex_tpu/trace/spans.py``,
+``recorder.py``, ``watchdog.py``): every line is an object with a
+``kind`` in {span, step, crash, watchdog}; per-kind REQUIRED keys below;
+``rank`` is a non-negative int everywhere; durations are finite,
+non-negative numbers; a crash/watchdog header names the last-completed
+span (string or null) and lists in-flight spans.
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
-Usage: python scripts/check_metrics_schema.py METRICS.jsonl
+Usage: python scripts/check_metrics_schema.py [--kind metrics|trace] FILE
 """
 
 from __future__ import annotations
@@ -26,7 +38,7 @@ from __future__ import annotations
 import json
 import math
 import sys
-from typing import List
+from typing import Dict, List, Optional
 
 REQUIRED = (
     "step", "loss", "loss_scale", "grad_norm", "param_norm",
@@ -38,12 +50,31 @@ COUNTERS = ("step", "overflow_count", "skip_count", "growth_count",
 NULLABLE = ("step_time_ms", "throughput_steps_per_s", "mfu",
             "collective_bytes", "loss", "grad_norm", "param_norm")
 
+# --- trace-event / crash-dump schema -----------------------------------------
 
-def check_lines(lines) -> List[str]:
-    """All schema violations in an iterable of JSONL lines (empty = ok)."""
-    errors: List[str] = []
-    prev_step = None
-    n_records = 0
+TRACE_KINDS = ("span", "step", "crash", "watchdog")
+#: required keys per trace-event kind (beyond "kind" itself)
+TRACE_REQUIRED = {
+    "span": ("name", "dur_ms"),
+    "step": ("step", "spans"),
+    "crash": ("reason", "rank", "last_completed_span", "in_flight_spans"),
+    "watchdog": ("reason", "rank", "seconds_since_last_step", "stacks",
+                 "silent_ranks"),
+}
+#: keys that may be null per kind (everything else non-null when present)
+TRACE_NULLABLE = {
+    "span": ("step",),
+    "step": ("step", "dur_ms", "metrics", "loss_scale"),
+    "crash": ("last_completed_span", "in_flight_collective"),
+    "watchdog": ("last_step", "last_completed_span",
+                 "in_flight_collective"),
+}
+
+
+# --- shared core -------------------------------------------------------------
+
+def _iter_objects(lines, errors: List[str]):
+    """Parse JSONL, reporting bad lines; yields (lineno, dict)."""
     for i, raw in enumerate(lines, start=1):
         line = raw.strip()
         if not line:
@@ -56,27 +87,62 @@ def check_lines(lines) -> List[str]:
         if not isinstance(rec, dict):
             errors.append(f"line {i}: not a JSON object")
             continue
+        yield i, rec
+
+
+def _is_number(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_finite_numbers(i: int, rec: Dict, errors: List[str],
+                          prefix: str = "") -> None:
+    """Every numeric value (one level of nesting included) is finite —
+    Infinity/NaN are not strict JSON and never belong on the wire."""
+    for k, v in rec.items():
+        if _is_number(v) and not math.isfinite(v):
+            errors.append(f"line {i}: {prefix}{k!r} is non-finite ({v!r})")
+        elif isinstance(v, dict):
+            _check_finite_numbers(i, v, errors, prefix=f"{k}.")
+        elif isinstance(v, list):
+            for j, item in enumerate(v):
+                if isinstance(item, dict):
+                    _check_finite_numbers(i, item, errors,
+                                          prefix=f"{k}[{j}].")
+                elif _is_number(item) and not math.isfinite(item):
+                    errors.append(f"line {i}: {k}[{j}] is non-finite "
+                                  f"({item!r})")
+
+
+def _check_counter(i: int, rec: Dict, key: str, errors: List[str],
+                   what: str = "counter") -> None:
+    v = rec.get(key)
+    if v is None or key not in rec:
+        return
+    if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+        errors.append(f"line {i}: {what} {key!r} must be a "
+                      f"non-negative int, got {v!r}")
+
+
+# --- metrics schema ----------------------------------------------------------
+
+def check_lines(lines) -> List[str]:
+    """All metrics-schema violations in an iterable of JSONL lines
+    (empty = ok)."""
+    errors: List[str] = []
+    prev_step = None
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
         n_records += 1
         for key in REQUIRED:
             if key not in rec:
                 errors.append(f"line {i}: missing required key {key!r}")
         for key, v in rec.items():
-            if v is None:
-                if key not in NULLABLE:
-                    errors.append(f"line {i}: {key!r} is null "
-                                  f"(only {NULLABLE} may be)")
-                continue
-            if isinstance(v, bool) or not isinstance(v, (int, float)):
-                continue
-            if not math.isfinite(v):
-                errors.append(f"line {i}: {key!r} is non-finite ({v!r})")
+            if v is None and key not in NULLABLE:
+                errors.append(f"line {i}: {key!r} is null "
+                              f"(only {NULLABLE} may be)")
+        _check_finite_numbers(i, rec, errors)
         for key in COUNTERS:
-            v = rec.get(key)
-            if v is None or key not in rec:
-                continue
-            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
-                errors.append(f"line {i}: counter {key!r} must be a "
-                              f"non-negative int, got {v!r}")
+            _check_counter(i, rec, key, errors)
         step = rec.get("step")
         if isinstance(step, int) and not isinstance(step, bool):
             if prev_step is not None and step <= prev_step:
@@ -88,24 +154,118 @@ def check_lines(lines) -> List[str]:
     return errors
 
 
+# --- trace schema ------------------------------------------------------------
+
+def check_trace_lines(lines) -> List[str]:
+    """All trace-schema violations in an iterable of JSONL lines
+    (empty = ok). Validates span/step timeline events, flight-recorder
+    crash dumps, and watchdog hang dumps."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in TRACE_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{TRACE_KINDS}, got {kind!r}")
+            continue
+        for key in TRACE_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = TRACE_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        _check_counter(i, rec, "pid", errors, what="field")
+        for dk in ("dur_ms", "t_ms", "wall_time",
+                   "seconds_since_last_step", "deadline_s"):
+            if dk not in rec or rec[dk] is None:
+                continue
+            v = rec[dk]
+            if not _is_number(v):
+                errors.append(f"line {i}: {dk!r} must be a number, "
+                              f"got {v!r}")
+            elif v < 0 and dk != "t_ms":
+                errors.append(f"line {i}: {dk!r} must be >= 0, got {v!r}")
+        if kind == "span" and not isinstance(rec.get("name"), str):
+            errors.append(f"line {i}: span 'name' must be a string")
+        if kind == "step":
+            spans = rec.get("spans")
+            if not isinstance(spans, list):
+                errors.append(f"line {i}: step 'spans' must be a list")
+            else:
+                for j, s in enumerate(spans):
+                    if (not isinstance(s, dict)
+                            or not isinstance(s.get("name"), str)
+                            or not _is_number(s.get("dur_ms"))):
+                        errors.append(f"line {i}: spans[{j}] must be "
+                                      "{name: str, dur_ms: number}")
+            _check_counter(i, rec, "step", errors, what="field")
+        if kind in ("crash", "watchdog"):
+            if not isinstance(rec.get("reason"), str):
+                errors.append(f"line {i}: {kind} 'reason' must be a "
+                              "string")
+            lcs = rec.get("last_completed_span")
+            if lcs is not None and not isinstance(lcs, str):
+                errors.append(f"line {i}: 'last_completed_span' must be "
+                              "a string or null")
+            ifs = rec.get("in_flight_spans")
+            if ifs is not None and not isinstance(ifs, list):
+                errors.append(f"line {i}: 'in_flight_spans' must be a "
+                              "list")
+        if kind == "watchdog":
+            if not isinstance(rec.get("stacks"), dict):
+                errors.append(f"line {i}: watchdog 'stacks' must be an "
+                              "object")
+            sr = rec.get("silent_ranks")
+            if not (isinstance(sr, list)
+                    and all(isinstance(r, int) and not isinstance(r, bool)
+                            and r >= 0 for r in sr)):
+                errors.append(f"line {i}: 'silent_ranks' must be a list "
+                              "of non-negative ints")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
+
+
+CHECKERS = {"metrics": check_lines, "trace": check_trace_lines}
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
-    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+    kind = "metrics"
+    files: List[str] = []
+    it = iter(argv)
+    for a in it:
+        if a in ("-h", "--help"):
+            print(__doc__)
+            return 2
+        if a == "--kind":
+            kind = next(it, "")
+        elif a.startswith("--kind="):
+            kind = a.split("=", 1)[1]
+        else:
+            files.append(a)
+    if kind not in CHECKERS or len(files) != 1:
         print(__doc__)
         return 2
     try:
-        with open(argv[0]) as f:
-            errors = check_lines(f)
+        with open(files[0]) as f:
+            errors = CHECKERS[kind](f)
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
     for e in errors:
         print(e, file=sys.stderr)
     if errors:
-        print(f"{argv[0]}: INVALID ({len(errors)} violations)",
+        print(f"{files[0]}: INVALID ({len(errors)} violations)",
               file=sys.stderr)
         return 1
-    print(f"{argv[0]}: ok")
+    print(f"{files[0]}: ok ({kind})")
     return 0
 
 

@@ -1,0 +1,70 @@
+#!/usr/bin/env bash
+# Tier-1 verify — the ROADMAP.md command, encapsulated.
+#
+#   scripts/run_tier1.sh            # full tier-1 pytest run (870s budget)
+#   scripts/run_tier1.sh --smoke    # fast pre-flight: schema validators
+#                                   # + a 3-step traced bench.py --trace run
+#
+# Exit status is pytest's (or the first failing smoke step). The full
+# run prints DOTS_PASSED=<n> — the count of passing-test dots the driver
+# tracks — whether or not the run hit the timeout.
+
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${TIER1_TIMEOUT:-870}"
+LOG="${TIER1_LOG:-/tmp/_t1.log}"
+
+if [[ "${1:-}" == "--smoke" ]]; then
+    set -e
+    tmp="$(mktemp -d)"
+    trap 'rm -rf "$tmp"' EXIT
+
+    echo "== smoke: metrics schema validator (self-test stream)"
+    JAX_PLATFORMS=cpu python - "$tmp/metrics.jsonl" <<'EOF'
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from apex_tpu import monitor
+logger = monitor.MetricsLogger(
+    sinks=[monitor.JSONLSink(sys.argv[1])], flush_every=2)
+m = monitor.metrics_init()
+for i in range(4):
+    m = m.count_step(jnp.bool_(True)).record_loss(float(i))
+    logger.record(m)
+logger.close()
+EOF
+    python scripts/check_metrics_schema.py --kind metrics "$tmp/metrics.jsonl"
+
+    echo "== smoke: 3-step traced bench (bench.py --trace)"
+    # run inside $tmp so TRACE*.json(l) artifacts never land in the tree
+    repo="$(pwd)"
+    (cd "$tmp" && JAX_PLATFORMS=cpu python "$repo/bench.py" --trace)
+
+    echo "== smoke: trace schema validator on the bench event stream"
+    python scripts/check_metrics_schema.py --kind trace \
+        "$tmp/TRACE_EVENTS.jsonl"
+
+    echo "== smoke: Chrome trace is valid JSON with traceEvents"
+    python - "$tmp/TRACE.json" <<'EOF'
+import json, sys
+ct = json.load(open(sys.argv[1]))
+assert isinstance(ct.get("traceEvents"), list) and ct["traceEvents"], \
+    "TRACE.json has no traceEvents"
+EOF
+    echo "smoke ok"
+    exit 0
+fi
+
+rm -f "$LOG"
+timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee "$LOG"
+rc=${PIPESTATUS[0]}
+# ROADMAP's class plus X: a progress line containing an xpassed test
+# must not drop its passing dots from the count
+echo "DOTS_PASSED=$(grep -aE '^[.FEsxX]+( *\[ *[0-9]+%\])?$' "$LOG" \
+    | tr -cd . | wc -c)"
+exit "$rc"

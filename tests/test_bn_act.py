@@ -159,6 +159,7 @@ def test_module_running_stats_and_eval():
     assert z_eval.shape == x.shape
 
 
+@pytest.mark.slow           # ~85s pair on CPU CI: full-model trajectories
 @pytest.mark.parametrize("arch", ["resnet18", "resnet50"])
 def test_resnet_fused_matches_oracle(arch):
     """Full-model check: fused-BN ResNet loss and input grad equal the

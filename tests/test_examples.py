@@ -34,6 +34,7 @@ def test_simple_distributed(devices):
                  ["--steps", "3"])
 
 
+@pytest.mark.slow           # ~90s pair on CPU CI; dcgan + simple stay tier-1
 @pytest.mark.parametrize("extra", [
     [],                                   # plain O2
     ["--sync_bn", "--opt-level", "O1"],   # syncbn + O1 policy
@@ -52,6 +53,7 @@ def test_dcgan(devices):
                   "--ndf", "16", "--print-freq", "2"])
 
 
+@pytest.mark.slow           # ~30s on CPU CI: JPEG tree + pipeline end-to-end
 def test_imagenet_real_data(devices, tmp_path, capsys):
     """--data: train from an actual JPEG ImageFolder tree through the
     apex_tpu.data pipeline (loader probe + prefetch + sharded step)."""

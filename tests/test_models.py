@@ -82,6 +82,7 @@ class TestDCGAN:
 
 
 class TestGraftEntry:
+    @pytest.mark.slow       # ~21s on CPU CI: full multichip dryrun
     def test_dryrun_multichip_8(self):
         """The driver contract: 8-virtual-device full training step."""
         import importlib.util

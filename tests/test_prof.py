@@ -285,6 +285,36 @@ def test_opcode_categories_modern_traces():
         assert _categorize(m.group("opcode"), text) == want_cat
 
 
+def test_by_scope_aggregates_named_scopes():
+    """TraceProfile.by_scope over synthetic op records: transform
+    wrappers (jit/transpose(jvp)/vmap) are stripped so the same
+    trace.span name aggregates under one key at the requested depth;
+    metadata-less ops land under (unscoped)."""
+    from apex_tpu.prof.xplane import OpRecord, TraceProfile
+
+    def rec(name, us, op_name=None):
+        hlo = f"%{name} = f32[8]{{0}} fusion(f32[8]{{0}} %p0)"
+        if op_name is not None:
+            hlo += f', metadata={{op_name="{op_name}"}}'
+        return OpRecord(name=name, opcode="fusion", category="fusion",
+                        occurrences=1, total_us=us, hlo=hlo)
+
+    tp = TraceProfile(path="", device="d", module_runs=1,
+                      module_total_us=0.0, ops=[
+        rec("f.1", 10.0, "jit(step)/amp/fwd/conv"),
+        rec("f.2", 5.0, "jit(step)/transpose(jvp(step))/amp/fwd/dot"),
+        rec("f.3", 2.0, "jit(step)/vmap(step)/amp/unscale/mul"),
+        rec("f.4", 1.0, "jit(step)"),          # wrappers only
+        rec("f.5", 4.0),                       # no metadata at all
+    ])
+    got = tp.by_scope(depth=2)
+    assert got["amp/fwd"] == 15.0              # fwd + its transpose
+    assert got["amp/unscale"] == 2.0
+    assert got["(unscoped)"] == 5.0            # f.4 + f.5
+    # depth=1 folds everything under the top-level scope
+    assert tp.by_scope(depth=1)["amp"] == 17.0
+
+
 _REPO_ROOT = str(__import__("pathlib").Path(__file__).resolve().parents[1])
 
 

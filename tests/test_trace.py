@@ -1,0 +1,644 @@
+"""apex_tpu.trace — spans, flight recorder, watchdog, NaN provenance.
+
+Covers the ISSUE-2 acceptance contract: the span timeline produces a
+structurally valid Chrome trace (Perfetto-loadable), a forced mid-step
+exception in a subprocess produces a crash dump naming the
+last-completed span with a valid Metrics snapshot (validated by
+``scripts/check_metrics_schema.py --kind trace``), a stalled step fires
+the hang watchdog with thread stacks, ``debug_nans`` names the first
+non-finite span, and spans/probes with the mode off add zero extra
+dispatches to the compiled step.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, monitor, trace
+from apex_tpu.optim import FusedSGD
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_SCHEMA_SCRIPT = os.path.join(_REPO_ROOT, "scripts",
+                              "check_metrics_schema.py")
+
+
+def _validate(path, kind):
+    return subprocess.run(
+        [sys.executable, _SCHEMA_SCRIPT, "--kind", kind, str(path)],
+        capture_output=True, text=True, cwd=_REPO_ROOT)
+
+
+# --- span timeline -----------------------------------------------------------
+
+def test_span_timeline_records_steps_and_nesting():
+    tracer = trace.Tracer()
+    with tracer:
+        for i in range(3):
+            with trace.step(i):
+                with trace.span("fwd"):
+                    time.sleep(0.002)
+                    with trace.span("inner"):
+                        pass
+                with trace.span("bwd"):
+                    pass
+    assert len(tracer.steps) == 3
+    st = tracer.steps[0]
+    assert [s.name for s in st.spans] == ["inner", "fwd", "bwd"]
+    fwd = next(s for s in st.spans if s.name == "fwd")
+    inner = next(s for s in st.spans if s.name == "inner")
+    assert fwd.dur_ms >= 2.0              # slept 2ms inside
+    assert inner.depth == fwd.depth + 1   # nesting tracked
+    assert st.dur_ms >= fwd.dur_ms
+    assert tracer.last_completed_span == "bwd"
+    # table has one column per span name, one row per step
+    table = tracer.timeline().table()
+    for col in ("fwd", "inner", "bwd", "total_ms"):
+        assert col in table
+    assert len(table.splitlines()) == 4
+
+
+def test_span_passive_without_tracer():
+    # no tracer entered: span still works (named_scope passthrough)
+    with trace.span("orphan"):
+        x = jnp.ones(3) * 2
+    assert trace.current_tracer() is None
+    assert float(x[0]) == 2.0
+
+
+def test_span_decorator_and_annotate_feed_timeline():
+    from apex_tpu import prof
+
+    @trace.span("work")
+    def work(x):
+        return x + 1
+
+    @prof.annotate("annotated")
+    def annotated(x):
+        return x * 2
+
+    tracer = trace.Tracer()
+    with tracer:
+        with trace.step():
+            work(jnp.ones(2))
+            annotated(jnp.ones(2))
+    names = [s.name for s in tracer.steps[0].spans]
+    assert names == ["work", "annotated"]
+
+
+def test_in_flight_collective_and_open_spans():
+    tracer = trace.Tracer()
+    with tracer:
+        with trace.step():
+            with trace.span("outer"):
+                with trace.span("allreduce", kind="collective"):
+                    assert tracer.open_spans == ["outer", "allreduce"]
+                    assert tracer.in_flight_collective == "allreduce"
+            assert tracer.in_flight_collective is None
+
+
+def test_recovered_exception_clears_in_flight():
+    """A span unwound by a caught-and-recovered exception must not be
+    reported in-flight once the step completes normally."""
+    tracer = trace.Tracer()
+    with tracer:
+        with trace.step(0):
+            try:
+                with trace.span("load", kind="collective"):
+                    raise IOError("transient")
+            except IOError:
+                pass
+            # mid-step: the aborted span IS still in flight forensically
+            assert tracer.in_flight_collective == "load"
+            with trace.span("work"):
+                pass
+        # the step completed: nothing is in flight any more
+        assert tracer.open_spans == []
+        assert tracer.in_flight_collective is None
+        assert tracer.last_completed_span == "work"
+
+
+def test_chrome_trace_is_structurally_valid(tmp_path):
+    """The Perfetto-loadability contract: JSON object with a traceEvents
+    list of complete-duration events (name/ph/ts/dur/pid/tid), as the
+    Trace Event Format requires."""
+    tracer = trace.Tracer()
+    with tracer:
+        for i in range(2):
+            with trace.step(i):
+                with trace.span("a"):
+                    with trace.span("b"):
+                        pass
+    path = tmp_path / "trace.json"
+    tracer.write_chrome_trace(str(path), rank=0)
+    ct = json.loads(path.read_text())
+    assert isinstance(ct, dict)
+    evs = ct["traceEvents"]
+    assert isinstance(evs, list) and len(evs) == 6   # 2 steps + 4 spans
+    for ev in evs:
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert ev["ph"] == "X"
+        for k in ("ts", "dur"):
+            assert isinstance(ev[k], (int, float)) and ev[k] >= 0
+        for k in ("pid", "tid"):
+            assert isinstance(ev[k], int)
+    # events nest consistently: child ts within parent [ts, ts+dur]
+    spans = [e for e in evs if e["cat"] != "step"]
+    a = [e for e in spans if e["name"] == "a"][0]
+    b = [e for e in spans if e["name"] == "b"][0]
+    assert a["ts"] <= b["ts"] <= b["ts"] + b["dur"] <= a["ts"] + a["dur"] \
+        + 1e3  # 1ms slack for clock reads
+
+
+def test_trace_schema_rejects_malformed_values():
+    from importlib import util as _util
+    spec = _util.spec_from_file_location("check_metrics_schema",
+                                        _SCHEMA_SCRIPT)
+    mod = _util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    ok = {"kind": "span", "name": "x", "step": 0, "rank": 0,
+          "t_ms": 1.0, "dur_ms": 2.0}
+    assert mod.check_trace_lines([json.dumps(ok)]) == []
+    # non-numeric duration
+    bad = dict(ok, dur_ms="fast")
+    assert mod.check_trace_lines([json.dumps(bad)])
+    # null on a non-nullable key
+    bad = dict(ok, t_ms=None)
+    assert mod.check_trace_lines([json.dumps(bad)])
+    # negative duration / unknown kind / missing required key
+    assert mod.check_trace_lines([json.dumps(dict(ok, dur_ms=-1.0))])
+    assert mod.check_trace_lines([json.dumps(dict(ok, kind="nope"))])
+    no_name = dict(ok)
+    no_name.pop("name")
+    assert mod.check_trace_lines([json.dumps(no_name)])
+
+
+def test_step_events_pass_trace_schema(tmp_path):
+    tracer = trace.Tracer()
+    with tracer:
+        for i in range(2):
+            with trace.step(i):
+                with trace.span("s"):
+                    pass
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        for ev in tracer.step_events(rank=0) + tracer.span_events(rank=0):
+            f.write(json.dumps(ev) + "\n")
+    r = _validate(path, "trace")
+    assert r.returncode == 0, r.stderr
+
+
+def test_metrics_logger_trace_event_channel(tmp_path):
+    events = tmp_path / "events.jsonl"
+    logger = monitor.MetricsLogger(
+        sinks=[], trace_sink=monitor.JSONLSink(str(events)))
+    tracer = trace.Tracer()
+    tracer.subscribe(lambda st: logger.record_event(st.to_event(0)))
+    with tracer:
+        with trace.step(7):
+            with trace.span("x"):
+                pass
+    logger.close()
+    recs = [json.loads(l) for l in events.read_text().splitlines()]
+    assert len(recs) == 1
+    assert recs[0]["kind"] == "step" and recs[0]["step"] == 7
+    assert recs[0]["spans"][0]["name"] == "x"
+    assert _validate(events, "trace").returncode == 0
+
+
+# --- MetricsLogger crash-safety (satellite) ----------------------------------
+
+def test_metrics_logger_flushes_buffered_tail_on_exception(tmp_path):
+    jsonl = tmp_path / "m.jsonl"
+    with pytest.raises(RuntimeError):
+        with monitor.MetricsLogger(
+                sinks=[monitor.JSONLSink(str(jsonl))],
+                flush_every=100) as logger:
+            m = monitor.metrics_init().count_step(jnp.bool_(True))
+            logger.record(m)           # buffered, below flush_every
+            raise RuntimeError("mid-run crash")
+    lines = jsonl.read_text().splitlines()
+    assert len(lines) == 1             # the tail reached the sink
+    assert json.loads(lines[0])["step"] == 1
+
+
+def test_metrics_logger_atexit_flush_in_subprocess(tmp_path):
+    jsonl = tmp_path / "m.jsonl"
+    child = textwrap.dedent(f"""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        from apex_tpu import monitor
+        logger = monitor.MetricsLogger(
+            sinks=[monitor.JSONLSink({str(jsonl)!r})], flush_every=100)
+        m = monitor.metrics_init().count_step(jnp.bool_(True))
+        logger.record(m)
+        # no close(): the atexit hook must flush the buffered record
+    """)
+    r = subprocess.run([sys.executable, "-c", child], cwd=_REPO_ROOT,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    assert len(jsonl.read_text().splitlines()) == 1
+
+
+# --- NaN provenance ----------------------------------------------------------
+
+def test_debug_nans_names_first_bad_span():
+    trace.reset_nan_state()
+    with trace.debug_nans():
+        @jax.jit
+        def f(x):
+            a = trace.nan_probe("scale", x * 2)         # finite
+            b = trace.nan_probe("log", jnp.log(-a))     # nan
+            return trace.nan_probe("sum", jnp.sum(b))   # nan too
+
+        out = f(jnp.ones(4))
+        jax.block_until_ready(out)
+    hit = trace.first_nan()
+    assert hit is not None and hit["span"] == "log"
+    trace.reset_nan_state()
+    assert trace.first_nan() is None
+
+
+def test_debug_nans_off_is_identity_and_dispatch_free():
+    def traced(w, x):
+        with trace.span("fwd"):
+            h = jnp.tanh(x @ w)
+        h = trace.nan_probe("fwd", h)
+        return trace.nan_probe("loss", jnp.sum(h * h))
+
+    def plain(w, x):
+        h = jnp.tanh(x @ w)
+        return jnp.sum(h * h)
+
+    w = jnp.ones((8, 4)) * 0.1
+    x = jnp.ones((2, 8))
+    n_t, host_t = monitor.module_count_and_host_ops(
+        jax.jit(traced), w, x)
+    n_p, _ = monitor.module_count_and_host_ops(jax.jit(plain), w, x)
+    assert n_t == n_p
+    assert host_t == [], host_t
+    # and ON compiles real host callbacks (the guard is load-bearing).
+    # The flag is read at trace time and jax caches traces per function
+    # object — exactly the documented caveat — so drop the cached trace
+    with trace.debug_nans():
+        jax.clear_caches()
+        _, host_on = monitor.module_count_and_host_ops(
+            jax.jit(traced), w, x)
+    assert host_on
+    trace.reset_nan_state()
+
+
+def test_amp_builtin_probes_name_fwd_span():
+    """A loss that is non-finite at the forward pass must be attributed
+    to amp/fwd — the built-in provenance of the amp step."""
+    trace.reset_nan_state()
+    params = {"w": jnp.full((4, 2), 0.5, jnp.float32)}
+    amp_opt, state = amp.initialize(params, FusedSGD(lr=0.1), "O2",
+                                    half_dtype=jnp.float16, verbosity=0)
+    x = jnp.ones((4, 4), jnp.float32)
+
+    with trace.debug_nans():
+        @jax.jit
+        def step(state):
+            def loss_fn(p):
+                return jnp.log(-jnp.abs(jnp.mean(x @ p["w"])))   # nan
+            state, loss, finite = amp_opt.step(state, loss_fn)
+            return state, loss
+
+        state, loss = step(state)
+        jax.block_until_ready(loss)
+    hit = trace.first_nan()
+    assert hit is not None and hit["span"] == "amp/fwd", hit
+    trace.reset_nan_state()
+
+
+# --- flight recorder ---------------------------------------------------------
+
+def test_recorder_ring_is_bounded_and_ranked_path(tmp_path):
+    rec = trace.FlightRecorder(str(tmp_path / "c.jsonl"), capacity=3)
+    for i in range(10):
+        rec.record(step=i, dur_ms=1.0, spans=[("s", 0.5)])
+    p = rec.dump(reason="manual")
+    lines = [json.loads(l) for l in open(p)]
+    assert lines[0]["kind"] == "crash"
+    steps = [l["step"] for l in lines[1:]]
+    assert steps == [7, 8, 9]            # only the last `capacity` kept
+    # rank_path: identity single-process, ranked when explicit
+    assert trace.rank_path("a/b.jsonl", rank=3) == "a/b.rank3.jsonl"
+    assert trace.rank_path(str(tmp_path / "x.jsonl")) == \
+        str(tmp_path / "x.jsonl")
+
+
+def test_recorder_dump_passes_trace_schema(tmp_path):
+    tracer = trace.Tracer()
+    rec = trace.FlightRecorder(str(tmp_path / "c.jsonl"), tracer=tracer,
+                               collective_bytes=4096)
+    m = monitor.metrics_init().count_step(jnp.bool_(True))
+    with tracer:
+        with trace.step(0):
+            with trace.span("fwd"):
+                pass
+        rec.record_metrics(m)
+    p = rec.dump(reason="manual")
+    r = _validate(p, "trace")
+    assert r.returncode == 0, r.stderr
+    lines = [json.loads(l) for l in open(p)]
+    assert lines[0]["last_completed_span"] == "fwd"
+    step_rec = lines[1]
+    assert step_rec["metrics"]["step"] == 1
+    assert step_rec["collective_bytes"] == 4096
+
+
+_CRASH_CHILD = textwrap.dedent("""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import sys
+    import jax.numpy as jnp
+    from apex_tpu import amp, trace
+    from apex_tpu.optim import FusedSGD
+
+    tracer = trace.Tracer()
+    rec = trace.FlightRecorder(sys.argv[1], capacity=8, tracer=tracer)
+    rec.install()
+
+    params = {"w": jnp.full((4, 2), 0.5, jnp.float32)}
+    amp_opt, state = amp.initialize(params, FusedSGD(lr=0.1), "O1",
+                                    verbosity=0, monitor=True)
+    x = jnp.ones((4, 4), jnp.float32)
+
+    @jax.jit
+    def step(state):
+        def loss_fn(p):
+            return jnp.mean(x @ p["w"])
+        state, loss, _ = amp_opt.step(state, loss_fn)
+        return state, loss
+
+    with tracer:
+        for i in range(3):
+            with trace.step(i):
+                with trace.span("dispatch"):
+                    state, loss = step(state)
+                with trace.span("fetch"):
+                    float(loss)
+                rec.record_metrics(state.metrics)
+        # step 3 dies mid-step, after fwd completed, inside bwd
+        with trace.step(3):
+            with trace.span("fwd"):
+                pass
+            with trace.span("bwd"):
+                raise RuntimeError("boom mid-step")
+""")
+
+
+def test_forced_midstep_exception_dumps_crash_report(tmp_path):
+    """The acceptance case (single-process half): a raise mid-step
+    leaves a crash dump whose header names the last-completed span and
+    the in-flight one, whose step records carry valid Metrics
+    snapshots, and which passes the trace schema validator."""
+    dump = tmp_path / "crash.jsonl"
+    r = subprocess.run([sys.executable, "-c", _CRASH_CHILD, str(dump)],
+                       cwd=_REPO_ROOT, capture_output=True, text=True,
+                       timeout=240)
+    assert r.returncode != 0                      # it crashed...
+    assert "boom mid-step" in r.stderr            # ...loudly (hook chained)
+    assert dump.exists(), r.stderr
+    lines = [json.loads(l) for l in dump.read_text().splitlines()]
+    hdr = lines[0]
+    assert hdr["kind"] == "crash" and hdr["reason"] == "exception"
+    assert hdr["last_completed_span"] == "fwd"    # fwd done, bwd open
+    assert "bwd" in hdr["in_flight_spans"]
+    assert "RuntimeError" in hdr["exception"]
+    assert hdr["traceback"]
+    # buffered steps carry fetched Metrics snapshots; the dying step is
+    # recorded too, flagged aborted
+    steps = [l for l in lines[1:] if l["kind"] == "step"]
+    assert len(steps) == 4
+    assert [s["metrics"]["step"] for s in steps[:3]] == [1, 2, 3]
+    assert all(s["metrics"]["loss_scale"] is not None for s in steps[:3])
+    assert steps[3]["aborted"] is True and steps[3].get("metrics") is None
+    # the artifact validates
+    assert _validate(dump, "trace").returncode == 0
+
+
+# --- hang watchdog -----------------------------------------------------------
+
+def test_watchdog_fires_on_stalled_step_and_dump_validates(tmp_path):
+    tracer = trace.Tracer()
+    rec = trace.FlightRecorder(str(tmp_path / "c.jsonl"), tracer=tracer)
+    fired = []
+    wd = trace.HangWatchdog(0.15, recorder=rec, tracer=tracer,
+                            path=str(tmp_path / "hang.jsonl"),
+                            on_fire=fired.append, poll_s=0.02)
+    with tracer:
+        with wd:
+            # two healthy steps, then a stall longer than the deadline
+            for i in range(2):
+                with trace.step(i):
+                    with trace.span("work"):
+                        pass
+            assert wd.fire_count == 0
+            time.sleep(0.5)              # artificially stalled step
+    assert wd.fire_count == 1            # fired once, not per poll
+    ev = fired[0]
+    assert ev["kind"] == "watchdog"
+    assert ev["last_step"] == 1
+    assert ev["seconds_since_last_step"] >= 0.15
+    assert ev["silent_ranks"] == [ev["rank"]]
+    assert ev["last_completed_span"] == "work"
+    # the stack dump contains this (stalled) test frame
+    stacks = "\n".join("\n".join(v) for v in ev["stacks"].values())
+    assert "test_watchdog_fires_on_stalled_step" in stacks
+    assert _validate(tmp_path / "hang.jsonl", "trace").returncode == 0
+
+
+def test_watchdog_path_not_double_ranked_and_skips_device_fetch(tmp_path):
+    """The derived hang path must not re-apply the rank suffix the
+    recorder's path already carries, and the hang dump must not fetch
+    device metrics (a device_get against a wedged runtime blocks the
+    watchdog thread forever)."""
+    ranked = str(tmp_path / "crash.rank0.jsonl")   # as on a multi-host run
+    rec = trace.FlightRecorder(ranked)
+    rec.record(step=0, metrics=monitor.metrics_init())
+    wd = trace.HangWatchdog(30.0, recorder=rec)
+    assert wd.path == str(tmp_path / "crash.rank0.hang.jsonl")
+    wd.fire(idle_s=31.0)                           # manual fire, no thread
+    lines = [json.loads(l) for l in open(wd.path)]
+    step_rec = [l for l in lines if l["kind"] == "step"][0]
+    assert step_rec["metrics"] is None             # buffered, NOT fetched
+    assert step_rec["metrics_error"]
+    assert _validate(wd.path, "trace").returncode == 0
+
+
+def test_watchdog_rearms_after_heartbeat_resumes(tmp_path):
+    wd = trace.HangWatchdog(0.1, path=str(tmp_path / "h.jsonl"),
+                            poll_s=0.02)
+    wd.start()
+    time.sleep(0.3)
+    assert wd.fire_count == 1
+    wd.notify_step(5)                    # heartbeat resumes
+    time.sleep(0.3)                      # second stall
+    wd.stop()
+    assert wd.fire_count == 2
+    ev = json.loads(open(tmp_path / "h.jsonl").readline())
+    assert ev["last_step"] == 5
+
+
+# --- multi-process acceptance case -------------------------------------------
+
+_MP_CHILD = textwrap.dedent("""
+    import os, sys
+    import jax
+    from apex_tpu import _compat
+    jax.config.update("jax_platforms", "cpu")
+    _compat.request_cpu_devices(2)
+
+    from apex_tpu.parallel.launch import distributed_init, \\
+        enable_crash_dumps
+
+    distributed_init()
+    assert jax.process_count() == 2, jax.process_count()
+    rank = jax.process_index()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+    from apex_tpu import parallel, trace
+    from apex_tpu.parallel import DistributedDataParallel
+
+    tracer, rec, _wd = enable_crash_dumps(sys.argv[1], capacity=8)
+
+    mesh = parallel.data_parallel_mesh()
+    ddp = DistributedDataParallel(mesh)
+
+    def step(w, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        g = ddp.sync(g)
+        return w - 0.1 * g, jax.lax.pmean(loss, ddp.axis_name)
+
+    spmd = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(parallel.DATA_AXIS), P(parallel.DATA_AXIS)),
+        out_specs=(P(), P()), check_vma=False))
+
+    np_rng = np.random.RandomState(0)
+    w = jnp.asarray(np_rng.randn(8, 1), jnp.float32)
+    xg = np_rng.randn(16, 8).astype("float32")
+    yg = np_rng.randn(16, 1).astype("float32")
+
+    try:
+        xs = jax.device_put(xg, parallel.batch_sharding(mesh))
+        ys = jax.device_put(yg, parallel.batch_sharding(mesh))
+
+        def dispatch(w):
+            return spmd(w, xs, ys)
+
+        w2, loss = dispatch(w)
+        float(loss)
+        w = w2
+        start = 1
+    except Exception as e:
+        if "Multiprocess computations aren't implemented" not in str(e):
+            raise
+        # this CPU backend can form the 2-process cluster but cannot run
+        # cross-process programs; the crash-dump contract under test
+        # (per-rank files, rank tagging, span forensics) doesn't need
+        # the psum — fall back to a process-local step
+        local = jax.jit(lambda w: (
+            w - 0.1 * jax.grad(lambda w: jnp.mean((xg @ w - yg) ** 2))(w),
+            jnp.mean((xg @ w - yg) ** 2)))
+
+        def dispatch(w):
+            return local(w)
+        start = 0
+
+    with tracer:
+        for i in range(start, 2):
+            with trace.step(i):
+                with trace.span("dispatch"):
+                    w, loss = dispatch(w)
+                with trace.span("fetch"):
+                    float(loss)
+        with trace.step(2):
+            with trace.span("dispatch"):
+                w, loss = dispatch(w)
+            raise RuntimeError(f"forced mid-step crash on rank {rank}")
+""")
+
+
+@pytest.mark.slow
+def test_two_process_crash_produces_per_rank_dumps(tmp_path):
+    """The ISSUE-2 acceptance case: a forced mid-step exception in a
+    2-process parallel.launch run produces per-rank crash dumps that
+    name the last-completed span and pass the extended schema
+    validator."""
+    import socket
+
+    def _free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    base = tmp_path / "crash.jsonl"
+    env_base = {
+        **os.environ,
+        "MASTER_ADDR": "127.0.0.1",
+        "MASTER_PORT": str(_free_port()),
+        "WORLD_SIZE": "2",
+        "JAX_PLATFORMS": "cpu",
+        "TF_CPP_MIN_LOG_LEVEL": "2",
+    }
+    procs = []
+    for rank in range(2):
+        env = {**env_base, "RANK": str(rank)}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MP_CHILD, str(base)], env=env,
+            cwd=_REPO_ROOT, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("two-process crash run timed out:\n"
+                    + "\n---\n".join(o or "" for o in outs))
+    joined = "\n---rank-output---\n".join(outs)
+    if not all("forced mid-step crash" in o for o in outs):
+        # cluster bring-up unsupported here (same policy as
+        # test_multiproc_launch) — but only when the failure is
+        # environmental, never when our code broke
+        if any(s in joined for s in ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                                     "Permission denied", "unreachable")):
+            pytest.skip(f"cluster bring-up unsupported here:\n{joined}")
+        pytest.fail(f"children did not reach the forced crash:\n{joined}")
+    for rank in range(2):
+        dump = tmp_path / f"crash.rank{rank}.jsonl"
+        assert dump.exists(), (f"rank {rank} wrote no dump\n{joined}\n"
+                               f"{os.listdir(tmp_path)}")
+        lines = [json.loads(l) for l in dump.read_text().splitlines()]
+        hdr = lines[0]
+        assert hdr["kind"] == "crash" and hdr["rank"] == rank
+        assert hdr["process_count"] == 2
+        assert hdr["last_completed_span"] == "dispatch"
+        assert f"rank {rank}" in hdr["exception"]
+        steps = [l for l in lines[1:] if l["kind"] == "step"]
+        # at least one completed step, then the aborted step 2
+        assert len(steps) >= 2 and steps[-1]["step"] == 2
+        assert steps[-1].get("aborted") is True
+        assert all(not s.get("aborted") for s in steps[:-1])
+        assert _validate(dump, "trace").returncode == 0, dump
