@@ -72,7 +72,19 @@ def _fingerprint(root: str, paths, labels) -> str:
                 stats[e.path] = e.stat()
     h = hashlib.sha256()
     for p, y in zip(paths, labels):
-        st = stats[p]
+        st = stats.get(p)
+        if st is None:
+            # deleted/renamed between the listing and this sweep, or a
+            # path-normalization mismatch with the scandir key: fall
+            # back to a direct stat; a file that is truly gone
+            # fingerprints as absent (so the cache rebuilds) instead of
+            # raising KeyError on the warm-check path (ADVICE r5)
+            try:
+                st = os.stat(p)
+            except OSError:
+                h.update(os.path.relpath(p, root).encode())
+                h.update(b"\0%d\0missing\n" % int(y))
+                continue
         h.update(os.path.relpath(p, root).encode())
         h.update(b"\0%d\0%d\0%d\n" % (int(y), st.st_size,
                                       st.st_mtime_ns))

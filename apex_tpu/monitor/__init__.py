@@ -18,7 +18,9 @@ Two halves (see docs/monitoring.md):
 from apex_tpu.monitor.check import module_count_and_host_ops
 from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
                                           collective_bytes,
-                                          collective_bytes_from_text)
+                                          collective_bytes_by_dtype,
+                                          collective_bytes_from_text,
+                                          wire_report)
 from apex_tpu.monitor.logger import MetricsLogger
 from apex_tpu.monitor.metrics import (METRIC_FIELDS, Metrics, metrics_init,
                                       metrics_to_dict)
@@ -29,5 +31,6 @@ __all__ = [
     "MetricsLogger",
     "Sink", "StdoutSink", "JSONLSink", "CSVSink",
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
+    "collective_bytes_by_dtype", "wire_report",
     "module_count_and_host_ops",
 ]

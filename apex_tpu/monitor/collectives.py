@@ -23,7 +23,8 @@ from apex_tpu.prof import hlo as _hlo
 from apex_tpu.prof import xplane as _xplane
 
 __all__ = ["COLLECTIVE_OPCODES", "collective_bytes",
-           "collective_bytes_from_text"]
+           "collective_bytes_from_text", "collective_bytes_by_dtype",
+           "wire_report"]
 
 # The canonical prefix list lives next to the trace categorizer so live
 # accounting and post-hoc attribution bucket opcodes identically.
@@ -34,7 +35,8 @@ def collective_bytes_from_text(hlo_text: str) -> Dict[str, int]:
     """Sum collective result bytes per opcode over an optimized-HLO dump.
 
     Returns ``{opcode: bytes, ..., "total": bytes}`` (opcodes with zero
-    traffic are omitted; ``total`` is always present).
+    traffic are omitted; ``total`` is always present). A thin rollup of
+    :func:`collective_bytes_by_dtype` — one scan, two views.
 
     Known limit: each instruction is counted ONCE — a collective inside
     a ``while``/``scan`` body (e.g. a per-microbatch psum) executes
@@ -43,7 +45,20 @@ def collective_bytes_from_text(hlo_text: str) -> Dict[str, int]:
     loop (the usual accumulate-then-sync pattern) or scale the estimate
     by the trip count yourself.
     """
-    totals: Dict[str, int] = {}
+    totals = {op: sum(per.values())
+              for op, per in collective_bytes_by_dtype(hlo_text).items()}
+    totals["total"] = sum(totals.values())
+    return totals
+
+
+def collective_bytes_by_dtype(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Collective result bytes per opcode, split per wire dtype:
+    ``{opcode: {dtype: bytes}}``. The breakdown is what makes compressed
+    collectives auditable — a ``compress="bf16"`` DDP step shows its
+    grad traffic under ``{"all-reduce": {"bf16": ...}}`` while the
+    logical gradient is fp32. Async ``-start`` halves are skipped
+    (counted at the matching ``-done``)."""
+    out: Dict[str, Dict[str, int]] = {}
     for raw in hlo_text.splitlines():
         line = raw.strip()
         m = _hlo._INSTR_RE.match(line)
@@ -54,11 +69,45 @@ def collective_bytes_from_text(hlo_text: str) -> Dict[str, int]:
             if op.startswith(prefix):
                 if op.endswith("-start"):
                     break  # counted at the matching -done
-                _, nbytes = _hlo._shape_elems_bytes(m.group("shape"))
-                totals[prefix] = totals.get(prefix, 0) + nbytes
+                for dt, dims in _hlo._SHAPE_RE.findall(m.group("shape")):
+                    if dt not in _hlo._DTYPE_BYTES:
+                        continue
+                    elems = 1
+                    for d in dims.split(","):
+                        if d:
+                            elems *= int(d)
+                    slot = out.setdefault(prefix, {})
+                    slot[dt] = slot.get(dt, 0) + elems * \
+                        _hlo._DTYPE_BYTES[dt]
                 break
-    totals["total"] = sum(totals.values())
-    return totals
+    return out
+
+
+def wire_report(fn=None, *args, hlo_text: Optional[str] = None,
+                logical_bytes: Optional[int] = None, **kwargs) -> Dict:
+    """Logical-vs-wire collective accounting for one compiled step.
+
+    ``logical_bytes`` is the uncompressed payload the step *semantically*
+    moves (e.g. ``4 * n_params`` for an fp32 grad sync); the wire bytes
+    come from the optimized HLO's collective result shapes. Returns::
+
+        {"wire_bytes": int, "by_opcode": {op: {dtype: bytes}},
+         "logical_bytes": int | None, "wire_to_logical": float | None}
+
+    A bucketed+``compress="bf16"`` DDP step reports
+    ``wire_to_logical ≈ 0.5`` — the number the acceptance audit pins
+    (tests/test_pod_hlo.py) and the uncompressed baseline DynamiQ-style
+    collectives are judged against.
+    """
+    if hlo_text is None:
+        if fn is None:
+            raise ValueError("pass a step function or hlo_text=")
+        hlo_text = _hlo.compiled_hlo(fn, *args, **kwargs)
+    by_op = collective_bytes_by_dtype(hlo_text)
+    wire = sum(b for per in by_op.values() for b in per.values())
+    ratio = (wire / logical_bytes) if logical_bytes else None
+    return {"wire_bytes": wire, "by_opcode": by_op,
+            "logical_bytes": logical_bytes, "wire_to_logical": ratio}
 
 
 def collective_bytes(fn=None, *args, hlo_text: Optional[str] = None,

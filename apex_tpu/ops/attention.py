@@ -1724,10 +1724,9 @@ def mask_softmax_dropout(scores, mask=None, dropout_rate=0.0,
 
 # --- lse-returning variant (sequence-parallel building block) ---------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
                         block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K,
-                        dropout_rate=0.0, dropout_seed=None,
+                        *, dropout_rate=0.0, dropout_seed=None,
                         causal_offset=None, dropout_block_offset=None):
     """Like :func:`flash_attention` but returns ``(out, lse)`` with
     ``lse`` (B, H, Sq) differentiable — the building block ring attention
@@ -1742,7 +1741,24 @@ def flash_attention_lse(q, k, v, bias=None, scale=None, causal=False,
     same global coordinates (ring hops pass their ring position; the
     reference's fused dropout has no distributed counterpart,
     `apex/contrib/csrc/multihead_attn/dropout.h:1-308`).
+
+    ``dropout_rate``/``dropout_seed``/``causal_offset``/
+    ``dropout_block_offset`` are keyword-only: they were inserted ahead
+    of ``causal_offset`` historically, so a positional caller would
+    silently bind an offset to ``dropout_rate`` — now it fails loudly
+    at the call site (ADVICE r5).
     """
+    return _flash_attention_lse(q, k, v, bias, scale, causal, block_q,
+                                block_k, dropout_rate, dropout_seed,
+                                causal_offset, dropout_block_offset)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_attention_lse(q, k, v, bias, scale, causal, block_q, block_k,
+                         dropout_rate, dropout_seed, causal_offset,
+                         dropout_block_offset):
+    # positional custom_vjp core — custom_vjp cannot resolve
+    # keyword-only parameters, hence the public wrapper above
     (o, lse), _ = _fal_fwd(q, k, v, bias, scale, causal, block_q,
                            block_k, dropout_rate, dropout_seed,
                            causal_offset, dropout_block_offset)
@@ -1825,4 +1841,4 @@ def _fal_bwd(scale, causal, block_q, block_k, dropout_rate, res, cot):
             None)
 
 
-flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)
+_flash_attention_lse.defvjp(_fal_fwd, _fal_bwd)

@@ -490,7 +490,12 @@ class FusedBNAct(nn.Module):
     init_scale: float = 1.0
     dtype: Optional[Any] = None
     #: fp8 backward-only residuals (or env APEX_TPU_FP8_RESIDUALS=1 at
-    #: trace time); see _Cfg.fp8
+    #: trace time); see _Cfg.fp8. Caveat (ADVICE r5): with ReLU the
+    #: backward re-derives the activation mask from the *quantized* x̂,
+    #: so activations within ~one e4m3 quantum of the y==0 boundary can
+    #: receive gradients through a flipped mask — an extra noise source
+    #: beyond the quantization noise itself. Fine for the opt-in
+    #: memory-bandwidth experiment; don't expect bitwise-stable masks.
     fp8_residuals: bool = False
 
     @nn.compact

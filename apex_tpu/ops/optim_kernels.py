@@ -168,7 +168,7 @@ def adagrad_update(p, g, h, *, lr, eps=1e-10, weight_decay=0.0,
 
 def _lamb_stage1_kernel(adam_w, scalars, p_ref, g_ref, m_ref, v_ref,
                         u_ref, mo_ref, vo_ref):
-    b1, b2, eps, wd, bc1, bc2, clip = (scalars[i] for i in range(7))
+    b1, b2, eps, wd, bc1, bc2, clip, b3 = (scalars[i] for i in range(8))
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32) * clip   # global-norm clip folded in
     m = m_ref[:].astype(jnp.float32)
@@ -176,7 +176,7 @@ def _lamb_stage1_kernel(adam_w, scalars, p_ref, g_ref, m_ref, v_ref,
 
     if not adam_w:
         g = g + wd * p
-    m = b1 * m + (1.0 - b1) * g
+    m = b1 * m + b3 * g
     v = b2 * v + (1.0 - b2) * g * g
     u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
     if adam_w:
@@ -188,21 +188,27 @@ def _lamb_stage1_kernel(adam_w, scalars, p_ref, g_ref, m_ref, v_ref,
 
 
 def lamb_stage1(p, g, m, v, *, beta1, beta2, eps, weight_decay, step,
-                bias_correction=True, adam_w_mode=True, clip_scale=1.0):
+                bias_correction=True, adam_w_mode=True, clip_scale=1.0,
+                grad_averaging=True):
     """Stage 1: Adam-style update direction ``u`` (fp32) + new m, v.
 
     ``clip_scale`` pre-scales grads by ``max_grad_norm/global_norm`` when
     clipping is active (the reference computes the global norm with
-    `multi_tensor_l2norm` first, `fused_lamb.py:120-136`)."""
+    `multi_tensor_l2norm` first, `fused_lamb.py:120-136`).
+    ``grad_averaging=False`` accumulates raw grads into the first moment
+    (``m = β1·m + g`` instead of ``β1·m + (1−β1)·g``) — the reference's
+    ``grad_averaging`` knob (`multi_tensor_lamb.cu:60-63`, the same
+    ``beta3`` NovoGrad exposes)."""
     step = jnp.asarray(step, jnp.float32)
     if bias_correction:
         bc1 = 1.0 - jnp.power(jnp.float32(beta1), step)
         bc2 = 1.0 - jnp.power(jnp.float32(beta2), step)
     else:
         bc1 = bc2 = jnp.float32(1.0)
+    b3 = (1.0 - beta1) if grad_averaging else 1.0
     scalars = jnp.stack([jnp.asarray(s, jnp.float32) for s in
                          (beta1, beta2, eps, weight_decay, bc1, bc2,
-                          clip_scale)])
+                          clip_scale, b3)])
     kernel = functools.partial(_lamb_stage1_kernel, adam_w_mode)
     return _launch(kernel, [p, g, m, v],
                    [jnp.float32, m.dtype, v.dtype], scalars)

@@ -7,6 +7,8 @@ the data axis, the fused update runs on each device's **shard** of the
 fp32 master/momentum arena, and the new parameters come back with one
 **all-gather** — optionally in a compressed dtype (the reference's e5m2
 all-gather; here any jnp dtype incl. ``float8_e5m2``/``bfloat16``).
+The inbound reduce-scatter can be compressed the same way
+(``grad_scatter_dtype=jnp.bfloat16`` halves the grad-side ICI bytes).
 
 What the reference engineers by hand maps to mesh/XLA machinery:
 
@@ -84,14 +86,22 @@ def _padded_len(n: int, world: int) -> int:
     return per * world
 
 
-def _reduce_scatter_mean(buf, axis_name: Axis, world: int):
+def _reduce_scatter_mean(buf, axis_name: Axis, world: int,
+                         wire_dtype=None):
     """Mean-reducing scatter over (possibly nested) axes: scatter each
     axis in order, so device (i0, i1, ...) ends with tile
     i0·n1·… + i1·… (axis-major) — the intra/inter-group pipeline of
-    `_pipeline_block_reductions` (`distributed_fused_adam.py:319-341`)."""
-    out = buf
+    `_pipeline_block_reductions` (`distributed_fused_adam.py:319-341`).
+
+    ``wire_dtype`` compresses the scatter's wire format (e.g.
+    ``jnp.bfloat16`` halves ICI bytes, the grad-side sibling of the
+    ``param_gather_dtype`` compressed all-gather); the result is cast
+    back to the input dtype before the mean division."""
+    out = buf if wire_dtype is None else buf.astype(wire_dtype)
     for a in _axes(axis_name):
         out = jax.lax.psum_scatter(out, a, scatter_dimension=0, tiled=True)
+    if wire_dtype is not None:
+        out = out.astype(buf.dtype)
     return out / world
 
 
@@ -119,7 +129,7 @@ class DistributedFusedAdam(FusedOptimizer):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
                  weight_decay=0.0, adam_w_mode=True, bias_correction=True,
                  axis_name: Axis = "data", max_grad_norm: float = 0.0,
-                 param_gather_dtype=None):
+                 param_gather_dtype=None, grad_scatter_dtype=None):
         super().__init__(lr)
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -129,6 +139,11 @@ class DistributedFusedAdam(FusedOptimizer):
         self.axis_name = axis_name
         self.max_grad_norm = max_grad_norm
         self.param_gather_dtype = param_gather_dtype
+        #: wire dtype of the grad reduce-scatter (e.g. ``jnp.bfloat16``
+        #: halves the inbound ICI bytes; masters/moments stay fp32). No
+        #: error feedback on this path — the fp32 master update absorbs
+        #: the per-step rounding like any bf16-grad training run.
+        self.grad_scatter_dtype = grad_scatter_dtype
 
     # -- sharding helpers ----------------------------------------------------
 
@@ -143,8 +158,9 @@ class DistributedFusedAdam(FusedOptimizer):
         out = {}
         for part in spec.partitions:
             g = self._pad_full(g_bufs[part.dtype], part.buffer_len, world)
-            out[part.dtype] = _reduce_scatter_mean(g, self.axis_name,
-                                                   world)
+            out[part.dtype] = _reduce_scatter_mean(
+                g, self.axis_name, world,
+                wire_dtype=self.grad_scatter_dtype)
         return out
 
     # -- state ---------------------------------------------------------------
@@ -233,12 +249,14 @@ class DistributedFusedLAMB(DistributedFusedAdam):
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
                  weight_decay=0.01, adam_w_mode=True, bias_correction=True,
                  axis_name: Axis = "data", max_grad_norm: float = 1.0,
-                 use_nvlamb: bool = False, param_gather_dtype=None):
+                 use_nvlamb: bool = False, param_gather_dtype=None,
+                 grad_scatter_dtype=None):
         super().__init__(lr=lr, betas=betas, eps=eps,
                          weight_decay=weight_decay, adam_w_mode=adam_w_mode,
                          bias_correction=bias_correction,
                          axis_name=axis_name, max_grad_norm=max_grad_norm,
-                         param_gather_dtype=param_gather_dtype)
+                         param_gather_dtype=param_gather_dtype,
+                         grad_scatter_dtype=grad_scatter_dtype)
         self.use_nvlamb = use_nvlamb
 
     def _per_tensor_sq(self, buf, part, world):

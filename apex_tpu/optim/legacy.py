@@ -157,13 +157,17 @@ class FusedLAMB(_LegacyFused):
 
     def __init__(self, lr: Scalar = 1e-3, bias_correction=True,
                  betas=(0.9, 0.999), eps=1e-6, weight_decay=0.01,
-                 adam_w_mode=True, max_grad_norm=1.0, use_nvlamb=False):
+                 adam_w_mode=True, grad_averaging=True,
+                 max_grad_norm=1.0, use_nvlamb=False):
         self.lr = lr
         self.bias_correction = bias_correction
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
         self.adam_w_mode = adam_w_mode
+        #: reference contrib FusedLAMB knob (`fused_lamb.py:45-47`):
+        #: False accumulates raw grads into m (β3 = 1)
+        self.grad_averaging = grad_averaging
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
 
@@ -188,7 +192,8 @@ class FusedLAMB(_LegacyFused):
             beta2=self.beta2, eps=self.eps,
             weight_decay=self.weight_decay, step=count,
             bias_correction=self.bias_correction,
-            adam_w_mode=self.adam_w_mode, clip_scale=ctx)
+            adam_w_mode=self.adam_w_mode, clip_scale=ctx,
+            grad_averaging=self.grad_averaging)
         ratio_pos = fused.lamb_trust_ratios(
             part, p, u, use_nvlamb=self.use_nvlamb,
             weight_decay=self.weight_decay)

@@ -12,6 +12,10 @@ from apex_tpu.parallel.mesh import (
     make_mesh, data_parallel_mesh, hierarchical_data_mesh,
     replicated, batch_sharding, axis_size, local_batch,
 )
+from apex_tpu.parallel.comm import (
+    bucket_plan, bucket_table, bucketed_all_reduce, init_residual,
+    wire_bytes,
+)
 from apex_tpu.parallel.distributed import (
     DistributedDataParallel, Reducer, sync_gradients, flat_all_reduce,
     flat_tree_all_reduce,
@@ -34,6 +38,8 @@ __all__ = [
     "replicated", "batch_sharding", "axis_size", "local_batch",
     "DistributedDataParallel", "Reducer", "sync_gradients",
     "flat_all_reduce", "flat_tree_all_reduce", "replicate",
+    "bucket_plan", "bucket_table", "bucketed_all_reduce",
+    "init_residual", "wire_bytes",
     "LARC", "larc_rewrite_grads",
     "distributed_init", "enable_crash_dumps", "is_distributed",
     "process_index", "process_count", "maybe_print",

@@ -26,7 +26,15 @@ compiler's job. What survives as API are the *semantic* knobs:
   (``xla_gpu_all_reduce_combine_threshold_bytes`` — the DebugOptions field
   is shared across backends). Backends whose compile service rejects
   option overrides (e.g. the axon tunnel) fall back to default combining
-  with a one-time warning.
+  with a one-time warning. With ``bucket_allreduce=True`` the same knob
+  sizes *explicit* buckets instead: ``allreduce_bucket`` parity
+  (`distributed.py:425-475`) — one psum per reverse-parameter-order
+  bucket, chained so the latency-hiding scheduler overlaps each bucket's
+  all-reduce with the remaining backward (see
+  :mod:`apex_tpu.parallel.comm`).
+- ``compress`` (``"bf16"`` / ``"int8"``): compressed collectives with an
+  optional error-feedback residual — capability the reference never had
+  (EQuARX/DynamiQ lineage), see :func:`comm.bucketed_all_reduce`.
 
 ``Reducer`` (`distributed.py:89-126`) survives as the manual-trigger
 average; ``flat_dist_call`` (`distributed.py:26-49`) as ``flat_all_reduce``
@@ -178,10 +186,24 @@ class DistributedDataParallel:
                  allreduce_always_fp32: bool = False,
                  delay_allreduce: bool = False,
                  message_size: Optional[int] = None,
-                 grad_dtype=None):
+                 grad_dtype=None,
+                 bucket_allreduce: bool = False,
+                 compress: Optional[str] = None,
+                 compress_block: Optional[int] = None):
+        from apex_tpu.parallel import comm as _comm
         if axis_name not in mesh.axis_names:
             raise ValueError(f"axis {axis_name!r} not in mesh "
                              f"{mesh.axis_names}")
+        if compress not in _comm.COMPRESS_MODES:
+            raise ValueError(f"compress must be one of "
+                             f"{_comm.COMPRESS_MODES}, got {compress!r}")
+        if compress is not None and allreduce_always_fp32:
+            raise ValueError("compress fixes the wire dtype; it does not "
+                             "compose with allreduce_always_fp32")
+        if bucket_allreduce and delay_allreduce:
+            raise ValueError("bucket_allreduce (overlapped per-bucket "
+                             "reduction) and delay_allreduce (one "
+                             "terminal flat reduce) are opposite modes")
         self.mesh = mesh
         self.axis_name = axis_name
         self.gradient_average = gradient_average
@@ -189,6 +211,15 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.delay_allreduce = delay_allreduce
         self.message_size = message_size
+        #: explicit message_size-bounded buckets in reverse-parameter
+        #: order, one chained psum each — the apex ``allreduce_bucket``
+        #: overlap structure (see apex_tpu.parallel.comm)
+        self.bucket_allreduce = bucket_allreduce
+        #: None | "bf16" | "int8" — compressed collectives with optional
+        #: error feedback (pass ``residual=`` to :meth:`sync`)
+        self.compress = compress
+        self.compress_block = (compress_block if compress_block
+                               else _comm.DEFAULT_COMPRESS_BLOCK)
         #: dtype the gradients ARRIVE in — used only to size the
         #: message_size → combine-threshold conversion (bf16 grads halve
         #: the byte threshold). It does NOT cast the reduction: grads
@@ -206,15 +237,26 @@ class DistributedDataParallel:
 
     # -- in-step API ---------------------------------------------------------
 
-    def sync(self, grads):
+    def sync(self, grads, residual=None):
         """Sync a gradient pytree (call inside the wrapped step). Honors
         ``no_sync`` — the `_disable_allreduce` flag
         (`apex/parallel/distributed.py:566-570`) — and ``delay_allreduce``
         (one flat fused reduce per dtype, the `allreduce_fallback` path).
+        With ``bucket_allreduce`` or ``compress`` set, the sync runs
+        through :func:`comm.bucketed_all_reduce` (per-bucket chained
+        psums / compressed collectives).
+
+        ``residual`` enables error feedback for the compressed modes:
+        pass the previous step's residual (seed with
+        :meth:`init_residual`) and the return value becomes
+        ``(synced_grads, new_residual)`` — thread it through your step
+        state with a per-device sharding (docs/parallel.md). Without
+        ``residual`` the return value stays a bare pytree.
 
         Runs under a ``kind="collective"`` trace span so the psums are
-        scoped ``ddp/sync_gradients`` in xplane traces and HLO dumps —
-        that attribution is what survives into the compiled program.
+        scoped ``ddp/sync_gradients`` in xplane traces and HLO dumps
+        (per-bucket sub-spans ``bucket00``… nest inside it) — that
+        attribution is what survives into the compiled program.
         The span itself executes at trace time (this code runs inside
         the user's jitted step), so *runtime* in-flight-collective
         forensics come from host-side collective spans around the
@@ -222,16 +264,40 @@ class DistributedDataParallel:
         kind="collective"): jax.block_until_ready(grads)`` — see
         docs/tracing.md."""
         if not self._sync_enabled:
-            return grads
+            return grads if residual is None else (grads, residual)
+        from apex_tpu.parallel import comm as _comm
         from apex_tpu.trace.spans import span as _span
+        if self.bucket_allreduce or self.compress is not None:
+            # compress without bucketing = one bucket per dtype
+            msg = self.message_size if self.message_size else (
+                _comm.DEFAULT_MESSAGE_SIZE if self.bucket_allreduce
+                else None)
+            with _span("ddp/sync_gradients", kind="collective"):
+                return _comm.bucketed_all_reduce(
+                    grads, self.axis_name, message_size=msg,
+                    gradient_average=self.gradient_average,
+                    gradient_predivide_factor=self
+                    .gradient_predivide_factor,
+                    allreduce_always_fp32=self.allreduce_always_fp32,
+                    compress=self.compress, residual=residual,
+                    compress_block=self.compress_block)
         fn = flat_tree_all_reduce if self.delay_allreduce else \
             sync_gradients
         with _span("ddp/sync_gradients", kind="collective"):
-            return fn(
+            synced = fn(
                 grads, self.axis_name,
                 gradient_average=self.gradient_average,
                 gradient_predivide_factor=self.gradient_predivide_factor,
                 allreduce_always_fp32=self.allreduce_always_fp32)
+        # exact modes have no compression error: the residual passes
+        # through unchanged so callers can keep one code shape
+        return synced if residual is None else (synced, residual)
+
+    def init_residual(self, grads):
+        """Zeroed error-feedback residual matching a gradient pytree —
+        see :func:`apex_tpu.parallel.comm.init_residual`."""
+        from apex_tpu.parallel import comm as _comm
+        return _comm.init_residual(grads)
 
     def no_sync(self):
         """Context manager: steps wrapped while active skip gradient

@@ -8,14 +8,24 @@ the hierarchy the reference hand-builds,
 `apex/contrib/optimizers/distributed_fused_adam.py:250-290`,
 `apex/parallel/distributed.py:604-624`).
 
-Prints, per DDP mode:
+Prints, per DDP mode (per-tensor, delay_allreduce, bucketed,
+bucketed+bf16):
 - every collective in the optimized module (op, dtype, bytes,
   replica-group shape),
 - the bytes-on-ICI budget: a bidirectional-ring all-reduce moves
   2*(N-1)/N * buffer bytes per chip,
-- the weak-scaling prediction against the measured single-chip step.
+- the weak-scaling prediction against the measured single-chip step,
+- for the bucketed modes, the **overlap audit**: the scheduled module's
+  ``all-reduce-start``/``-done`` pairs with the count of real compute
+  instructions scheduled between them (nonzero gap = the latency-hiding
+  scheduler put backward compute behind the collective), plus the
+  bytes-per-bucket table.
 
 Usage: python scripts/pod_comm_budget.py [--topology v5e:8x8]
+       python scripts/pod_comm_budget.py --cpu8   # 8-device CPU-mesh
+           # structural variant (run_tier1.sh --smoke): asserts the
+           # per-bucket all-reduce structure + bf16 wire halving without
+           # TPU hardware; exit 1 on violation
 """
 
 import os
@@ -75,17 +85,46 @@ def collectives(hlo: str):
     return out
 
 
-def build_step(mesh, delay_allreduce, model=None):
+# StableHLO (lowered, pre-optimization) collectives: the WIRE dtype as
+# authored. Needed because CPU's float-normalization pass promotes bf16
+# all-reduces to f32 in the *optimized* module — the compiled text then
+# under-reports the compression (TPU keeps bf16 native, so the optimized
+# audit is authoritative there).
+_STABLE_COLL_RE = re.compile(
+    r'"stablehlo\.(all_reduce|reduce_scatter|all_gather|all_to_all|'
+    r'collective_permute)".*?->\s*\(?tensor<([^>]*)>', re.S)
+_STABLE_DTYPE_BYTES = dict(_DTYPE_BYTES, i8=1, i16=2, i32=4, i64=8, i1=1)
+
+
+def stablehlo_collectives(text: str):
+    """(op, dtype, n_operands, bytes) per collective in a lowered
+    StableHLO module — same row shape as :func:`collectives`."""
+    out = []
+    for op, ty in _STABLE_COLL_RE.findall(text):
+        parts = ty.split("x")
+        dt = parts[-1]
+        elems = int(np.prod([int(p) for p in parts[:-1]] or [1]))
+        out.append((op.replace("_", "-"), dt, 1,
+                    elems * _STABLE_DTYPE_BYTES.get(dt, 4)))
+    return out
+
+
+def build_step(mesh, delay_allreduce, model=None, *,
+               bucket_allreduce=False, message_size=None, compress=None):
     """The flagship O2+DDP step — ONE definition shared by this
     script's v5e-64 audit and tests/test_pod_hlo.py's CI assertions,
-    so what CI pins is exactly what the pod evidence compiled."""
+    so what CI pins is exactly what the pod evidence compiled.
+    ``bucket_allreduce``/``message_size``/``compress`` select the
+    overlapped/compressed sync modes (apex_tpu.parallel.comm)."""
     from jax.sharding import PartitionSpec as P
 
     from apex_tpu import amp, models, ops, parallel
     from apex_tpu.optim import FusedSGD
 
     ddp = parallel.DistributedDataParallel(
-        mesh, delay_allreduce=delay_allreduce)
+        mesh, delay_allreduce=delay_allreduce,
+        bucket_allreduce=bucket_allreduce, message_size=message_size,
+        compress=compress)
     if model is None:
         model = models.ResNet(stage_sizes=[3, 4, 6, 3],
                               num_classes=1000, dtype=jnp.bfloat16)
@@ -111,7 +150,8 @@ def build_step(mesh, delay_allreduce, model=None):
 
 
 def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=256,
-                   model=None, image_size=224):
+                   model=None, image_size=224, bucket_allreduce=False,
+                   message_size=None, compress=None):
     """Lower the full ResNet-50 O2+DDP step over ``mesh`` using only
     avals (no real arrays — works on abstract topology devices)."""
     from jax.sharding import PartitionSpec as P
@@ -119,7 +159,10 @@ def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=256,
     from apex_tpu import parallel
 
     step, model, amp_opt = build_step(mesh, delay_allreduce,
-                                      model=model)
+                                      model=model,
+                                      bucket_allreduce=bucket_allreduce,
+                                      message_size=message_size,
+                                      compress=compress)
 
     # shape-only init on the default backend (tiny arrays, real mesh
     # not needed): we just need the state/batch_stats avals
@@ -175,6 +218,105 @@ def report(hlo, params_s, n):
           f"{RESNET_STEP_MS} ms step: {eff * 100:.1f}%")
 
 
+# --- overlap audit -----------------------------------------------------------
+
+# schedule-level "real compute" — the ops worth hiding a collective
+# behind (elementwise glue rides inside fusions anyway)
+_COMPUTE_RE = re.compile(
+    r"= [^ ]+ (fusion|convolution|dot|custom-call|tpu_custom_call)\(")
+_START_RE = re.compile(
+    r"%?([\w.\-]+) = [^=]*?((?:all-reduce|reduce-scatter|all-gather|"
+    r"all-to-all|collective-permute)-start)\(")
+_DONE_RE = re.compile(
+    r"= [^=]*?(?:all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)-done\(\s*%?([\w.\-]+)")
+
+
+def overlap_audit(hlo: str):
+    """Audit async-collective overlap in a *scheduled* optimized module.
+
+    HLO text of a compiled executable lists instructions in schedule
+    order, so the distance between an ``all-reduce-start`` and its
+    ``-done`` is exactly what the latency-hiding scheduler achieved.
+    Returns one dict per start/done pair::
+
+        {"op": ..., "bytes": ..., "compute_between": n,
+         "start_line": i, "done_line": j}
+
+    ``compute_between`` counts fusion/convolution/dot/custom-call
+    instructions scheduled inside the window — nonzero means real
+    (backward) compute runs behind the collective. Backends that do not
+    emit async pairs (CPU) return an empty list; the structural bucket
+    claims are then asserted on the sync ``all-reduce`` count instead
+    (tests/test_pod_hlo.py does both).
+    """
+    lines = hlo.splitlines()
+    starts = {}
+    compute = np.zeros(len(lines) + 1, np.int64)
+    for i, line in enumerate(lines):
+        compute[i + 1] = compute[i] + bool(_COMPUTE_RE.search(line))
+        m = _START_RE.search(line)
+        if m:
+            nbytes = 0
+            head = line.split(f" {m.group(2)}(")[0]
+            if "=" in head:
+                for sm in _SHAPE_RE.finditer(head.split("=", 1)[1]):
+                    dims = [int(x) for x in sm.group(2).split(",")
+                            if x] or [1]
+                    nbytes += int(np.prod(dims)) * _DTYPE_BYTES.get(
+                        sm.group(1), 4)
+            # the -start tuple carries operand AND result buffers;
+            # halve to report the logical payload once
+            starts[m.group(1)] = (i, m.group(2), nbytes // 2)
+    out = []
+    for j, line in enumerate(lines):
+        m = _DONE_RE.search(line)
+        if not m or m.group(1) not in starts:
+            continue
+        i, op, nbytes = starts[m.group(1)]
+        out.append({"op": op.replace("-start", ""), "bytes": nbytes,
+                    "compute_between": int(compute[j] - compute[i + 1]),
+                    "start_line": i, "done_line": j})
+    return out
+
+
+def print_overlap(hlo, leaves, message_size):
+    from apex_tpu.parallel import comm
+
+    plan = comm.bucket_plan(leaves, message_size)
+    print(f"  bucket plan ({len(plan)} buckets, message_size="
+          f"{message_size}):")
+    print(comm.bucket_table(plan))
+    pairs = overlap_audit(hlo)
+    if not pairs:
+        print("  (no async start/done pairs — backend compiles sync "
+              "collectives; bucket structure asserted on all-reduce "
+              "count)")
+        return
+    overlapped = sum(1 for p in pairs if p["compute_between"] > 0)
+    print(f"  async collective pairs: {len(pairs)}, with compute "
+          f"scheduled inside the window: {overlapped}")
+    for p in pairs:
+        print(f"    {p['op']:16s} {p['bytes'] / 2 ** 20:8.2f} MiB  "
+              f"compute-between={p['compute_between']}")
+
+
+def _flagship_modes():
+    """(label, lower_flagship kwargs) per audited DDP mode."""
+    return [
+        ("delay_allreduce (one flat fused reduce per dtype)",
+         dict(delay_allreduce=True)),
+        ("per-tensor psum + XLA combiner",
+         dict(delay_allreduce=False)),
+        ("bucketed backward-ordered (message_size=1e7)",
+         dict(delay_allreduce=False, bucket_allreduce=True,
+              message_size=10_000_000)),
+        ("bucketed + compress=bf16",
+         dict(delay_allreduce=False, bucket_allreduce=True,
+              message_size=10_000_000, compress="bf16")),
+    ]
+
+
 def main():
     topology = "v5e:8x8"
     if "--topology" in sys.argv:
@@ -190,14 +332,77 @@ def main():
     mesh = Mesh(np.array(topo.devices), (parallel.DATA_AXIS,))
     print(f"AOT target: {topology} ({n} chips)")
 
-    for delay in (True, False):
-        print(f"\nDDP delay_allreduce={delay} "
-              f"({'one flat fused reduce per dtype' if delay else 'per-tensor psum + XLA combiner'}):")
-        lowered, params_s = lower_flagship(mesh, n,
-                                           delay_allreduce=delay)
+    for label, kw in _flagship_modes():
+        print(f"\nDDP {label}:")
+        lowered, params_s = lower_flagship(mesh, n, **kw)
         hlo = lowered.compile().as_text()
         report(hlo, params_s, n)
+        if kw.get("bucket_allreduce"):
+            leaves = jax.tree_util.tree_leaves(params_s)
+            print_overlap(hlo, leaves, kw["message_size"])
+
+
+def main_cpu8():
+    """8-device CPU-mesh structural variant of the audit
+    (``run_tier1.sh --smoke``): no TPU needed. Compiles the small-model
+    flagship step in bucketed and bucketed+bf16 modes and ASSERTS
+    the per-bucket all-reduce structure and the bf16 wire halving —
+    exit status is the audit verdict."""
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+    from apex_tpu import _compat
+    _compat.request_cpu_devices(8)
+    from jax.sharding import Mesh
+
+    from apex_tpu import models, parallel
+    from apex_tpu.parallel import comm
+
+    mesh = Mesh(np.array(jax.devices()), (parallel.DATA_AXIS,))
+    model = models.ResNet(stage_sizes=[1, 1], num_classes=10, width=16,
+                          dtype=jnp.bfloat16)
+    message_size = 30_000
+
+    print("overlap audit, 8-device CPU mesh (structural variant)")
+    for label, kw in (
+            ("bucketed", dict(bucket_allreduce=True,
+                              message_size=message_size)),
+            ("bucketed+bf16", dict(bucket_allreduce=True,
+                                   message_size=message_size,
+                                   compress="bf16"))):
+        lowered, params_s = lower_flagship(
+            mesh, 8, delay_allreduce=False, model=model, image_size=32,
+            per_chip_batch=4, **kw)
+        hlo = lowered.compile().as_text()
+        leaves = jax.tree_util.tree_leaves(params_s)
+        plan = comm.bucket_plan(leaves, message_size)
+        colls = collectives(hlo)
+        ars = [c for c in colls if c[0] == "all-reduce" and c[3] > 128]
+        print(f"\nmode {label}: {len(plan)} buckets -> "
+              f"{len(ars)} grad all-reduces")
+        print(comm.bucket_table(plan))
+        assert len(plan) >= 2, "model too small to exercise bucketing"
+        assert len(ars) >= len(plan), (
+            f"buckets merged: {len(ars)} all-reduces < {len(plan)} "
+            f"buckets\n" + "\n".join(map(str, ars)))
+        if kw.get("compress") == "bf16":
+            # wire dtype from the LOWERED module (CPU promotes bf16
+            # all-reduces to f32 during optimization; TPU doesn't)
+            n_params = sum(int(np.prod(l.shape)) for l in leaves)
+            logical = n_params * 4
+            wire = sum(c[3] for c in stablehlo_collectives(
+                lowered.as_text())
+                if c[0] == "all-reduce" and c[3] > 128)
+            print(f"  wire {wire} B vs logical {logical} B "
+                  f"(ratio {wire / logical:.3f})")
+            assert wire <= logical * 0.505, (
+                f"bf16 mode did not halve wire bytes: {wire} vs "
+                f"{logical}")
+        print_overlap(hlo, leaves, message_size)
+    print("\ncpu8 overlap audit ok")
 
 
 if __name__ == "__main__":
-    main()
+    if "--cpu8" in sys.argv:
+        main_cpu8()
+    else:
+        main()

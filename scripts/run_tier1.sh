@@ -4,6 +4,8 @@
 #   scripts/run_tier1.sh            # full tier-1 pytest run (870s budget)
 #   scripts/run_tier1.sh --smoke    # fast pre-flight: schema validators
 #                                   # + a 3-step traced bench.py --trace run
+#                                   # + the DDP overlap audit (8-device
+#                                   #   CPU variant of pod_comm_budget)
 #
 # Exit status is pytest's (or the first failing smoke step). The full
 # run prints DOTS_PASSED=<n> — the count of passing-test dots the driver
@@ -53,6 +55,10 @@ ct = json.load(open(sys.argv[1]))
 assert isinstance(ct.get("traceEvents"), list) and ct["traceEvents"], \
     "TRACE.json has no traceEvents"
 EOF
+
+    echo "== smoke: DDP overlap audit (8-device CPU variant)"
+    JAX_PLATFORMS=cpu python scripts/pod_comm_budget.py --cpu8
+
     echo "smoke ok"
     exit 0
 fi

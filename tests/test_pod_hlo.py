@@ -26,10 +26,12 @@ from jax.sharding import PartitionSpec as P
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from scripts.pod_comm_budget import collectives, lower_flagship
+from scripts.pod_comm_budget import (collectives, lower_flagship,
+                                     overlap_audit,
+                                     stablehlo_collectives)
 
 
-def _compile_resnet_step(mesh, n, delay_allreduce):
+def _compile_resnet_step(mesh, n, delay_allreduce, **mode_kw):
     # small ResNet keeps CI fast; the collective structure is the same,
     # and the step construction is the SAME code the v5e-64 evidence
     # compiles (scripts/pod_comm_budget.py)
@@ -39,12 +41,15 @@ def _compile_resnet_step(mesh, n, delay_allreduce):
                                 width=16, dtype=jnp.bfloat16)
     lowered, params_s = lower_flagship(
         mesh, n, delay_allreduce=delay_allreduce, model=model_small,
-        image_size=32, per_chip_batch=4)
+        image_size=32, per_chip_batch=4, **mode_kw)
     hlo = lowered.compile().as_text()
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params_s))
     n_tensors = len(jax.tree_util.tree_leaves(params_s))
-    return hlo, n_params, n_tensors
+    return hlo, n_params, n_tensors, lowered, params_s
+
+
+_BUCKET_MSG = 30_000    # elements: splits the small model into 2 buckets
 
 
 def _xla_combines_allreduces(mesh) -> bool:
@@ -71,7 +76,7 @@ def test_ddp_one_fused_grad_allreduce(mesh8, delay):
     if not delay and not _xla_combines_allreduces(mesh8):
         pytest.skip("this XLA pipeline has no all-reduce combiner pass; "
                     "the fused-sync claim needs delay_allreduce here")
-    hlo, n_params, n_tensors = _compile_resnet_step(mesh8, 8, delay)
+    hlo, n_params, n_tensors, _, _ = _compile_resnet_step(mesh8, 8, delay)
     colls = collectives(hlo)
     # everything except the scalar loss pmean is grad traffic
     ars = [c for c in colls if c[0] == "all-reduce" and c[3] > 128]
@@ -124,6 +129,162 @@ def test_zero_optimizer_scatter_gather(mesh8):
               if c[0] == "all-reduce" and c[3] >= param_bytes // 2]
     assert not big_ar, (
         f"ZeRO path still moves full-size all-reduces: {big_ar}")
+
+
+class TestBucketedOverlap:
+    """Overlap-audit assertions for the bucketed backward-ordered sync
+    (apex ``allreduce_bucket`` parity) on the CI mesh. The async
+    start/done-pair half of the audit needs a TPU-scheduled module and
+    lives in the slow v5e-64 test below + the ``ddp/overlap-start-done``
+    compile-check case; here the structure (per-bucket all-reduces that
+    the combiner cannot re-merge, wire dtype/bytes) is pinned."""
+
+    def test_per_bucket_allreduces_not_merged(self, mesh8):
+        from apex_tpu.parallel import comm
+
+        hlo, n_params, _, _, params_s = _compile_resnet_step(
+            mesh8, 8, False, bucket_allreduce=True,
+            message_size=_BUCKET_MSG)
+        plan = comm.bucket_plan(jax.tree_util.tree_leaves(params_s),
+                                _BUCKET_MSG)
+        assert len(plan) >= 2, "model too small to exercise bucketing"
+        ars = [c for c in collectives(hlo)
+               if c[0] == "all-reduce" and c[3] > 128]
+        assert len(ars) >= len(plan), (
+            f"buckets merged into {len(ars)} all-reduces "
+            f"(plan has {len(plan)}):\n" + "\n".join(map(str, ars)))
+        # no single terminal all-reduce carries the whole gradient
+        grad_bytes = n_params * 4
+        assert all(c[3] < grad_bytes for c in ars), ars
+        # ...but together they still cover it
+        assert sum(c[3] for c in ars) >= int(grad_bytes * 0.95)
+
+    def test_bucket_bytes_bounded_by_message_size(self, mesh8):
+        from apex_tpu.parallel import comm
+
+        hlo, _, _, _, params_s = _compile_resnet_step(
+            mesh8, 8, False, bucket_allreduce=True,
+            message_size=_BUCKET_MSG)
+        plan = comm.bucket_plan(jax.tree_util.tree_leaves(params_s),
+                                _BUCKET_MSG)
+        # bucketing is at tensor granularity: a single oversized tensor
+        # may exceed the cap, exactly like the reference's
+        # allreduce_bucket — the bound is max(cap, biggest tensor)
+        biggest = max(int(np.prod(l.shape)) for l in
+                      jax.tree_util.tree_leaves(params_s))
+        cap_bytes = max(_BUCKET_MSG, biggest) * 4
+        ars = [c for c in collectives(hlo)
+               if c[0] == "all-reduce" and c[3] > 128]
+        assert max(c[3] for c in ars) <= cap_bytes * 1.05, (ars,
+                                                            cap_bytes)
+        assert max(b.bytes() for b in plan) <= cap_bytes
+
+    def test_bf16_wire_bytes_halved(self, mesh8):
+        """compress="bf16": wire bytes ≤ 50% of the logical fp32 grad
+        bytes. Asserted on the LOWERED module's collectives — CPU's
+        float-normalization pass promotes bf16 all-reduces to f32 in
+        the optimized text (TPU keeps them native; the slow v5e-64
+        audit asserts the optimized module there)."""
+        _, n_params, _, lowered, _ = _compile_resnet_step(
+            mesh8, 8, False, bucket_allreduce=True,
+            message_size=_BUCKET_MSG, compress="bf16")
+        colls = stablehlo_collectives(lowered.as_text())
+        ars = [c for c in colls if c[0] == "all-reduce" and c[3] > 128]
+        assert ars and all(c[1] == "bf16" for c in ars), colls
+        logical = n_params * 4
+        wire = sum(c[3] for c in ars)
+        assert wire <= logical * 0.505, (wire, logical)
+        assert wire >= logical * 0.45, (wire, logical)
+
+    def test_default_mode_structurally_unchanged(self, mesh8):
+        """The default (no-bucket, no-compress) DDP path must compile
+        to the same program as before this layer existed — same opcode
+        sequence, same collectives (the compile-check case
+        ``ddp/no-compress-bitident``, run here so CI owns it)."""
+        from apex_tpu.ops import compile_check as cc
+
+        fn = dict(cc.CASES)["ddp/no-compress-bitident"]
+        fn()
+
+    def test_overlap_audit_parses_async_pairs(self):
+        """overlap_audit on a synthetic scheduled module: start/done
+        pairs found, compute between them counted."""
+        hlo = "\n".join([
+            "%ars.1 = (f32[100]{0}, f32[100]{0}) "
+            "all-reduce-start(%p0), replica_groups={{0,1}}",
+            "%fusion.7 = f32[8]{0} fusion(%p1), kind=kLoop",
+            "%dot.3 = f32[8,8]{1,0} dot(%p1, %p2)",
+            "%ard.1 = f32[100]{0} all-reduce-done(%ars.1)",
+            "%ars.2 = (f32[50]{0}, f32[50]{0}) "
+            "all-reduce-start(%fusion.7), replica_groups={{0,1}}",
+            "%ard.2 = f32[50]{0} all-reduce-done(%ars.2)",
+        ])
+        pairs = overlap_audit(hlo)
+        assert len(pairs) == 2
+        assert pairs[0]["compute_between"] == 2
+        assert pairs[0]["bytes"] == 400
+        assert pairs[1]["compute_between"] == 0
+
+
+@pytest.mark.slow
+def test_v5e64_aot_overlap_and_compression():
+    """The acceptance audit against a REAL v5e-64 topology: bucketed
+    mode compiles to per-bucket all-reduces (no single terminal
+    all-reduce — the structure the latency-hiding scheduler needs to
+    emit start/done pairs behind backward; pairs themselves are
+    asserted only when the printed module carries them, see below), and
+    ``compress="bf16"`` moves ≤ 50% of the logical grad bytes in the
+    OPTIMIZED module (bf16 is native on TPU). Skipped where the
+    environment cannot AOT-compile for TPU topologies (CPU-only CI —
+    the structural halves above keep it pinned in-budget)."""
+    from apex_tpu.parallel import comm
+
+    try:
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc(platform="tpu",
+                                            topology_name="v5e:8x8")
+    except Exception as e:
+        pytest.skip(f"no TPU AOT topology support: {e}")
+    from jax.sharding import Mesh
+    from apex_tpu import parallel
+    mesh = Mesh(np.array(topo.devices), (parallel.DATA_AXIS,))
+
+    try:
+        hlo, n_params, _, _, params_s = _compile_resnet_step(
+            mesh, 64, False, bucket_allreduce=True,
+            message_size=_BUCKET_MSG)
+    except Exception as e:
+        pytest.skip(f"TPU AOT compile unavailable: {e}")
+    plan = comm.bucket_plan(jax.tree_util.tree_leaves(params_s),
+                            _BUCKET_MSG)
+    grad_bytes = n_params * 4
+    ars = [c for c in collectives(hlo)
+           if c[0] == "all-reduce" and c[3] > 128]
+    assert len(ars) >= len(plan) >= 2, (len(ars), len(plan))
+    assert all(c[3] < grad_bytes for c in ars), "terminal all-reduce"
+    # async start/done pairs appear only in modules printed AFTER the
+    # latency-hiding scheduler's async conversion; the v5e AOT path
+    # prints the optimized-but-sync form (measured: zero -start ops),
+    # so the pair half is conditional — the per-bucket structure above
+    # is what gives the scheduler its overlap freedom either way
+    pairs = [p for p in overlap_audit(hlo) if p["bytes"] > 128]
+    if pairs:
+        assert any(p["compute_between"] > 0 for p in pairs), pairs
+
+    hlo_bf16, n_params, _, _, _ = _compile_resnet_step(
+        mesh, 64, False, bucket_allreduce=True,
+        message_size=_BUCKET_MSG, compress="bf16")
+    # scheduled TPU modules carry collectives as start/done pairs (the
+    # audit reports payload bytes once per pair); unscheduled fall back
+    # to the sync-collective scan
+    pairs_bf16 = [p for p in overlap_audit(hlo_bf16)
+                  if p["op"] == "all-reduce" and p["bytes"] > 128]
+    if pairs_bf16:
+        wire = sum(p["bytes"] for p in pairs_bf16)
+    else:
+        wire = sum(c[3] for c in collectives(hlo_bf16)
+                   if c[0] == "all-reduce" and c[3] > 128)
+    assert wire <= n_params * 4 * 0.505, (wire, n_params * 4)
 
 
 @pytest.mark.slow
