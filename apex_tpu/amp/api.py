@@ -273,6 +273,53 @@ class Amp:
         state = self.apply_gradients(state, grads, finite)
         return state, out, finite
 
+    # -- memory accounting ---------------------------------------------------
+
+    def memory_footprint(self, params) -> dict:
+        """Analytic HBM bytes of the mixed-precision state for ``params``
+        — the master-weight accounting the
+        :class:`apex_tpu.prof.MemoryReport` class table is cross-checked
+        against (docs/memory.md). Host-side shape arithmetic only.
+
+        Under a master-weights policy (O1/O2) every parameter is held
+        TWICE: the fp32 master (``state.params`` — classified
+        ``params`` in the report, since it is the checkpointed weight)
+        plus the model-dtype forward copy materialized per step (an
+        ``activations``-class temp under ``amp/fwd``). O3 keeps one
+        model-dtype copy. Returns ``{"n_params", "master_bytes",
+        "model_copy_bytes", "scaler_bytes", "metrics_bytes",
+        "total_bytes", "master_dtype", "model_dtype"}``.
+        """
+        import numpy as np
+        leaves = jax.tree_util.tree_leaves(params)
+        n = sum(int(np.prod(np.shape(l))) for l in leaves)
+        if self.policy.master_weights or self.policy.cast_model_type is None:
+            master_dt = jnp.dtype(jnp.float32)
+        else:
+            master_dt = jnp.dtype(self.policy.compute_dtype)
+        model_dt = jnp.dtype(self.policy.compute_dtype)
+        master_bytes = n * master_dt.itemsize
+        # the per-step forward copy exists only when the stored params
+        # and the compute dtype differ (O1/O2 masters): under O3 the
+        # stored params ARE model-dtype and the cast is an elided no-op
+        model_copy = (n * model_dt.itemsize
+                      if (self.policy.cast_model_type is not None
+                          and master_dt != model_dt) else 0)
+        scaler_bytes = (8 * self.num_losses
+                        if self.scale_cfg is not None else 0)
+        metrics_bytes = 9 * 4 if self.monitor else 0
+        return {
+            "n_params": n,
+            "master_bytes": master_bytes,
+            "model_copy_bytes": model_copy,
+            "scaler_bytes": scaler_bytes,
+            "metrics_bytes": metrics_bytes,
+            "total_bytes": (master_bytes + model_copy + scaler_bytes
+                            + metrics_bytes),
+            "master_dtype": str(master_dt),
+            "model_dtype": str(model_dt),
+        }
+
     # -- checkpoint parity ---------------------------------------------------
 
     def state_dict(self, state: AmpState):

@@ -65,13 +65,19 @@ class MetricsLogger:
                  peak_flops: Optional[float] = None,
                  flops_per_step: Optional[float] = None,
                  collective_bytes_per_step: Optional[int] = None,
-                 trace_sink: Optional[Sink] = None):
+                 trace_sink: Optional[Sink] = None,
+                 memory_sink: Optional[Sink] = None):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
         self.flush_every = max(int(flush_every), 1)
         self.flops_per_step = flops_per_step
         self.collective_bytes_per_step = collective_bytes_per_step
         self.trace_sink = trace_sink
+        #: the ``memory`` event channel (kind="memory"/"memory_report"/
+        #: "retrace"/"compile" events — validate with
+        #: ``check_metrics_schema.py --kind memory``)
+        self.memory_sink = memory_sink
+        self.memory_report = None      # last attached prof.MemoryReport
         if peak_flops is None:
             from apex_tpu.prof.report import device_peak_flops
             peak_flops = device_peak_flops() or None
@@ -178,6 +184,63 @@ class MetricsLogger:
         if self.trace_sink is not None and not self._closed:
             self.trace_sink.emit(dict(event))
 
+    # -- memory channel ------------------------------------------------------
+
+    def record_memory(self, event: Dict) -> None:
+        """Emit one memory/compile event (``kind="memory"|"memory_report"
+        |"retrace"|"compile"``) through the memory channel — plain-dict
+        pass-through like :meth:`record_event`. Wire a
+        :class:`apex_tpu.prof.CompileWatcher` with
+        ``watcher.subscribe(logger.record_memory)`` to stream retrace
+        warnings; non-finite numbers are nulled to keep the strict-JSON
+        contract."""
+        if self.memory_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+        self.memory_sink.emit(rec)
+
+    def sample_memory(self, step: Optional[int] = None, *,
+                      device=None, **extra) -> Optional[Dict]:
+        """Sample the device allocator (``device.memory_stats()`` — a
+        host-side runtime call, zero device dispatches) and emit one
+        ``kind="memory"`` event. Off-TPU backends report no stats; the
+        event still lands (values null) so the stream shape is uniform.
+        Returns the emitted record (or None when there is no sink)."""
+        from apex_tpu.prof.memory import device_memory_sample
+        if self.memory_sink is None or self._closed:
+            return None
+        rec: Dict = {"kind": "memory", "step": step, "rank": 0,
+                     "wall_time": time.time()}
+        try:
+            import jax as _jax
+            rec["rank"] = _jax.process_index()
+        except Exception:
+            pass
+        rec.update(device_memory_sample(device))
+        if extra:
+            rec.update(extra)
+        self.record_memory(rec)
+        return rec
+
+    def attach_memory_report(self, report) -> "MetricsLogger":
+        """Attach a :class:`apex_tpu.prof.MemoryReport` (the compiled
+        step's footprint): emits one ``kind="memory_report"`` event and
+        keeps the report for consumers (``bench.py`` reads
+        ``peak_live_bytes``; hand it to
+        ``FlightRecorder.attach_memory_report`` too so crash dumps name
+        the biggest buffers)."""
+        self.memory_report = report
+        if report is not None:
+            try:
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+            self.record_memory(report.to_event(rank=rank))
+        return self
+
     def close(self) -> None:
         if self._closed:
             return
@@ -186,6 +249,8 @@ class MetricsLogger:
             sink.close()
         if self.trace_sink is not None:
             self.trace_sink.close()
+        if self.memory_sink is not None:
+            self.memory_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

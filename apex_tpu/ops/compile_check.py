@@ -846,6 +846,57 @@ def _():
     jax.clear_caches()
 
 
+# --- memory/compile observability: zero-dispatch contract --------------------
+
+@case("memory/no-extra-dispatch")
+def _():
+    """Memory sampling + compile_watch are pure host-side observers: a
+    step driven under a CompileWatcher with allocator sampling and an
+    attached MemoryReport must compile BIT-IDENTICAL HLO to an
+    unwatched twin (same guarantee monitor/trace already pin), with no
+    host traffic and exactly one trace in steady state."""
+    import io
+
+    from apex_tpu import monitor, prof
+    from apex_tpu.monitor.check import module_count_and_host_ops
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def train_step(p, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    plain = jax.jit(train_step)
+    hlo_plain = plain.lower(params, x, y).compile().as_text()
+
+    watcher = prof.CompileWatcher()
+    logger = monitor.MetricsLogger(
+        sinks=[], memory_sink=monitor.JSONLSink(io.StringIO()))
+    watcher.subscribe(logger.record_memory)
+    watched = watcher.watch(train_step, name="train_step")
+
+    watched(params, x, y)
+    logger.sample_memory(step=0)
+    rep = prof.memory_report(watched.jitted, params, x, y)
+    logger.attach_memory_report(rep)
+    watched(params, x, y)                      # steady state
+    logger.close()
+
+    hlo_watched = watched.jitted.lower(params, x, y).compile().as_text()
+    assert hlo_watched == hlo_plain, \
+        "watching/sampling changed the compiled program"
+    assert watcher["train_step"].n_traces == 1, \
+        watcher["train_step"].n_traces
+    _n, host = module_count_and_host_ops(watched.jitted, params, x, y)
+    assert not host, f"observed step compiled host traffic: {host}"
+    assert rep.total_bytes > 0
+
+
 # --- ddp: bucketed-overlap & exact-mode contracts ----------------------------
 
 def _pod_budget():

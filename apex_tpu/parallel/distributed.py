@@ -434,6 +434,33 @@ class DistributedDataParallel:
         from apex_tpu.monitor.collectives import collective_bytes as _cb
         return _cb(step_fn, *args, **kwargs)
 
+    def memory_report(self, step_fn: Callable, *args,
+                      batch_size: Optional[int] = None, **kwargs):
+        """Static per-device HBM footprint of a (wrapped) step — a
+        :class:`apex_tpu.prof.MemoryReport` whose class table attributes
+        every byte to params / optimizer state / activations / **comm**
+        (the ``bucket_plan`` buffers and compressed-collective wire
+        staging show up under ``comm``, scoped ``ddp/sync_gradients``).
+        AOT-only like :meth:`collective_bytes`: one compile, never a
+        dispatch. ``batch_size`` is the PER-DEVICE batch dimension (the
+        post-shard_map leading dim, i.e. global batch / world_size) and
+        enables the what-if batch forecast. See docs/memory.md."""
+        from apex_tpu.prof.memory import memory_report as _mr
+        if batch_size is None:
+            # infer: the common leading dim of the batch-side args
+            # (everything after state), divided over the data axis the
+            # wrapper splits it on. Ambiguity (leaves disagreeing on a
+            # world-divisible leading dim — e.g. a batch_stats vector
+            # riding along) leaves batch_size None: no forecast beats a
+            # silently wrong one.
+            dims = {l.shape[0] for a in args[1:]
+                    for l in jax.tree_util.tree_leaves(a)
+                    if getattr(l, "shape", ())}
+            cands = {d for d in dims if d % self.world_size == 0}
+            if len(cands) == 1:
+                batch_size = cands.pop() // self.world_size
+        return _mr(step_fn, *args, batch_size=batch_size, **kwargs)
+
     def wrap_grad_fn(self, grad_fn: Callable) -> Callable:
         """Wrap ``grad_fn(*a, **k) -> (value, grads)`` so grads come back
         synced — the "model wrapper" usage of the reference where backward

@@ -12,13 +12,24 @@ FLOPs analyzers). TPU-native, the same capability is:
 - :mod:`~apex_tpu.prof.hlo` — XLA cost analysis + per-instruction
   FLOPs/bytes estimates from optimized HLO;
 - :mod:`~apex_tpu.prof.report` — ``profile_step`` one-stop capture →
-  parse → MFU report.
+  parse → MFU report;
+- :mod:`~apex_tpu.prof.memory` — HBM footprint reports: per-buffer
+  attribution (params / optimizer state / activations / comm) from the
+  optimized HLO + ``memory_analysis()``, peak-live estimate, what-if
+  batch scaler vs HBM capacity (docs/memory.md);
+- :mod:`~apex_tpu.prof.compile_watch` — trace/lower/compile counters +
+  retrace detector naming the argument whose shape changed.
 """
 
 from apex_tpu.prof.annotate import (CallRecord, annotate, annotate_modules,
                                     scope)
+from apex_tpu.prof.compile_watch import (CompileWatcher, FunctionWatch,
+                                         global_counters)
 from apex_tpu.prof.hlo import (OpEstimate, compiled_hlo, cost_analysis,
                                op_estimates)
+from apex_tpu.prof.memory import (BufferRecord, MemoryReport,
+                                  device_memory_sample, hbm_capacity,
+                                  memory_report)
 from apex_tpu.prof.report import (PEAK_FLOPS, StepReport, device_peak_flops,
                                   profile_step, trace)
 from apex_tpu.prof.xplane import OpRecord, TraceProfile, parse_trace
@@ -29,4 +40,7 @@ __all__ = [
     "PEAK_FLOPS", "StepReport", "device_peak_flops", "profile_step",
     "trace",
     "OpRecord", "TraceProfile", "parse_trace",
+    "MemoryReport", "BufferRecord", "memory_report", "hbm_capacity",
+    "device_memory_sample",
+    "CompileWatcher", "FunctionWatch", "global_counters",
 ]

@@ -8,9 +8,14 @@ one timed event per executed HLO instruction, whose metadata carries the
 full HLO text (op name, shapes, fusion kind). This module decodes that
 file into per-op records and aggregates them.
 
-Decoding uses the xplane proto bundled with the baked-in tensorflow
+Decoding prefers the xplane proto bundled with tensorflow
 (``tensorflow.tsl.profiler.protobuf.xplane_pb2``) — imported lazily so
-apex_tpu itself never depends on tensorflow.
+apex_tpu itself never depends on tensorflow — and falls back to a
+**minimal pure-python wire-format decoder** (:func:`decode_xspace`)
+covering exactly the fields this parser reads (plane/line/event
+hierarchy + event metadata), so CI parses committed ``*.xplane.pb``
+fixtures without tensorflow (``tests/fixtures/``; set
+``APEX_TPU_XPLANE_PURE=1`` to force the fallback).
 """
 
 from __future__ import annotations
@@ -22,7 +27,7 @@ import re
 from typing import Dict, List, Optional
 
 __all__ = ["OpRecord", "TraceProfile", "parse_trace", "latest_xplane",
-           "COLLECTIVE_PREFIXES"]
+           "COLLECTIVE_PREFIXES", "decode_xspace"]
 
 # HLO instruction text → opcode: "%fusion.3 = f32[8]{0} fusion(...)" → the
 # word after the result shape. Shapes may be tuples "(f32[...], u32[])"
@@ -40,6 +45,28 @@ _OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
 # "jit(step)/amp/fwd" aggregate under the same user-named key
 _TRANSFORM_WRAPPERS = ("jit(", "transpose(", "jvp(", "vmap(", "pmap(",
                       "shard_map(", "scan(", "while(", "remat(")
+
+
+def strip_scope(op_name: str) -> str:
+    """User-named components of a metadata scope path:
+    ``jit(step)/transpose(jvp(amp/fwd))/tanh`` → ``amp/fwd/tanh``.
+
+    A user scope containing ``/`` splits the wrapper parens across path
+    components, so besides dropping self-contained wrapper components
+    each kept fragment is scrubbed of wrapper prefixes and dangling
+    parens. Shared by :meth:`TraceProfile.by_scope` and the
+    buffer-attribution in :mod:`apex_tpu.prof.memory`."""
+    parts = []
+    for p in op_name.split("/"):
+        if (p.startswith(_TRANSFORM_WRAPPERS)
+                and p.count("(") == p.count(")")):
+            continue          # self-contained wrapper, e.g. "jit(step)"
+        while p.startswith(_TRANSFORM_WRAPPERS):
+            p = p.split("(", 1)[1]      # fragment: keep the user content
+        p = p.strip(")")
+        if p:
+            parts.append(p)
+    return "/".join(parts)
 
 # The one canonical list of collective opcode prefixes — longest-prefix
 # entries first so e.g. ragged-all-to-all is not folded into all-to-all.
@@ -127,12 +154,9 @@ class TraceProfile:
         out: Dict[str, float] = {}
         for r in self.ops:
             m = _OP_NAME_RE.search(r.hlo)
-            if m:
-                parts = [p for p in m.group(1).split("/")
-                         if not p.startswith(_TRANSFORM_WRAPPERS)]
-                key = "/".join(parts[:depth]) if parts else "(unscoped)"
-            else:
-                key = "(unscoped)"
+            parts = strip_scope(m.group(1)).split("/") if m else []
+            parts = [p for p in parts if p]
+            key = "/".join(parts[:depth]) if parts else "(unscoped)"
             out[key] = out.get(key, 0.0) + r.total_us
         return dict(sorted(out.items(), key=lambda kv: -kv[1]))
 
@@ -155,22 +179,150 @@ def latest_xplane(logdir: str) -> Optional[str]:
     return max(files, key=os.path.getmtime) if files else None
 
 
-def _load_xspace(path: str):
-    try:
-        from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    except Exception as e:
-        raise ImportError(
-            "parsing xplane.pb requires the xplane proto bundled with "
-            "tensorflow (tensorflow.tsl.profiler.protobuf.xplane_pb2); "
-            f"import failed: {e!r}. Without tensorflow, use the "
-            "XLA-cost-analysis path instead — apex_tpu.prof.hlo."
-            "op_estimates / cost_analysis on the jitted step — "
-            "which needs no trace files (the reference degrades its "
-            "scaler the same way, apex/amp/scaler.py:39-52)") from e
-    xs = xplane_pb2.XSpace()
-    with open(path, "rb") as f:
-        xs.ParseFromString(f.read())
+# --- minimal pure-python XSpace decoder --------------------------------------
+#
+# Protobuf wire format is stable and tiny to read: every field is a
+# (tag = field_no << 3 | wire_type, payload) pair; messages are
+# length-delimited. This decoder covers exactly the XSpace subset
+# parse_trace consumes (field numbers pinned against the tsl proto:
+# XSpace.planes=1; XPlane.name=2/lines=3/event_metadata=4 with map
+# entries key=1/value=2; XLine.name=2/events=4; XEvent.metadata_id=1/
+# duration_ps=3; XEventMetadata.id=1/name=2/display_name=4), so a
+# committed fixture parses in CI without tensorflow.
+
+class _Msg:
+    """Attribute bag for decoded messages."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def _uvarint(buf: bytes, i: int):
+    out = shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, i
+        shift += 7
+
+
+def _fields(buf: bytes):
+    """Yield (field_number, wire_type, value) — value is an int for
+    varints/fixed, bytes for length-delimited."""
+    i = 0
+    while i < len(buf):
+        tag, i = _uvarint(buf, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _uvarint(buf, i)
+        elif wt == 1:
+            v = int.from_bytes(buf[i:i + 8], "little")
+            i += 8
+        elif wt == 2:
+            n, i = _uvarint(buf, i)
+            if i + n > len(buf):      # slicing would silently truncate
+                raise ValueError(f"truncated field {fno} "
+                                 f"({n} bytes past end)")
+            v = buf[i:i + n]
+            i += n
+        elif wt == 5:
+            v = int.from_bytes(buf[i:i + 4], "little")
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _decode_event(buf: bytes) -> _Msg:
+    ev = _Msg(metadata_id=0, duration_ps=0)
+    for fno, _wt, v in _fields(buf):
+        if fno == 1:
+            ev.metadata_id = v
+        elif fno == 3:
+            ev.duration_ps = v
+    return ev
+
+
+def _decode_line(buf: bytes) -> _Msg:
+    line = _Msg(name="", events=[])
+    for fno, _wt, v in _fields(buf):
+        if fno == 2:
+            line.name = v.decode("utf-8", "replace")
+        elif fno == 4:
+            line.events.append(_decode_event(v))
+    return line
+
+
+def _decode_event_metadata(buf: bytes) -> _Msg:
+    md = _Msg(id=0, name="", display_name="")
+    for fno, _wt, v in _fields(buf):
+        if fno == 1:
+            md.id = v
+        elif fno == 2:
+            md.name = v.decode("utf-8", "replace")
+        elif fno == 4:
+            md.display_name = v.decode("utf-8", "replace")
+    return md
+
+
+def _decode_plane(buf: bytes) -> _Msg:
+    plane = _Msg(name="", lines=[], event_metadata={})
+    for fno, _wt, v in _fields(buf):
+        if fno == 2:
+            plane.name = v.decode("utf-8", "replace")
+        elif fno == 3:
+            plane.lines.append(_decode_line(v))
+        elif fno == 4:
+            key, md = 0, None
+            for efno, _ewt, ev in _fields(v):     # map entry
+                if efno == 1:
+                    key = ev
+                elif efno == 2:
+                    md = _decode_event_metadata(ev)
+            if md is not None:
+                plane.event_metadata[key or md.id] = md
+    return plane
+
+
+def decode_xspace(data: bytes) -> _Msg:
+    """Decode a serialized XSpace with the pure-python reader — the
+    tensorflow-free fallback behind :func:`parse_trace`."""
+    xs = _Msg(planes=[])
+    for fno, _wt, v in _fields(data):
+        if fno == 1:
+            xs.planes.append(_decode_plane(v))
     return xs
+
+
+def _load_xspace(path: str):
+    if os.environ.get("APEX_TPU_XPLANE_PURE") != "1":
+        try:
+            from tensorflow.tsl.profiler.protobuf import xplane_pb2
+            xs = xplane_pb2.XSpace()
+            with open(path, "rb") as f:
+                xs.ParseFromString(f.read())
+            return xs
+        except OSError:
+            raise                     # file problems are not decode paths
+        except Exception:
+            # no/broken tensorflow (a partial install can raise far
+            # more than ImportError): the minimal decoder below
+            pass
+    try:
+        with open(path, "rb") as f:
+            return decode_xspace(f.read())
+    except (ValueError, IndexError) as e:
+        raise ValueError(
+            f"could not decode {path!r} as an XSpace proto (pure-python "
+            f"fallback): {e!r}. If tensorflow is available its bundled "
+            "proto (tensorflow.tsl.profiler.protobuf.xplane_pb2) handles "
+            "schema extensions; without trace files at all, use the "
+            "XLA-cost-analysis path instead — apex_tpu.prof.hlo."
+            "op_estimates / cost_analysis on the jitted step (the "
+            "reference degrades its scaler the same way, "
+            "apex/amp/scaler.py:39-52)") from e
 
 
 def parse_trace(logdir_or_file: str, device_index: int = 0) -> TraceProfile:

@@ -148,6 +148,10 @@ class FlightRecorder:
             tracer.subscribe(self.on_step)
         self.collective_bytes = collective_bytes
         self.extra_statics: Dict[str, Any] = {}
+        # JSON-able digest of the compiled step's HBM footprint
+        # (prof.MemoryReport.summary()) — embedded in the crash header
+        # so an OOM dump names the biggest buffers instead of just dying
+        self.memory_report: Optional[Dict[str, Any]] = None
         self._installed = False
         self._dumped = False
         self._abnormal_seen = False
@@ -207,6 +211,19 @@ class FlightRecorder:
                     self._ring[-1].extra.update(extra)
                 return
         self.record(metrics=metrics, **extra)
+
+    def attach_memory_report(self, report) -> "FlightRecorder":
+        """Attach the compiled step's :class:`apex_tpu.prof.MemoryReport`
+        (or an already-digested ``summary()`` dict). Stored as a plain
+        JSON-able dict — no live references, so dumping never touches
+        the (possibly wedged) runtime."""
+        if report is None:
+            self.memory_report = None
+        elif isinstance(report, dict):
+            self.memory_report = dict(report)
+        else:
+            self.memory_report = report.summary()
+        return self
 
     @property
     def last_completed_span(self) -> Optional[str]:
@@ -282,6 +299,8 @@ class FlightRecorder:
                                      if self.tracer is not None else None),
             "n_steps_recorded": len(self._ring),
         }
+        if self.memory_report is not None:
+            hdr["memory_report"] = self.memory_report
         from apex_tpu.trace.debug_nans import first_nan
         hit = first_nan()
         if hit is not None:

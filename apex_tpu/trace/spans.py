@@ -256,6 +256,30 @@ class Tracer:
         if target is not None:
             target.spans.append(ev)
 
+    def add_span_event(self, name: str, kind: str, dur_ms: float,
+                       t_end: Optional[float] = None) -> None:
+        """Record a span *retroactively* — an event whose duration was
+        only known after the fact (e.g. a compile detected by
+        :mod:`apex_tpu.prof.compile_watch` once the dispatch returned).
+        The event is back-dated so the timeline shows it where it
+        actually ran; it lands in the current step (or the latest
+        retained one, so post-step compiles are not lost)."""
+        now = (time.perf_counter() if t_end is None else t_end) - self._t0
+        ev = SpanEvent(name, kind, now - dur_ms * 1e-3, dur_ms,
+                       depth=len(self._open))
+        target = self._current
+        if target is not None:
+            target.spans.append(ev)
+            return
+        with self._lock:
+            if self.steps:
+                self.steps[-1].spans.append(ev)
+            else:
+                st = StepTrace(None, ev.t_start)
+                st.dur_ms = dur_ms
+                st.spans.append(ev)
+                self.steps.append(st)
+
     @property
     def open_spans(self) -> List[str]:
         """Names of in-flight spans, outermost first: still-open ones

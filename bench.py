@@ -3,9 +3,14 @@
 The BASELINE.json metric — images/sec/chip + MFU on ResNet-50, amp O2
 (bf16 compute, fp32 masters) + fused SGD — measured on whatever single
 accelerator is present. Prints ONE JSON line, whose ``extra`` also
-carries the BERT-Large LAMB row (the 61.0%-MFU headline workload) and
-the DDP comm-mode column (bucket plan + wire-byte ratios for
-exact/bf16/int8 gradient sync — see apex_tpu.parallel.comm).
+carries the BERT-Large LAMB row (the 61.0%-MFU headline workload), the
+DDP comm-mode column (bucket plan + wire-byte ratios for
+exact/bf16/int8 gradient sync — see apex_tpu.parallel.comm), the
+``peak_hbm_bytes`` footprint column (runtime allocator peak on TPU,
+apex_tpu.prof.memory report estimate elsewhere — AOT, zero extra
+dispatches on the measured path), and ``n_compiles`` (process-wide
+backend-compile count from apex_tpu.prof.compile_watch — a step
+silently retracing per call explodes this column).
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
@@ -584,9 +589,37 @@ def _bert_row(on_tpu: bool):
             "batch": b, "seq": s}
 
 
+def _memory_row(batch: int, size: int):
+    """The `peak_hbm_bytes` column: AOT-compile the headline step (one
+    compile, ZERO dispatches — the measured path is untouched) and read
+    the footprint. On TPU the runtime allocator's peak-bytes-in-use
+    (which saw the measured run) is authoritative; off-TPU the report's
+    peak-live estimate stands in. Also returns the class split so a
+    driver diff can attribute a footprint regression."""
+    from apex_tpu import prof
+
+    step, (state, batch_stats), (x, y) = _resnet_step_builder(batch, size)
+    compiled = jax.jit(step).lower(state, batch_stats, x, y).compile()
+    rep = prof.memory_report(compiled, batch_size=batch)
+    sample = prof.device_memory_sample()
+    peak = sample.get("peak_bytes_in_use")
+    return {
+        "peak_hbm_bytes": int(peak) if peak else int(rep.peak_live_bytes),
+        "source": "device" if peak else "report",
+        "peak_live_estimate_bytes": int(rep.peak_live_bytes),
+        "hbm_limit_bytes": rep.hbm_limit,
+        "classes_mib": {k: round(v / 2 ** 20, 2)
+                        for k, v in rep.classes.items()},
+    }
+
+
 def main():
     from apex_tpu import models, prof
+    from apex_tpu.prof import compile_watch as _cw
 
+    # process-wide compile counters for the n_compiles column — a
+    # listener registration, nothing on the measured path
+    _cw.install()
     on_tpu = jax.default_backend() == "tpu"
     size = 224 if on_tpu else 64
     # batch sweep: 256 is the sweet spot measured on v5e (see PERF.md).
@@ -627,6 +660,14 @@ def main():
         ddp_comm = _ddp_comm_modes()
     except Exception as e:
         ddp_comm = {"failed": type(e).__name__}
+    try:
+        mem = _memory_row(best_batch, size)
+    except Exception as e:
+        mem = {"failed": type(e).__name__}
+    # every trace/lowering/backend-compile the bench performed — a
+    # steady-state regression (a step silently retracing per call)
+    # shows up here as n_compiles exploding
+    n_compiles = int(_cw.global_counters()["compiles"])
 
     print(json.dumps({
         "metric": "resnet50_amp_o2_images_per_sec",
@@ -648,6 +689,9 @@ def main():
                   "batch": best_batch, "size": size,
                   "device": getattr(jax.devices()[0], "device_kind", "?"),
                   "loss": best_loss,
+                  "peak_hbm_bytes": mem.get("peak_hbm_bytes"),
+                  "memory": mem,
+                  "n_compiles": n_compiles,
                   "bert_large_lamb": bert,
                   "ddp_comm_modes": ddp_comm},
     }))

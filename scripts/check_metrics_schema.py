@@ -26,11 +26,22 @@ counters, JSON-object-per-line):
 non-negative numbers; a crash/watchdog header names the last-completed
 span (string or null) and lists in-flight spans.
 
+``--kind memory`` — the memory/compile event channel
+(``MetricsLogger(memory_sink=...)``; keep in lockstep with
+``apex_tpu/prof/memory.py`` and ``compile_watch.py``): ``kind`` in
+{memory, memory_report, retrace, compile}. A ``memory`` event is one
+runtime allocator sample (bytes in use / peak / limit, null off-TPU);
+``memory_report`` carries the compiled step's footprint (total + peak
+bytes, the per-class breakdown, top buffers); ``retrace``/``compile``
+are the retrace-detector warnings naming the function and the changed
+argument.
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
-Usage: python scripts/check_metrics_schema.py [--kind metrics|trace] FILE
+Usage: python scripts/check_metrics_schema.py
+           [--kind metrics|trace|memory] FILE
 """
 
 from __future__ import annotations
@@ -69,6 +80,31 @@ TRACE_NULLABLE = {
     "watchdog": ("last_step", "last_completed_span",
                  "in_flight_collective"),
 }
+
+
+# --- memory / compile channel schema -----------------------------------------
+
+MEMORY_KINDS = ("memory", "memory_report", "retrace", "compile")
+#: required keys per memory-event kind (beyond "kind" itself)
+MEMORY_REQUIRED = {
+    "memory": ("rank",),
+    "memory_report": ("rank", "total_bytes", "peak_live_bytes",
+                      "classes"),
+    "retrace": ("fn", "changed"),
+    "compile": ("fn", "dur_ms"),
+}
+#: keys that may be null per kind (everything else non-null when present)
+MEMORY_NULLABLE = {
+    "memory": ("step", "bytes_in_use", "peak_bytes_in_use",
+               "bytes_limit"),
+    "memory_report": ("step", "hbm_limit", "batch_size"),
+    "retrace": ("step",),
+    "compile": ("step", "changed"),
+}
+#: byte-count fields that must be non-negative integers when present
+MEMORY_BYTE_FIELDS = ("total_bytes", "attributed_bytes",
+                      "peak_live_bytes", "batch_bytes", "bytes_in_use",
+                      "peak_bytes_in_use", "bytes_limit", "hbm_limit")
 
 
 # --- shared core -------------------------------------------------------------
@@ -232,7 +268,69 @@ def check_trace_lines(lines) -> List[str]:
     return errors
 
 
-CHECKERS = {"metrics": check_lines, "trace": check_trace_lines}
+# --- memory schema -----------------------------------------------------------
+
+def check_memory_lines(lines) -> List[str]:
+    """All memory-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates runtime allocator samples, compiled-step
+    memory reports, and retrace-detector events."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in MEMORY_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{MEMORY_KINDS}, got {kind!r}")
+            continue
+        for key in MEMORY_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = MEMORY_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        for key in MEMORY_BYTE_FIELDS:
+            _check_counter(i, rec, key, errors, what="byte field")
+        if kind in ("retrace", "compile"):
+            if not isinstance(rec.get("fn"), str):
+                errors.append(f"line {i}: {kind} 'fn' must be a string")
+            dm = rec.get("dur_ms")
+            if dm is not None and "dur_ms" in rec and (
+                    not _is_number(dm) or dm < 0):
+                errors.append(f"line {i}: 'dur_ms' must be a "
+                              f"non-negative number, got {dm!r}")
+        if kind == "memory_report":
+            classes = rec.get("classes")
+            if not isinstance(classes, dict):
+                errors.append(f"line {i}: 'classes' must be an object")
+            else:
+                for ck, cv in classes.items():
+                    if (not isinstance(cv, int) or isinstance(cv, bool)
+                            or cv < 0):
+                        errors.append(
+                            f"line {i}: classes[{ck!r}] must be a "
+                            f"non-negative int, got {cv!r}")
+            tb = rec.get("top_buffers")
+            if tb is not None and not (
+                    isinstance(tb, list)
+                    and all(isinstance(b, dict)
+                            and isinstance(b.get("name"), str)
+                            and isinstance(b.get("bytes"), int)
+                            for b in tb)):
+                errors.append(f"line {i}: 'top_buffers' must be a list "
+                              "of {name: str, bytes: int, ...}")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
+
+
+CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
+            "memory": check_memory_lines}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,158 @@
+#!/usr/bin/env python
+"""Regenerate tests/fixtures/resnet_step.xplane.pb.
+
+A miniature XSpace trace shaped exactly like an on-chip
+``jax.profiler.trace`` capture of one ResNet O2 step (device plane
+"/device:TPU:0" with "XLA Modules" + "XLA Ops" lines, per-op HLO
+metadata carrying fusion kinds and named-scope paths, plus a host plane
+the parser must skip). Written with a pure-stdlib protobuf encoder —
+regenerating the fixture needs no tensorflow, and
+``tests/test_prof.py::TestXplaneFixture`` pins the decoded per-op table
+against the values below, so a parser regression surfaces in CI instead
+of only on-chip.
+
+The op set is a faithful miniature of a real v5e capture's shape
+(mega-fusions dominating, one conv, one all-reduce, a copy) with
+hand-chosen durations — small enough to commit, rich enough to exercise
+opcode extraction, fusion-kind categories, collective classification,
+scope attribution, and occurrence aggregation.
+
+Usage: python scripts/make_xplane_fixture.py [OUT.pb]
+"""
+
+import os
+import sys
+
+
+# --- minimal protobuf encoder (wire format) ----------------------------------
+
+def _uvarint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def field_varint(fno: int, v: int) -> bytes:
+    return _uvarint(fno << 3 | 0) + _uvarint(v)
+
+
+def field_bytes(fno: int, v: bytes) -> bytes:
+    return _uvarint(fno << 3 | 2) + _uvarint(len(v)) + v
+
+
+def field_str(fno: int, s: str) -> bytes:
+    return field_bytes(fno, s.encode())
+
+
+# --- XSpace schema subset (field numbers per the tsl xplane proto) -----------
+
+def event(metadata_id: int, duration_ps: int, offset_ps: int = 0) -> bytes:
+    return (field_varint(1, metadata_id) + field_varint(2, offset_ps)
+            + field_varint(3, duration_ps))
+
+
+def line(name: str, events) -> bytes:
+    body = field_str(2, name)
+    for ev in events:
+        body += field_bytes(4, ev)
+    return body
+
+
+def event_metadata(mid: int, name: str) -> bytes:
+    return field_varint(1, mid) + field_str(2, name)
+
+
+def plane(name: str, lines=(), metadata=()) -> bytes:
+    body = field_str(2, name)
+    for l in lines:
+        body += field_bytes(3, l)
+    for mid, md in metadata:
+        body += field_bytes(4, field_varint(1, mid) + field_bytes(2, md))
+    return body
+
+
+def xspace(planes) -> bytes:
+    return b"".join(field_bytes(1, p) for p in planes)
+
+
+# --- the fixture content -----------------------------------------------------
+
+#: (metadata_id, HLO text, [duration_us per occurrence]) — the pinned
+#: per-op table lives in tests/test_prof.py; keep the two in lockstep.
+OPS = [
+    (10, '%fusion.31 = bf16[64,14,14,256]{3,2,1,0:T(8,128)(2,1)} '
+         'fusion(bf16[64,14,14,256]{3,2,1,0} %p0, bf16[256]{0} %p1), '
+         'kind=kOutput, calls=%fused_computation.31, '
+         'metadata={op_name="jit(step)/jvp(amp/fwd)/stage3/bn_relu"}',
+     [93.0, 91.5]),
+    (11, '%convolution.7 = bf16[64,14,14,256]{3,2,1,0:T(8,128)(2,1)} '
+         'convolution(bf16[64,14,14,256]{3,2,1,0} %x, '
+         'bf16[3,3,256,256]{3,2,1,0} %w), window={size=3x3 pad=1_1x1_1}, '
+         'dim_labels=b01f_01io->b01f, '
+         'metadata={op_name="jit(step)/jvp(amp/fwd)/stage3/conv"}',
+     [74.2, 73.8]),
+    (12, '%all-reduce.3 = f32[524288]{0:T(1024)} all-reduce('
+         'f32[524288]{0} %grads), replica_groups={{0,1,2,3,4,5,6,7}}, '
+         'to_apply=%sum, metadata={op_name='
+         '"jit(step)/ddp/sync_gradients/bucket00/psum"}',
+     [41.0]),
+    (13, '%fusion.88 = (f32[1024]{0}, f32[1024]{0}) fusion('
+         'bf16[64,14,14,1024]{3,2,1,0} %dz), kind=kInput, '
+         'calls=%fused_computation.88, metadata={op_name='
+         '"jit(step)/transpose(jvp(amp/fwd))/stage3/bn_bwd_sums"}',
+     [49.7, 50.3]),
+    (14, '%copy.5 = bf16[64,56,56,64]{3,2,1,0:T(8,128)(2,1)} '
+         'copy(bf16[64,56,56,64]{1,3,2,0} %p4)',
+     [12.5]),
+    (15, '%custom-call.9 = bf16[64,512,8,64]{3,2,1,0} custom-call('
+         'bf16[64,512,8,64]{3,2,1,0} %q), custom_call_target='
+         '"tpu_custom_call", metadata={op_name='
+         '"jit(step)/jvp(amp/fwd)/attn/flash_attention"}',
+     [31.0]),
+]
+
+MODULE_RUNS = [990.0, 1010.0]     # us — two steps captured
+
+
+def build() -> bytes:
+    md = [(1, event_metadata(1, "jit_step(1234)"))]
+    op_events = []
+    t = 0
+    for mid, hlo, durs in OPS:
+        md.append((mid, event_metadata(mid, hlo)))
+        for d in durs:
+            op_events.append(event(mid, int(d * 1e6), offset_ps=t))
+            t += int(d * 1e6)
+    mod_events = [event(1, int(d * 1e6), offset_ps=i * 10 ** 9)
+                  for i, d in enumerate(MODULE_RUNS)]
+    device = plane("/device:TPU:0",
+                   lines=[line("XLA Modules", mod_events),
+                          line("XLA Ops", op_events)],
+                   metadata=md)
+    host = plane("/host:CPU",
+                 lines=[line("python", [event(1, 5_000_000)])],
+                 metadata=[(1, event_metadata(1, "hostloop"))])
+    return xspace([host, device])
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures", "resnet_step.xplane.pb")
+    data = build()
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "wb") as f:
+        f.write(data)
+    print(f"wrote {out} ({len(data)} bytes, {len(OPS)} ops, "
+          f"{len(MODULE_RUNS)} module runs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

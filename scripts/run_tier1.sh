@@ -59,6 +59,12 @@ EOF
     echo "== smoke: DDP overlap audit (8-device CPU variant)"
     JAX_PLATFORMS=cpu python scripts/pod_comm_budget.py --cpu8
 
+    echo "== smoke: memory-budget audit (8-device CPU variant)"
+    # asserts: (a) class attribution == memory_analysis within 1%,
+    # (b) ZeRO optimizer state ~1/N vs replicated, (c) compile_watch
+    # 1 steady-state compile + named changed arg on a forced retrace
+    JAX_PLATFORMS=cpu python scripts/memory_budget.py --cpu8
+
     echo "smoke ok"
     exit 0
 fi
