@@ -22,6 +22,9 @@ A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
 - ``apex_tpu.trace``     — distributed tracing + flight recorder: span-level
                            step timelines (Chrome-trace/Perfetto export),
                            crash dumps, hang watchdog, NaN provenance.
+- ``apex_tpu.lint``      — apexlint: jaxpr/HLO static-analysis passes that
+                           catch precision leaks, donation misses, implicit
+                           resharding and host syncs before they cost a run.
 
 Unlike the reference (an interception-based library over an eager framework),
 apex_tpu expresses the same capabilities as *policies, functional transforms and
@@ -37,6 +40,7 @@ from apex_tpu import _compat  # noqa: F401  (installs jax API shims first)
 from apex_tpu import amp
 from apex_tpu import arena
 from apex_tpu import fp16_utils
+from apex_tpu import lint
 from apex_tpu import monitor
 from apex_tpu import ops
 from apex_tpu import optim
@@ -46,5 +50,6 @@ from apex_tpu import reparam
 from apex_tpu import trace
 from apex_tpu import utils
 
-__all__ = ["amp", "arena", "fp16_utils", "monitor", "ops", "optim",
-           "parallel", "prof", "reparam", "trace", "utils", "__version__"]
+__all__ = ["amp", "arena", "fp16_utils", "lint", "monitor", "ops",
+           "optim", "parallel", "prof", "reparam", "trace", "utils",
+           "__version__"]

@@ -1,0 +1,109 @@
+"""apexlint — static analysis for compiled training steps.
+
+The reference apex kept mixed-precision training correct *by
+construction* (cast lists, opt-level validation at initialize time);
+apexlint closes the remaining gap by auditing what was actually traced
+and compiled. Two passes, both strictly AOT (trace + compile only —
+never a device dispatch; the ``lint/no-extra-dispatch`` compile-check
+case pins that an observed step stays bit-identical):
+
+- the **jaxpr pass** (:mod:`apex_tpu.lint.jaxpr_pass`) walks
+  ``jax.make_jaxpr`` output: RNG-key reuse, f64 creep, fp32 matmuls
+  inside an active half-precision amp policy, host callbacks / debug
+  prints traced into the step;
+- the **HLO pass** (:mod:`apex_tpu.lint.hlo_pass`) walks the optimized
+  scheduled HLO (reusing :mod:`apex_tpu.prof.memory`'s buffer parser
+  and the :mod:`apex_tpu.monitor` collective accounting): donation
+  misses with wasted-HBM estimates, collectives outside any known
+  named scope (implicit resharding) with wire-byte cost, host
+  transfers, and off-tile-grid matmul padding waste.
+
+Typical use — lint the step exactly as you run it (pass your jitted
+function so its ``donate_argnums`` are what gets audited)::
+
+    jstep = jax.jit(train_step, donate_argnums=(0, 1))
+    report = lint.lint_step(jstep, state, batch_stats, x, y,
+                            policy=policy)
+    print(report.table())
+    assert not report.errors
+
+CLI: ``python scripts/apexlint.py --flagship both`` (the
+``run_tier1.sh --smoke`` CI gate), or ``--hlo dump.txt`` for a
+pre-dumped module. Findings stream to JSONL via
+``MetricsLogger(lint_sink=...)`` and validate with
+``scripts/check_metrics_schema.py --kind lint``. Rule catalog,
+severities and the baseline-file workflow: docs/linting.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from apex_tpu.lint.findings import (Finding, Report, Rule, RULES,
+                                    SEVERITIES, load_baseline,
+                                    save_baseline)
+from apex_tpu.lint.hlo_pass import lint_hlo_text
+from apex_tpu.lint.jaxpr_pass import lint_jaxpr
+
+__all__ = ["Finding", "Report", "Rule", "RULES", "SEVERITIES",
+           "lint_step", "lint_jaxpr", "lint_hlo_text", "lint_hlo_file",
+           "load_baseline", "save_baseline"]
+
+
+def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
+              known_scopes: Sequence[str] = (),
+              min_donation_bytes: int = 4096,
+              rules: Optional[Sequence[str]] = None,
+              fn_name: Optional[str] = None, **kwargs) -> Report:
+    """Lint one training step with both passes. Strictly AOT.
+
+    ``fn`` may be a plain callable or a jitted function — pass the
+    jitted one so the HLO pass sees your real ``donate_argnums``
+    (donation is part of what is being audited). The jaxpr pass traces
+    ``fn`` with ``jax.make_jaxpr``; the HLO pass compiles it (or reuses
+    ``compiled=`` / ``hlo_text=`` when the caller already has the
+    executable, avoiding a second compile). ``policy`` activates the
+    fp32-matmul-in-amp rule; ``known_scopes`` extends the
+    implicit-resharding allowlist (regex fragments).
+    """
+    jaxpr_rules = {"rng-key-reuse", "f64-creep", "fp32-matmul-in-amp",
+                   "host-callback-in-step"}
+    findings = []
+    if fn is not None and (rules is None
+                           or jaxpr_rules & set(rules)):
+        # skip the (potentially expensive) trace entirely when the
+        # caller selected HLO-pass rules only — with compiled= that
+        # makes lint_step compile-free AND trace-free
+        findings += lint_jaxpr(fn, *args, policy=policy, **kwargs)
+    hlo_rules = {"donation-miss", "implicit-resharding",
+                 "host-transfer", "tile-padding"}
+    if hlo_text is None and (rules is None or hlo_rules & set(rules)):
+        # same economy as the trace skip above: no XLA compile when the
+        # caller selected jaxpr-pass rules only
+        if compiled is not None:
+            hlo_text = compiled.as_text()
+        elif fn is not None:
+            from apex_tpu.prof import hlo as _hlo
+            hlo_text = _hlo.compiled_hlo(fn, *args, **kwargs)
+    if hlo_text:
+        findings += lint_hlo_text(
+            hlo_text, known_scopes=known_scopes,
+            min_donation_bytes=min_donation_bytes, rules=rules)
+    if rules is not None:
+        findings = [f for f in findings if f.rule in set(rules)]
+    if fn_name is None and fn is not None:
+        fn_name = getattr(fn, "__name__", None) or type(fn).__name__
+    return Report(findings, fn_name=fn_name)
+
+
+def lint_hlo_file(path: str, *, known_scopes: Sequence[str] = (),
+                  min_donation_bytes: int = 4096) -> Report:
+    """HLO-pass-only lint of a dumped optimized-HLO text file
+    (``scripts/dump_hlo.py`` output or an XLA dump)."""
+    with open(path) as f:
+        text = f.read()
+    import os
+    return Report(
+        lint_hlo_text(text, known_scopes=known_scopes,
+                      min_donation_bytes=min_donation_bytes),
+        fn_name=os.path.basename(path))

@@ -1,0 +1,277 @@
+"""Finding/Report plumbing shared by both apexlint passes.
+
+A lint run produces :class:`Finding` records — one per rule violation,
+each carrying the rule id, severity, a human message, a fix-it hint, and
+machine evidence (HLO op / scope path / bytes) — collected into a
+:class:`Report` that renders a table, serializes to the ``lint`` JSONL
+channel (``MetricsLogger(lint_sink=...)``,
+``check_metrics_schema.py --kind lint``), and applies a baseline
+suppression file so previously-accepted findings don't block CI
+(docs/linting.md describes the workflow).
+
+Severities:
+
+- **error** — statically provable waste or a per-step host sync that
+  will cost the run (donation miss, host transfer, f64 creep, RNG key
+  reuse). CI gates on these (``apexlint --fail-on error``).
+- **warning** — a smell that is sometimes intentional (fp32 matmul
+  under an amp policy, a collective outside any known named scope).
+- **info** — advisory (tile-grid padding waste estimates).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = ["Finding", "Report", "Rule", "RULES", "SEVERITIES",
+           "load_baseline", "save_baseline"]
+
+#: severity names, most severe first (index = sort key)
+SEVERITIES = ("error", "warning", "info")
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One lint rule's identity: stable id, default severity, fix-it."""
+
+    id: str            # stable id, e.g. "APX101"
+    slug: str          # human name, e.g. "donation-miss"
+    severity: str      # default severity
+    title: str         # one-line description (the docs/linting.md row)
+    fix: str           # generic fix-it hint (findings may specialize)
+
+
+#: the rule catalog — ids are stable across releases (baselines and
+#: dashboards key on them); keep docs/linting.md in lockstep.
+RULES: Dict[str, Rule] = {r.slug: r for r in (
+    # jaxpr pass (trace-time semantics)
+    Rule("APX001", "rng-key-reuse", "error",
+         "the same PRNG key feeds more than one random primitive — "
+         "the draws are correlated, not independent",
+         "jax.random.split the key and use one subkey per draw"),
+    Rule("APX002", "f64-creep", "error",
+         "float64 values in the step jaxpr — TPUs emulate f64 at a "
+         "severe cost and it silently doubles HBM",
+         "cast to float32 at the boundary (or find the numpy scalar "
+         "that promoted the graph and .astype it)"),
+    Rule("APX003", "fp32-matmul-in-amp", "warning",
+         "an fp32 dot_general/conv runs inside an active bf16/fp16 amp "
+         "policy region — fp32 creep halves MXU throughput",
+         "cast the operands to the policy compute dtype (amp.auto_cast "
+         "region, or check the cast list covers this op)"),
+    Rule("APX004", "host-callback-in-step", "error",
+         "a host callback / debug print is traced into the step fn — "
+         "every step round-trips to the host",
+         "remove jax.debug.print/pure_callback from the steady-state "
+         "step (gate them behind trace.debug_nans-style flags)"),
+    # HLO pass (what XLA actually compiled)
+    Rule("APX101", "donation-miss", "error",
+         "a params/opt-state-sized input is not aliased to any output — "
+         "the buffer is double-allocated every step",
+         "donate the carried state: jax.jit(step, donate_argnums=...)"),
+    Rule("APX102", "implicit-resharding", "warning",
+         "a compiled collective is not attributable to any known named "
+         "scope — likely an implicit reshard XLA inserted",
+         "name the intended collective (trace.span/ddp.sync) or fix the "
+         "sharding so XLA stops moving data"),
+    Rule("APX103", "host-transfer", "error",
+         "the steady-state step compiles host traffic (infeed/outfeed/"
+         "send/recv/python callbacks)",
+         "keep device→host fetches out of the compiled step; amortize "
+         "telemetry through MetricsLogger"),
+    Rule("APX104", "tile-padding", "info",
+         "matmul operand dims are off the TPU tile grid — XLA pads to "
+         "(sublane,128) tiles and the padding is wasted HBM/MXU work",
+         "size matmul dims to multiples of (8,128) for f32 / (16,128) "
+         "for bf16 where the model allows"),
+)}
+
+_RULES_BY_ID = {r.id: r for r in RULES.values()}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation with its evidence."""
+
+    rule: str                      # Rule.slug
+    message: str                   # specialized human message
+    severity: Optional[str] = None  # default: the rule's severity
+    op: Optional[str] = None       # HLO instruction / jaxpr primitive
+    scope: Optional[str] = None    # named-scope / arg path / jaxpr path
+    bytes: Optional[int] = None    # wasted / moved bytes, when estimable
+    count: int = 1                 # occurrences folded into this finding
+    fix: Optional[str] = None      # specialized fix-it (default: rule's)
+
+    def __post_init__(self):
+        if self.rule not in RULES:
+            raise ValueError(f"unknown lint rule {self.rule!r}")
+        if self.severity is None:
+            self.severity = RULES[self.rule].severity
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r}")
+        if self.fix is None:
+            self.fix = RULES[self.rule].fix
+
+    @property
+    def id(self) -> str:
+        return RULES[self.rule].id
+
+    def fingerprint(self) -> str:
+        """Stable identity for baseline suppression: rule + where.
+        Bytes/counts are excluded — a baselined finding stays
+        suppressed when its size drifts."""
+        return f"{self.rule}|{self.op or ''}|{self.scope or ''}"
+
+    def to_event(self, fn: Optional[str] = None,
+                 step: Optional[int] = None) -> Dict:
+        """``kind="lint_finding"`` event for the lint JSONL channel."""
+        return {"kind": "lint_finding", "rule": self.rule, "id": self.id,
+                "severity": self.severity, "message": self.message,
+                "fix": self.fix, "op": self.op, "scope": self.scope,
+                "bytes": self.bytes, "count": self.count, "fn": fn,
+                "step": step}
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return ""
+    for unit, div in (("GiB", 2 ** 30), ("MiB", 2 ** 20), ("KiB", 2 ** 10)):
+        if abs(n) >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{int(n)} B"
+
+
+class Report:
+    """Ordered collection of findings from one lint run."""
+
+    def __init__(self, findings: Iterable[Finding], *,
+                 fn_name: Optional[str] = None, suppressed: int = 0):
+        self.findings: List[Finding] = sorted(
+            findings, key=lambda f: (SEVERITIES.index(f.severity),
+                                     f.id, f.scope or "", f.op or ""))
+        self.fn_name = fn_name
+        #: findings dropped by a baseline file (apply_baseline)
+        self.suppressed = suppressed
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def by_severity(self) -> Dict[str, int]:
+        out = {s: 0 for s in SEVERITIES}
+        for f in self.findings:
+            out[f.severity] += 1
+        return out
+
+    def by_rule(self, rule: str) -> List[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    def max_severity(self) -> Optional[str]:
+        return self.findings[0].severity if self.findings else None
+
+    def wasted_bytes(self, rule: Optional[str] = None) -> int:
+        """Sum of byte evidence across findings (optionally one rule) —
+        e.g. total HBM a donation fix would reclaim."""
+        return sum(f.bytes or 0 for f in self.findings
+                   if rule is None or f.rule == rule)
+
+    # -- baseline suppression ------------------------------------------------
+
+    def apply_baseline(self, baseline: Optional[Sequence[str]]) -> "Report":
+        """New Report without findings whose fingerprint is baselined."""
+        if not baseline:
+            return self
+        accepted = set(baseline)
+        kept = [f for f in self.findings
+                if f.fingerprint() not in accepted]
+        return Report(kept, fn_name=self.fn_name,
+                      suppressed=self.suppressed
+                      + (len(self.findings) - len(kept)))
+
+    # -- renderings ----------------------------------------------------------
+
+    def table(self) -> str:
+        head = f"apexlint: {len(self.findings)} finding(s)"
+        if self.fn_name:
+            head += f" on {self.fn_name}"
+        sev = self.by_severity()
+        head += (f" ({sev['error']} error, {sev['warning']} warning, "
+                 f"{sev['info']} info"
+                 + (f"; {self.suppressed} baselined" if self.suppressed
+                    else "") + ")")
+        lines = [head]
+        if not self.findings:
+            lines.append("  clean.")
+            return "\n".join(lines)
+        lines.append(f"  {'id':<7} {'severity':<8} {'rule':<22} "
+                     f"{'bytes':>10}  evidence")
+        for f in self.findings:
+            where = f.scope or f.op or ""
+            if f.op and f.scope:
+                where = f"{f.scope} [{f.op}]"
+            if f.count > 1:
+                where += f" (x{f.count})"
+            lines.append(f"  {f.id:<7} {f.severity:<8} {f.rule:<22} "
+                         f"{_fmt_bytes(f.bytes):>10}  {where[:70]}")
+            lines.append(f"          {f.message[:100]}")
+            lines.append(f"          fix: {f.fix[:100]}")
+        return "\n".join(lines)
+
+    def summary(self) -> Dict:
+        """JSON-able digest (the ``bench.py`` lint_findings source)."""
+        return {"n_findings": len(self.findings),
+                "by_severity": self.by_severity(),
+                "suppressed": self.suppressed,
+                "wasted_bytes": self.wasted_bytes(),
+                "rules": sorted({f.rule for f in self.findings})}
+
+    def to_events(self, step: Optional[int] = None) -> List[Dict]:
+        """``kind="lint_report"`` header + one ``lint_finding`` event per
+        finding — the stream ``check_metrics_schema.py --kind lint``
+        validates (emit via ``MetricsLogger.record_lint`` /
+        ``attach_lint_report``)."""
+        ev: Dict = {"kind": "lint_report", "fn": self.fn_name,
+                    "step": step, "suppressed": self.suppressed}
+        ev.update({"n_findings": len(self.findings),
+                   "by_severity": self.by_severity()})
+        return [ev] + [f.to_event(self.fn_name, step)
+                       for f in self.findings]
+
+
+# -- baseline files -----------------------------------------------------------
+
+def load_baseline(path: str) -> List[str]:
+    """Fingerprints from a baseline file (see docs/linting.md).
+
+    Format: ``{"version": 1, "suppress": ["rule|op|scope", ...]}``.
+    A missing file is an empty baseline (the committed CI baseline
+    starts empty on purpose — new error findings must break the gate).
+    """
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return []
+    if not isinstance(data, dict) or not isinstance(
+            data.get("suppress"), list):
+        raise ValueError(f"{path}: not a lint baseline "
+                         '(expected {"version": 1, "suppress": [...]})')
+    return [str(s) for s in data["suppress"]]
+
+
+def save_baseline(path: str, report: Report) -> int:
+    """Write every finding of ``report`` as the new baseline; returns
+    the number of suppressions written."""
+    fps = sorted({f.fingerprint() for f in report.findings})
+    with open(path, "w") as f:
+        json.dump({"version": 1, "suppress": fps}, f, indent=1)
+        f.write("\n")
+    return len(fps)

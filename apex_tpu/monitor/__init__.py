@@ -23,11 +23,12 @@ from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
                                           wire_report)
 from apex_tpu.monitor.logger import MetricsLogger
 from apex_tpu.monitor.metrics import (METRIC_FIELDS, Metrics, metrics_init,
-                                      metrics_to_dict)
+                                      metrics_snapshot, metrics_to_dict)
 from apex_tpu.monitor.sinks import CSVSink, JSONLSink, Sink, StdoutSink
 
 __all__ = [
-    "Metrics", "metrics_init", "metrics_to_dict", "METRIC_FIELDS",
+    "Metrics", "metrics_init", "metrics_to_dict", "metrics_snapshot",
+    "METRIC_FIELDS",
     "MetricsLogger",
     "Sink", "StdoutSink", "JSONLSink", "CSVSink",
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",

@@ -66,7 +66,9 @@ class MetricsLogger:
                  flops_per_step: Optional[float] = None,
                  collective_bytes_per_step: Optional[int] = None,
                  trace_sink: Optional[Sink] = None,
-                 memory_sink: Optional[Sink] = None):
+                 memory_sink: Optional[Sink] = None,
+                 lint_sink: Optional[Sink] = None,
+                 donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
         self.flush_every = max(int(flush_every), 1)
@@ -78,6 +80,18 @@ class MetricsLogger:
         #: ``check_metrics_schema.py --kind memory``)
         self.memory_sink = memory_sink
         self.memory_report = None      # last attached prof.MemoryReport
+        #: the ``lint`` event channel (kind="lint_report"/"lint_finding"
+        #: events from apex_tpu.lint — validate with
+        #: ``check_metrics_schema.py --kind lint``)
+        self.lint_sink = lint_sink
+        self.lint_report = None        # last attached lint.Report
+        #: snapshot each recorded metrics pytree into fresh device
+        #: buffers (async scalar copies). REQUIRED when the step is
+        #: jitted with donate_argnums over the state carrying the
+        #: metrics: donation invalidates the input buffers on the next
+        #: dispatch, and an un-snapshotted buffered record would be
+        #: "Array has been deleted" by flush time.
+        self.donation_safe = donation_safe
         if peak_flops is None:
             from apex_tpu.prof.report import device_peak_flops
             peak_flops = device_peak_flops() or None
@@ -121,6 +135,9 @@ class MetricsLogger:
     def record(self, metrics: Metrics, **extra) -> None:
         """Buffer one device snapshot. ``extra`` keys (host scalars only)
         are merged into the emitted record at flush."""
+        if self.donation_safe:
+            from apex_tpu.monitor.metrics import metrics_snapshot
+            metrics = metrics_snapshot(metrics)
         now = time.perf_counter()
         self._buf.append((metrics, dict(extra)) if extra else (metrics, None))
         self._times.append(now)
@@ -144,7 +161,21 @@ class MetricsLogger:
             return
         buf, times = self._buf, self._times
         self._buf, self._times = [], []
-        host = jax.device_get([m for m, _ in buf])
+        try:
+            host = jax.device_get([m for m, _ in buf])
+        except RuntimeError:
+            # a donated step invalidated buffered snapshots (the caller
+            # should pass donation_safe=True) — salvage what survives
+            # record-by-record instead of losing the whole window
+            host = []
+            for m, _ in buf:
+                try:
+                    host.append(jax.device_get(m))
+                except RuntimeError:
+                    host.append(None)
+            buf = [b for b, h in zip(buf, host) if h is not None]
+            times = [t for t, h in zip(times, host) if h is not None]
+            host = [h for h in host if h is not None]
         thru = self._throughput()
         for (_, extra), m, t in zip(buf, host, times):
             rec: Dict = metrics_to_dict(m)
@@ -241,6 +272,28 @@ class MetricsLogger:
             self.record_memory(report.to_event(rank=rank))
         return self
 
+    # -- lint channel --------------------------------------------------------
+
+    def record_lint(self, event: Dict) -> None:
+        """Emit one lint event (``kind="lint_report"|"lint_finding"``)
+        through the lint channel — plain-dict pass-through like
+        :meth:`record_event` (lint runs are rare AOT audits; nothing is
+        buffered)."""
+        if self.lint_sink is not None and not self._closed:
+            self.lint_sink.emit(dict(event))
+
+    def attach_lint_report(self, report,
+                           step: Optional[int] = None) -> "MetricsLogger":
+        """Attach an :class:`apex_tpu.lint.Report`: emits its
+        ``lint_report`` header + one ``lint_finding`` event per finding
+        and keeps the report for consumers (``bench.py`` reads the
+        finding count into its default JSON)."""
+        self.lint_report = report
+        if report is not None:
+            for ev in report.to_events(step=step):
+                self.record_lint(ev)
+        return self
+
     def close(self) -> None:
         if self._closed:
             return
@@ -251,6 +304,8 @@ class MetricsLogger:
             self.trace_sink.close()
         if self.memory_sink is not None:
             self.memory_sink.close()
+        if self.lint_sink is not None:
+            self.lint_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

@@ -28,7 +28,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Metrics", "metrics_init", "metrics_to_dict", "METRIC_FIELDS"]
+__all__ = ["Metrics", "metrics_init", "metrics_to_dict",
+           "metrics_snapshot", "METRIC_FIELDS"]
 
 
 class Metrics(NamedTuple):
@@ -81,6 +82,19 @@ def metrics_init() -> Metrics:
         growth_count=jnp.int32(0),
         backoff_count=jnp.int32(0),
     )
+
+
+def metrics_snapshot(m):
+    """Donation-safe copy of a metrics pytree (or any small pytree of
+    device arrays): fresh device buffers via async scalar copies, so a
+    step jitted with ``donate_argnums`` over the state carrying these
+    arrays cannot invalidate what a :class:`MetricsLogger` /
+    :class:`~apex_tpu.trace.FlightRecorder` buffered for a later
+    amortized fetch. A handful of scalar device copies per call — still
+    async, still no host sync (``MetricsLogger(donation_safe=True)``
+    applies it automatically at record time)."""
+    return jax.tree_util.tree_map(
+        lambda a: a.copy() if hasattr(a, "copy") else a, m)
 
 
 def metrics_to_dict(m: Metrics) -> dict:

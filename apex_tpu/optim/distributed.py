@@ -96,10 +96,17 @@ def _reduce_scatter_mean(buf, axis_name: Axis, world: int,
     ``wire_dtype`` compresses the scatter's wire format (e.g.
     ``jnp.bfloat16`` halves ICI bytes, the grad-side sibling of the
     ``param_gather_dtype`` compressed all-gather); the result is cast
-    back to the input dtype before the mean division."""
+    back to the input dtype before the mean division.
+
+    Runs under the ``zero/grad_scatter`` span so the reduce-scatters
+    are attributable in xplane traces and HLO dumps — the scope
+    apexlint's implicit-resharding rule recognizes as planned."""
+    from apex_tpu.trace.spans import span as _span
     out = buf if wire_dtype is None else buf.astype(wire_dtype)
-    for a in _axes(axis_name):
-        out = jax.lax.psum_scatter(out, a, scatter_dimension=0, tiled=True)
+    with _span("zero/grad_scatter", kind="collective"):
+        for a in _axes(axis_name):
+            out = jax.lax.psum_scatter(out, a, scatter_dimension=0,
+                                       tiled=True)
     if wire_dtype is not None:
         out = out.astype(buf.dtype)
     return out / world
@@ -107,10 +114,13 @@ def _reduce_scatter_mean(buf, axis_name: Axis, world: int,
 
 def _all_gather_shard(shard, axis_name: Axis):
     """Exact inverse of :func:`_reduce_scatter_mean`'s tiling: gather the
-    axes in reverse order."""
+    axes in reverse order — under the ``zero/param_gather`` span (same
+    attribution contract as ``zero/grad_scatter``)."""
+    from apex_tpu.trace.spans import span as _span
     out = shard
-    for a in reversed(_axes(axis_name)):
-        out = jax.lax.all_gather(out, a, axis=0, tiled=True)
+    with _span("zero/param_gather", kind="collective"):
+        for a in reversed(_axes(axis_name)):
+            out = jax.lax.all_gather(out, a, axis=0, tiled=True)
     return out
 
 

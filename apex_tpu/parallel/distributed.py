@@ -53,6 +53,22 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from apex_tpu.parallel.mesh import DATA_AXIS
 
+#: named-scope patterns (regex fragments) under which this package
+#: deliberately emits collectives — the allowlist apexlint's
+#: implicit-resharding rule (APX102) checks compiled collectives
+#: against. Every planned collective in the stack runs under one of
+#: these spans: DDP sync (+ per-bucket sub-spans), SyncBatchNorm's
+#: stats psums (flax module scope), ZeRO grad scatter / param gather
+#: (apex_tpu.optim.distributed). A collective matching none of them in
+#: optimized HLO is a reshard nobody asked for.
+KNOWN_COLLECTIVE_SCOPES = (
+    r"ddp/sync_gradients",
+    r"(^|/)bucket\d+",
+    r"(?i)sync_?batch_?norm",
+    r"zero/(grad_scatter|param_gather)",
+    r"(^|/)ring_",
+)
+
 
 def _is_float(x):
     return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)

@@ -19,7 +19,8 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
-__all__ = ["cost_analysis", "op_estimates", "OpEstimate", "compiled_hlo"]
+__all__ = ["cost_analysis", "op_estimates", "OpEstimate", "compiled_hlo",
+           "iter_instructions"]
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
@@ -132,6 +133,31 @@ _INSTR_RE = re.compile(
     r"(?P<op>[\w-]+)\((?P<args>[^)]*)\)")
 
 
+def iter_instructions(hlo_text: str):
+    """Yield ``(name, shape, opcode, operands, line)`` for every
+    instruction of an HLO text dump — top level or inside fused/nested
+    computations. The ONE operand parser (apexlint's tile rule shares
+    it): operand names are resolved by the caller against a module-wide
+    name→shape table since optimized HLO names operands without inline
+    types."""
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        args_text = m.group("args")
+        if "%" in args_text:
+            # older printers inline operand types ("f32[32,64]{1,0} %x"),
+            # whose commas break naive splitting — take the %-prefixed
+            # names directly
+            operands = re.findall(r"%([^\s,)]+)", args_text)
+        else:
+            operands = [a.strip().split()[-1]
+                        for a in args_text.split(",") if a.strip()]
+        yield (m.group("n").lstrip("%"), m.group("shape"),
+               m.group("op"), operands, line)
+
+
 def op_estimates(fn, *args, top: Optional[int] = None,
                  **kwargs) -> List[OpEstimate]:
     """Per-instruction FLOPs/bytes estimates from the optimized HLO.
@@ -146,24 +172,9 @@ def op_estimates(fn, *args, top: Optional[int] = None,
     text = compiled_hlo(fn, *args, **kwargs)
     shapes: Dict[str, str] = {}
     parsed = []
-    for raw in text.splitlines():
-        line = raw.strip()
-        m = _INSTR_RE.match(line)
-        if not m:
-            continue
-        name = m.group("n").lstrip("%")
-        shapes[name] = m.group("shape")
-        args_text = m.group("args")
-        if "%" in args_text:
-            # older printers inline operand types ("f32[32,64]{1,0} %x"),
-            # whose commas break naive splitting — take the %-prefixed
-            # names directly
-            operands = re.findall(r"%([^\s,)]+)", args_text)
-        else:
-            operands = [a.strip().split()[-1]
-                        for a in args_text.split(",") if a.strip()]
-        parsed.append((name, m.group("shape"), m.group("op"), operands,
-                       line))
+    for name, shape, op, operands, line in iter_instructions(text):
+        shapes[name] = shape
+        parsed.append((name, shape, op, operands, line))
 
     out: List[OpEstimate] = []
     for name, shape, opcode, operands, line in parsed:

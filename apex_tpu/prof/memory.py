@@ -53,6 +53,7 @@ from apex_tpu.prof.xplane import strip_scope as _strip_scope
 __all__ = [
     "MemoryReport", "BufferRecord", "memory_report", "memory_stats_of",
     "hbm_capacity", "device_memory_sample", "BUFFER_CLASSES",
+    "parse_entry",
 ]
 
 #: attribution classes, in table order. The first four are the
@@ -218,11 +219,17 @@ def _entry_lines(hlo_text: str) -> List[str]:
     return []
 
 
-def _parse_entry(hlo_text: str):
+_PARAM_NUM_RE = re.compile(r"parameter\((\d+)\)")
+
+
+def parse_entry(hlo_text: str):
     """(args, instrs, root_operands) of the entry computation.
 
-    args: [(name, shape, arg_path)];
+    args: [(name, shape, arg_path, param_number)];
     instrs: [(idx, name, shape, opcode, operands, scope, is_root)].
+
+    Shared scheduled-HLO parser: buffer attribution here and the
+    apexlint HLO pass (``apex_tpu.lint``) read the same records.
     """
     args, instrs = [], []
     root_ops: List[str] = []
@@ -235,8 +242,10 @@ def _parse_entry(hlo_text: str):
         sm = _OP_NAME_RE.search(line)
         op_name = sm.group(1) if sm else ""
         if op == "parameter":
+            pm = _PARAM_NUM_RE.search(line)
+            pnum = int(pm.group(1)) if pm else len(args)
             # the arg-path metadata has escaped quotes: state.params[\'w\']
-            args.append((name, shape, op_name.replace("\\'", "'")))
+            args.append((name, shape, op_name.replace("\\'", "'"), pnum))
         # operand names: %-prefixed tokens inside the call parens
         tail = line.split(f" {op}(", 1)[-1]
         operands = re.findall(r"%([\w.\-]+)", tail)
@@ -448,9 +457,9 @@ def memory_report(fn, *args, batch_size: Optional[int] = None,
 
     arg_records: List[BufferRecord] = []
     classes = {cls: 0 for cls in BUFFER_CLASSES}
-    args_meta, instrs, _root = _parse_entry(text)
+    args_meta, instrs, _root = parse_entry(text)
     parsed_arg_bytes = 0
-    for name, shape, path in args_meta:
+    for name, shape, path, _pnum in args_meta:
         nbytes = shape_bytes(shape)
         parsed_arg_bytes += nbytes
         cls = classify_arg_path(path or name)

@@ -8,9 +8,11 @@ DDP comm-mode column (bucket plan + wire-byte ratios for
 exact/bf16/int8 gradient sync — see apex_tpu.parallel.comm), the
 ``peak_hbm_bytes`` footprint column (runtime allocator peak on TPU,
 apex_tpu.prof.memory report estimate elsewhere — AOT, zero extra
-dispatches on the measured path), and ``n_compiles`` (process-wide
+dispatches on the measured path), ``n_compiles`` (process-wide
 backend-compile count from apex_tpu.prof.compile_watch — a step
-silently retracing per call explodes this column).
+silently retracing per call explodes this column), and
+``lint_findings``/``lint_errors`` (apexlint finding counts on the
+compiled headline step — see apex_tpu.lint / docs/linting.md).
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
@@ -329,17 +331,23 @@ def _bench_dcgan(batch, iters):
     return batch * K / dt, dt / K, flops_step * K / dt
 
 
-def _bench_bert(batch, seq):
-    """Config 5: BERT-Large MLM step with FusedLAMB + fused LayerNorm +
-    flash attention."""
+def _bert_step_builder(batch, seq, encoder=None, vocab=30000):
+    """ONE construction of the BERT-LAMB MLM step (amp O1 + FusedLAMB,
+    auto_cast forward) shared by the bench row, the apexlint flagship
+    (`scripts/apexlint.py --flagship bert` — the program the smoke gate
+    lints must be the program the bench measures), and
+    `scripts/prof_bert.py`. ``encoder=None`` builds the full BertLarge;
+    pass a scaled `models.BertEncoder` for CPU structural variants.
+    Returns ``(step, state, (toks, labels), policy, enc, variables)``.
+    """
     from apex_tpu import amp, models
     from apex_tpu.optim import FusedLAMB
 
     policy = amp.Policy.from_opt_level("O1")
-    enc = models.BertLarge()
+    enc = encoder if encoder is not None else models.BertLarge()
     rng = np.random.RandomState(0)
-    toks = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
+    toks = jnp.asarray(rng.randint(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, vocab, (batch, seq)), jnp.int32)
     variables = enc.init(jax.random.PRNGKey(0), toks[:1])
     amp_opt = amp.Amp(policy, FusedLAMB(lr=1e-3))
     state = amp_opt.init(variables["params"])
@@ -351,6 +359,14 @@ def _bench_bert(batch, seq):
         loss, grads, state, finite = amp_opt.backward(state, loss_fn)
         return amp_opt.apply_gradients(state, grads, finite), loss
 
+    return step, state, (toks, labels), policy, enc, variables
+
+
+def _bench_bert(batch, seq):
+    """Config 5: BERT-Large MLM step with FusedLAMB + fused LayerNorm +
+    flash attention."""
+    step, state, (toks, labels), _policy, _enc, variables = \
+        _bert_step_builder(batch, seq)
     dev_dt, wall_dt, _ = _scan_device_time(step, (state,),
                                            (toks, labels), n_carry=1)
     n_params = sum(int(np.prod(l.shape)) for l in
@@ -481,10 +497,16 @@ def run_monitor(steps: int = 20, jsonl_path: str = "MONITOR.jsonl"):
     batch, size = (128, 224) if on_tpu else (8, 64)
     step, (state, batch_stats), (x, y) = _resnet_step_builder(
         batch, size, monitor=True)
-    jstep = jax.jit(step)
+    # donate the carried state (apexlint APX101: an undonated
+    # state+batch_stats double-allocates them every step — this loop
+    # shipped without donation until the lint rule flagged it)
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    # donation_safe: the donated state carries the metrics pytree, so
+    # the logger snapshots each record (async scalar copies) instead of
+    # buffering buffers the next dispatch would invalidate
     logger = monitor.MetricsLogger(
         sinks=[monitor.StdoutSink(), monitor.JSONLSink(jsonl_path)],
-        flush_every=5)
+        flush_every=5, donation_safe=True)
     logger.attach(jstep, state, batch_stats, x, y)
     for _ in range(steps):
         state, batch_stats, _loss = jstep(state, batch_stats, x, y)
@@ -508,7 +530,8 @@ def run_trace(steps: int = 3, chrome_path: str = "TRACE.json",
     batch, size = (128, 224) if on_tpu else (8, 64)
     step, (state, batch_stats), (x, y) = _resnet_step_builder(
         batch, size, monitor=True)
-    jstep = jax.jit(step)
+    # carried state donated (apexlint APX101, same fix as run_monitor)
+    jstep = jax.jit(step, donate_argnums=(0, 1))
 
     tracer = trace.Tracer()
     recorder = trace.FlightRecorder("TRACE_CRASH.jsonl",
@@ -529,8 +552,11 @@ def run_trace(steps: int = 3, chrome_path: str = "TRACE.json",
                     # sync point: materialize the loss so the span
                     # timeline measures real step time, not async submit
                     float(np.asarray(loss))
-                logger.record(state.metrics, images_per_step=batch)
-                recorder.record_metrics(state.metrics)
+                # one donation-safe snapshot feeds both consumers (the
+                # donated next dispatch would invalidate the originals)
+                m = monitor.metrics_snapshot(state.metrics)
+                logger.record(m, images_per_step=batch)
+                recorder.record_metrics(m)
     logger.close()
     recorder.uninstall()
     tracer.write_chrome_trace(chrome_path)
@@ -590,19 +616,28 @@ def _bert_row(on_tpu: bool):
 
 
 def _memory_row(batch: int, size: int):
-    """The `peak_hbm_bytes` column: AOT-compile the headline step (one
-    compile, ZERO dispatches — the measured path is untouched) and read
-    the footprint. On TPU the runtime allocator's peak-bytes-in-use
-    (which saw the measured run) is authoritative; off-TPU the report's
-    peak-live estimate stands in. Also returns the class split so a
-    driver diff can attribute a footprint regression."""
-    from apex_tpu import prof
+    """The `peak_hbm_bytes` + `lint_findings` columns: AOT-compile the
+    headline step (one compile, ZERO dispatches — the measured path is
+    untouched) and read the footprint + apexlint report off the same
+    executable. The compile is donated like the measured scan program
+    (an undonated compile here was itself a donation-miss apexlint
+    flagged — the report must describe the program actually measured).
+    On TPU the runtime allocator's peak-bytes-in-use (which saw the
+    measured run) is authoritative; off-TPU the report's peak-live
+    estimate stands in. Also returns the class split so a driver diff
+    can attribute a footprint regression."""
+    from apex_tpu import amp, lint, prof
 
     step, (state, batch_stats), (x, y) = _resnet_step_builder(batch, size)
-    compiled = jax.jit(step).lower(state, batch_stats, x, y).compile()
+    compiled = jax.jit(step, donate_argnums=(0, 1)).lower(
+        state, batch_stats, x, y).compile()
     rep = prof.memory_report(compiled, batch_size=batch)
     sample = prof.device_memory_sample()
     peak = sample.get("peak_bytes_in_use")
+    lint_rep = lint.lint_step(
+        step, state, batch_stats, x, y,
+        policy=amp.Policy.from_opt_level("O2"), compiled=compiled,
+        fn_name="resnet50_o2_step")
     return {
         "peak_hbm_bytes": int(peak) if peak else int(rep.peak_live_bytes),
         "source": "device" if peak else "report",
@@ -610,6 +645,7 @@ def _memory_row(batch: int, size: int):
         "hbm_limit_bytes": rep.hbm_limit,
         "classes_mib": {k: round(v / 2 ** 20, 2)
                         for k, v in rep.classes.items()},
+        "lint": lint_rep.summary(),
     }
 
 
@@ -691,6 +727,13 @@ def main():
                   "loss": best_loss,
                   "peak_hbm_bytes": mem.get("peak_hbm_bytes"),
                   "memory": mem,
+                  # apexlint finding count on the compiled headline
+                  # step (AOT — same executable the memory row reads);
+                  # error-severity findings here mean the measured
+                  # program wastes HBM or syncs the host per step
+                  "lint_findings": mem.get("lint", {}).get("n_findings"),
+                  "lint_errors": mem.get("lint", {}).get(
+                      "by_severity", {}).get("error"),
                   "n_compiles": n_compiles,
                   "bert_large_lamb": bert,
                   "ddp_comm_modes": ddp_comm},

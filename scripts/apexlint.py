@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""apexlint CLI — lint compiled training steps before they cost a run.
+
+Three ways to name the step:
+
+``--flagship resnet|bert|both`` (default: both)
+    The BASELINE.md flagship steps, built exactly as ``bench.py`` runs
+    them (ResNet-50 amp O2 + FusedSGD; BERT LAMB amp O1), jitted WITH
+    their donation so the donation rule audits the real program. On an
+    accelerator the full-size configs are used; on CPU the structural
+    downscalings (the same convention as ``pod_comm_budget --cpu8`` /
+    ``memory_budget --cpu8``: ResNet at 64px/b8, a 4-layer BERT at
+    seq 128) — same step structure, CPU-compilable.
+
+``--import pkg.mod:builder``
+    ``builder()`` must return ``(step_fn, args)`` or
+    ``(step_fn, args, policy)``; ``step_fn`` may be jitted (pass your
+    real ``donate_argnums``).
+
+``--hlo FILE``
+    HLO-pass-only lint of a dumped optimized-HLO text file
+    (``scripts/dump_hlo.py`` output or an XLA dump).
+
+Output: the finding table on stdout; ``--jsonl FILE`` streams
+``lint_report``/``lint_finding`` events through the
+``MetricsLogger(lint_sink=...)`` channel (validate with
+``check_metrics_schema.py --kind lint``); ``--json`` prints a summary
+object. ``--baseline FILE`` suppresses previously-accepted findings
+(``--write-baseline`` records the current findings as that file);
+``--fail-on error|warning|never`` (default error) sets the exit gate —
+``run_tier1.sh --smoke`` runs the flagship lint with the committed
+(empty) ``scripts/apexlint_baseline.json`` so any new error-severity
+finding breaks CI. Everything is AOT: trace + compile, zero dispatches.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _build_flagship_resnet():
+    """The headline ResNet-50 amp O2 step, donated as bench measures it."""
+    import jax
+    import bench
+    from apex_tpu import amp
+    on_tpu = jax.default_backend() == "tpu"
+    batch, size = (256, 224) if on_tpu else (8, 64)
+    step, (state, batch_stats), (x, y) = bench._resnet_step_builder(
+        batch, size, "O2")
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    return (jstep, (state, batch_stats, x, y),
+            amp.Policy.from_opt_level("O2"), "resnet50_o2_step")
+
+
+def _build_flagship_bert():
+    """The BERT LAMB step, built by bench's own `_bert_step_builder`
+    (the lint gate audits the program the bench measures), donated. CPU
+    uses a 4-layer structural downscale — XLA:CPU takes minutes just to
+    compile the 24-layer BertLarge module (see bench._bert_row)."""
+    import jax
+    import bench
+    from apex_tpu import models
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        enc, batch, seq = None, 16, 512      # None -> full BertLarge
+    else:
+        enc = models.BertEncoder(30000, hidden=256, layers=4, heads=4,
+                                 max_len=128)
+        batch, seq = 2, 128
+    step, state, (toks, labels), policy, _enc, _vars = \
+        bench._bert_step_builder(batch, seq, encoder=enc)
+    jstep = jax.jit(step, donate_argnums=(0,))
+    return jstep, (state, toks, labels), policy, "bert_lamb_step"
+
+
+FLAGSHIPS = {"resnet": _build_flagship_resnet,
+             "bert": _build_flagship_bert}
+
+
+def _import_builder(spec):
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise SystemExit(f"--import wants pkg.mod:builder, got {spec!r}")
+    import importlib
+    built = getattr(importlib.import_module(mod_name), fn_name)()
+    if len(built) == 2:
+        fn, args = built
+        policy = None
+    else:
+        fn, args, policy = built[:3]
+    return fn, args, policy, spec
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    flagship = None
+    imports, hlo_files = [], []
+    baseline_path = write_baseline = jsonl_path = None
+    fail_on = "error"
+    as_json = False
+    it = iter(argv)
+    for a in it:
+        if a in ("-h", "--help"):
+            print(__doc__)
+            return 2
+        elif a == "--json":
+            as_json = True
+            continue
+        elif a not in ("--flagship", "--import", "--hlo", "--baseline",
+                       "--write-baseline", "--jsonl", "--fail-on"):
+            print(f"unknown arg {a!r}\n{__doc__}", file=sys.stderr)
+            return 2
+        val = next(it, None)
+        if val is None:
+            print(f"{a} requires a value\n{__doc__}", file=sys.stderr)
+            return 2
+        if a == "--flagship":
+            flagship = val
+        elif a == "--import":
+            imports.append(val)
+        elif a == "--hlo":
+            hlo_files.append(val)
+        elif a == "--baseline":
+            baseline_path = val
+        elif a == "--write-baseline":
+            write_baseline = val
+        elif a == "--jsonl":
+            jsonl_path = val
+        elif a == "--fail-on":
+            fail_on = val
+    if fail_on not in ("error", "warning", "never"):
+        print(f"--fail-on must be error|warning|never, got {fail_on!r}",
+              file=sys.stderr)
+        return 2
+    if flagship is None and not imports and not hlo_files:
+        flagship = "both"
+    targets = []
+    if flagship:
+        names = list(FLAGSHIPS) if flagship == "both" else [flagship]
+        for n in names:
+            if n not in FLAGSHIPS:
+                print(f"unknown flagship {n!r} (choices: "
+                      f"{', '.join(FLAGSHIPS)}, both)", file=sys.stderr)
+                return 2
+            targets.append(("flagship", n))
+    targets += [("import", s) for s in imports]
+    targets += [("hlo", p) for p in hlo_files]
+
+    from apex_tpu import lint
+    baseline = lint.load_baseline(baseline_path) if baseline_path else []
+
+    logger = None
+    if jsonl_path:
+        from apex_tpu import monitor
+        logger = monitor.MetricsLogger(
+            sinks=[], lint_sink=monitor.JSONLSink(jsonl_path))
+
+    reports, raw_findings = [], []
+    for kind, what in targets:
+        if kind == "hlo":
+            report = lint.lint_hlo_file(what)
+        else:
+            fn, args, policy, name = (FLAGSHIPS[what]()
+                                      if kind == "flagship"
+                                      else _import_builder(what))
+            report = lint.lint_step(fn, *args, policy=policy,
+                                    fn_name=name)
+        # the written baseline must cover EVERYTHING that fired —
+        # including findings the read baseline suppresses, or a
+        # --baseline X --write-baseline X refresh would drop still-live
+        # accepted debt and resurface it as new failures
+        raw_findings += report.findings
+        report = report.apply_baseline(baseline)
+        reports.append(report)
+        if as_json:
+            out = {"fn": report.fn_name}
+            out.update(report.summary())
+            print(json.dumps(out))
+        else:
+            print(report.table())
+        if logger is not None:
+            logger.attach_lint_report(report)
+    if logger is not None:
+        logger.close()
+
+    if write_baseline:
+        n = lint.save_baseline(write_baseline, lint.Report(raw_findings))
+        print(f"wrote {write_baseline} ({n} suppressions)")
+
+    # severity rank comes from the one canonical ordering (index =
+    # sort key) in apex_tpu.lint.SEVERITIES
+    sev_rank = {s: i for i, s in enumerate(lint.SEVERITIES)}
+    worst = min((sev_rank[r.max_severity()] for r in reports
+                 if r.max_severity()), default=99)
+    if fail_on != "never" and worst <= sev_rank[fail_on]:
+        print(f"apexlint: failing (findings at or above "
+              f"--fail-on {fail_on})", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,12 +36,20 @@ bytes, the per-class breakdown, top buffers); ``retrace``/``compile``
 are the retrace-detector warnings naming the function and the changed
 argument.
 
+``--kind lint`` — the apexlint event channel
+(``MetricsLogger(lint_sink=...)``; keep in lockstep with
+``apex_tpu/lint/findings.py``): ``kind`` in {lint_report,
+lint_finding}. A ``lint_report`` header carries the finding count and
+per-severity breakdown; each ``lint_finding`` names its rule (stable
+id), severity in {error, warning, info}, message, fix-it hint, and
+evidence (op / scope / bytes).
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
-           [--kind metrics|trace|memory] FILE
+           [--kind metrics|trace|memory|lint] FILE
 """
 
 from __future__ import annotations
@@ -105,6 +113,22 @@ MEMORY_NULLABLE = {
 MEMORY_BYTE_FIELDS = ("total_bytes", "attributed_bytes",
                       "peak_live_bytes", "batch_bytes", "bytes_in_use",
                       "peak_bytes_in_use", "bytes_limit", "hbm_limit")
+
+
+# --- lint channel schema ------------------------------------------------------
+
+LINT_KINDS = ("lint_report", "lint_finding")
+LINT_SEVERITIES = ("error", "warning", "info")
+#: required keys per lint-event kind (beyond "kind" itself)
+LINT_REQUIRED = {
+    "lint_report": ("n_findings", "by_severity"),
+    "lint_finding": ("rule", "id", "severity", "message"),
+}
+#: keys that may be null per kind (everything else non-null when present)
+LINT_NULLABLE = {
+    "lint_report": ("step", "fn"),
+    "lint_finding": ("step", "fn", "op", "scope", "bytes", "fix"),
+}
 
 
 # --- shared core -------------------------------------------------------------
@@ -329,8 +353,65 @@ def check_memory_lines(lines) -> List[str]:
     return errors
 
 
+# --- lint schema --------------------------------------------------------------
+
+def check_lint_lines(lines) -> List[str]:
+    """All lint-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates apexlint report headers and findings."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in LINT_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{LINT_KINDS}, got {kind!r}")
+            continue
+        for key in LINT_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = LINT_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "bytes", errors, what="byte field")
+        _check_counter(i, rec, "count", errors, what="field")
+        _check_counter(i, rec, "step", errors, what="field")
+        if kind == "lint_report":
+            _check_counter(i, rec, "n_findings", errors, what="field")
+            _check_counter(i, rec, "suppressed", errors, what="field")
+            sev = rec.get("by_severity")
+            if not isinstance(sev, dict):
+                errors.append(f"line {i}: 'by_severity' must be an "
+                              "object")
+            else:
+                for sk, sv in sev.items():
+                    if sk not in LINT_SEVERITIES:
+                        errors.append(f"line {i}: by_severity key "
+                                      f"{sk!r} not in {LINT_SEVERITIES}")
+                    if (not isinstance(sv, int) or isinstance(sv, bool)
+                            or sv < 0):
+                        errors.append(f"line {i}: by_severity[{sk!r}] "
+                                      f"must be a non-negative int, got "
+                                      f"{sv!r}")
+        if kind == "lint_finding":
+            for key in ("rule", "id", "message"):
+                if key in rec and not isinstance(rec.get(key), str):
+                    errors.append(f"line {i}: {key!r} must be a string")
+            sev = rec.get("severity")
+            if sev is not None and sev not in LINT_SEVERITIES:
+                errors.append(f"line {i}: 'severity' must be one of "
+                              f"{LINT_SEVERITIES}, got {sev!r}")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
+
+
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
-            "memory": check_memory_lines}
+            "memory": check_memory_lines, "lint": check_lint_lines}
 
 
 def main(argv=None) -> int:

@@ -1,6 +1,14 @@
 """Per-op profile of the BERT-Large LAMB bench step (VERDICT r2 item 3).
 
 Usage: python scripts/prof_bert.py [--batch N] [--seq N] [--top N]
+           [--lint]
+
+``--lint`` runs apexlint over the exact jitted step being profiled and
+fails (exit 1) on any error-severity finding — the donation audit that
+keeps this driver honest: the step carries the whole AmpState (fp32
+params + LAMB m/v slots) in argnum 0, and donating it is what keeps
+opt state from being re-allocated every step (apexlint APX101 flags
+the miss, and quantifies the wasted HBM, if the donation ever drops).
 """
 
 import os
@@ -10,7 +18,6 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -26,31 +33,36 @@ def main():
     if "--top" in argv:
         top = int(argv[argv.index("--top") + 1])
 
-    from apex_tpu import amp, models, prof
-    from apex_tpu.optim import FusedLAMB
+    from apex_tpu import prof
+    import bench
 
-    policy = amp.Policy.from_opt_level("O1")
-    enc = models.BertLarge()
-    rng = np.random.RandomState(0)
-    toks = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(rng.randint(0, 30000, (batch, seq)), jnp.int32)
-    variables = enc.init(jax.random.PRNGKey(0), toks[:1])
-    amp_opt = amp.Amp(policy, FusedLAMB(lr=1e-3))
-    state = amp_opt.init(variables["params"])
-
-    def step(state, toks, labels):
-        def loss_fn(mp):
-            with amp.auto_cast(policy):
-                return models.mlm_loss(enc, {"params": mp}, toks, labels)
-        loss, grads, state, finite = amp_opt.backward(state, loss_fn)
-        return amp_opt.apply_gradients(state, grads, finite), loss
+    # the ONE construction of this step (bench row + apexlint flagship
+    # share it — see bench._bert_step_builder)
+    step, state, (toks, labels), policy, enc, variables = \
+        bench._bert_step_builder(batch, seq)
 
     import tempfile
     import time
 
+    # donate the FULL carried state (argnum 0 = AmpState: fp32 params,
+    # LAMB m/v arena slots, scalers) — apexlint's donation rule audits
+    # this aliasing from the compiled HLO (--lint below / docs/linting.md)
     jstep = jax.jit(step, donate_argnums=(0,))
     from apex_tpu.prof import hlo as _hlo
-    cost = _hlo.cost_analysis(jstep, state, toks, labels)
+    # ONE AOT compile feeds the cost analysis AND (under --lint) the
+    # lint HLO pass — BERT-Large compiles are minutes-class, never twice
+    compiled = jstep.lower(state, toks, labels).compile()
+    ca = _hlo.cost_analysis_of(compiled)
+    cost = {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+
+    if "--lint" in argv:
+        from apex_tpu import lint
+        rep = lint.lint_step(jstep, state, toks, labels, policy=policy,
+                             compiled=compiled, fn_name="prof_bert_step")
+        print(rep.table())
+        if rep.errors:
+            sys.exit(1)
     for _ in range(3):
         state, loss = jstep(state, toks, labels)
     float(loss)

@@ -6,6 +6,9 @@
 #                                   # + a 3-step traced bench.py --trace run
 #                                   # + the DDP overlap audit (8-device
 #                                   #   CPU variant of pod_comm_budget)
+#                                   # + the memory-budget audit (--cpu8)
+#                                   # + apexlint on both flagship steps
+#                                   #   (asserts zero error findings)
 #
 # Exit status is pytest's (or the first failing smoke step). The full
 # run prints DOTS_PASSED=<n> — the count of passing-test dots the driver
@@ -64,6 +67,18 @@ EOF
     # (b) ZeRO optimizer state ~1/N vs replicated, (c) compile_watch
     # 1 steady-state compile + named changed arg on a forced retrace
     JAX_PLATFORMS=cpu python scripts/memory_budget.py --cpu8
+
+    echo "== smoke: apexlint flagship steps (--fail-on error)"
+    # lints the flagship ResNet-O2 and BERT-LAMB steps (CPU structural
+    # downscalings) against the committed baseline — which starts
+    # EMPTY, so any new error-severity finding (donation miss, host
+    # transfer, f64 creep, RNG reuse) breaks this gate
+    JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship both \
+        --baseline scripts/apexlint_baseline.json --fail-on error \
+        --jsonl "$tmp/lint.jsonl"
+
+    echo "== smoke: lint schema validator on the apexlint event stream"
+    python scripts/check_metrics_schema.py --kind lint "$tmp/lint.jsonl"
 
     echo "smoke ok"
     exit 0

@@ -1,0 +1,437 @@
+"""apexlint — jaxpr/HLO static-analysis pass suite.
+
+One seeded-violation fixture per rule (a small jaxpr / HLO module that
+triggers exactly its rule) plus a negative twin that must NOT fire —
+the per-rule contract ISSUE 5 demands — and the integration claims:
+
+- the donation rule's wasted-bytes estimate for the PRE-fix
+  ``prof_bert.py``-structure step (undonated) agrees with
+  ``prof.memory_report``'s params+optimizer_state attribution within
+  5%, and the donated twin lints clean;
+- the post-fix flagship-structure steps produce zero error-severity
+  findings (the no-false-positive guard behind the
+  ``run_tier1.sh --smoke`` gate);
+- Report plumbing: baseline suppression round-trip, lint JSONL events
+  through ``MetricsLogger(lint_sink=...)`` validating under
+  ``check_metrics_schema.py --kind lint`` (in-process and subprocess);
+- the two ``lint/*`` compile-check cases run as registered.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import amp, lint, models, monitor, prof
+from apex_tpu.lint import findings as F
+from apex_tpu.optim import FusedSGD
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_SCHEMA_SCRIPT = os.path.join(_REPO_ROOT, "scripts",
+                              "check_metrics_schema.py")
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- jaxpr pass: seeded violation + negative twin per rule -------------------
+
+class TestRngKeyReuse:
+    def test_fires_on_raw_key_reuse(self):
+        def f(key, x):
+            a = jax.random.normal(key, (4,))
+            b = jax.random.uniform(key, (4,))
+            return a + b + x
+
+        fs = lint.lint_jaxpr(f, jax.random.PRNGKey(0), jnp.zeros(4))
+        hits = [f_ for f_ in fs if f_.rule == "rng-key-reuse"]
+        assert len(hits) == 1 and hits[0].count == 2
+        assert hits[0].severity == "error"
+
+    def test_fires_on_typed_key_reuse(self):
+        def f(key, x):
+            return (jax.random.normal(key, (4,))
+                    + jax.random.uniform(key, (4,)) + x)
+
+        fs = lint.lint_jaxpr(f, jax.random.key(0), jnp.zeros(4))
+        assert "rng-key-reuse" in _rules(fs)
+
+    def test_split_then_use_is_reuse(self):
+        # splitting a key and ALSO drawing from it is the classic bug
+        def f(key):
+            k1, _ = jax.random.split(key)
+            return jax.random.normal(key, (2,)) + jax.random.normal(
+                k1, (2,))
+
+        assert "rng-key-reuse" in _rules(
+            lint.lint_jaxpr(f, jax.random.PRNGKey(0)))
+
+    def test_clean_split_does_not_fire(self):
+        def f(key, x):
+            k1, k2 = jax.random.split(key)
+            return (jax.random.normal(k1, (4,))
+                    + jax.random.uniform(k2, (4,)) + x)
+
+        assert "rng-key-reuse" not in _rules(
+            lint.lint_jaxpr(f, jax.random.PRNGKey(0), jnp.zeros(4)))
+
+
+class TestF64Creep:
+    def test_fires_on_f64(self):
+        from jax.experimental import enable_x64
+        with enable_x64():
+            fs = lint.lint_jaxpr(
+                lambda x: jnp.sum(x.astype(jnp.float64)),
+                jnp.zeros(4, jnp.float32))
+        hits = [f for f in fs if f.rule == "f64-creep"]
+        assert len(hits) == 1 and hits[0].severity == "error"
+        assert hits[0].count >= 1
+
+    def test_clean_f32_does_not_fire(self):
+        fs = lint.lint_jaxpr(lambda x: jnp.sum(x * 2), jnp.zeros(4))
+        assert "f64-creep" not in _rules(fs)
+
+
+class TestFp32MatmulInAmp:
+    def test_fires_under_half_policy(self):
+        pol = amp.Policy.from_opt_level("O2")
+
+        def mm(a, b):
+            return a @ b
+
+        fs = lint.lint_jaxpr(mm, jnp.zeros((8, 128)),
+                             jnp.zeros((128, 128)), policy=pol)
+        hits = [f for f in fs if f.rule == "fp32-matmul-in-amp"]
+        assert len(hits) == 1 and hits[0].severity == "warning"
+
+    def test_bf16_matmul_does_not_fire(self):
+        pol = amp.Policy.from_opt_level("O2")
+
+        def mm(a, b):
+            return a @ b
+
+        fs = lint.lint_jaxpr(
+            mm, jnp.zeros((8, 128), jnp.bfloat16),
+            jnp.zeros((128, 128), jnp.bfloat16), policy=pol)
+        assert "fp32-matmul-in-amp" not in _rules(fs)
+
+    def test_inactive_without_policy(self):
+        def mm(a, b):
+            return a @ b
+
+        fs = lint.lint_jaxpr(mm, jnp.zeros((8, 128)),
+                             jnp.zeros((128, 128)))
+        assert "fp32-matmul-in-amp" not in _rules(fs)
+
+
+class TestHostCallback:
+    def test_fires_on_debug_print(self):
+        def f(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        fs = lint.lint_jaxpr(f, jnp.ones(4))
+        hits = [f_ for f_ in fs if f_.rule == "host-callback-in-step"]
+        assert len(hits) == 1 and hits[0].severity == "error"
+        assert hits[0].op == "debug_callback"
+
+    def test_clean_step_does_not_fire(self):
+        fs = lint.lint_jaxpr(lambda x: x * 2, jnp.ones(4))
+        assert fs == []
+
+
+# --- HLO pass: seeded violation + negative twin per rule ---------------------
+
+def _toy_amp_step():
+    """Small Amp O2 train step with real params/opt-state arg paths."""
+    pol = amp.Policy.from_opt_level("O2")
+    params = {"w": jnp.zeros((64, 64), jnp.float32),
+              "b": jnp.zeros((64,), jnp.float32)}
+    amp_opt = amp.Amp(pol, FusedSGD(lr=0.1, momentum=0.9))
+    state = amp_opt.init(params)
+    x = jnp.zeros((8, 64))
+    y = jnp.zeros((8, 64))
+
+    def step(state, x, y):
+        def loss_fn(mp):
+            return jnp.mean((x @ mp["w"] + mp["b"] - y) ** 2)
+        loss, grads, state, finite = amp_opt.backward(state, loss_fn)
+        return amp_opt.apply_gradients(state, grads, finite), loss
+
+    return step, state, x, y, pol
+
+
+class TestDonationMiss:
+    def test_fires_on_undonated_step(self):
+        step, state, x, y, pol = _toy_amp_step()
+        rep = lint.lint_step(jax.jit(step), state, x, y, policy=pol)
+        hits = rep.by_rule("donation-miss")
+        assert hits and all(h.severity == "error" for h in hits)
+        # evidence: arg paths name the carried state, bytes estimated
+        assert any("opt_state" in (h.scope or "") for h in hits)
+        assert all((h.bytes or 0) > 0 for h in hits)
+
+    def test_donated_step_is_clean(self):
+        step, state, x, y, pol = _toy_amp_step()
+        rep = lint.lint_step(jax.jit(step, donate_argnums=(0,)),
+                             state, x, y, policy=pol)
+        assert rep.by_rule("donation-miss") == []
+        assert rep.errors == []
+
+    def test_inference_params_not_flagged(self):
+        # params that never come back out have no output to donate
+        # into — not carried state, not a finding
+        params = {"w": jnp.zeros((64, 64)), "b": jnp.zeros((64,))}
+
+        def infer(params, x):
+            return x @ params["w"] + params["b"]
+
+        rep = lint.lint_step(jax.jit(infer), params, jnp.zeros((8, 64)))
+        assert rep.by_rule("donation-miss") == []
+
+
+class TestImplicitResharding:
+    def test_fires_on_unscoped_collective(self, mesh8):
+        def step(x):
+            return jax.lax.psum(x, "data")
+
+        m = jax.jit(jax.shard_map(step, mesh=mesh8,
+                                  in_specs=(P("data"),),
+                                  out_specs=P("data"), check_vma=False))
+        text = m.lower(jnp.ones((8, 128))).compile().as_text()
+        hits = [f for f in lint.lint_hlo_text(text)
+                if f.rule == "implicit-resharding"]
+        assert len(hits) == 1
+        assert hits[0].severity == "warning"
+        assert hits[0].op == "all-reduce"
+        assert (hits[0].bytes or 0) > 0      # wire-byte cost attached
+
+    def test_known_scope_not_flagged(self, mesh8):
+        from apex_tpu.trace.spans import span
+
+        def step(x):
+            with span("ddp/sync_gradients", kind="collective"):
+                return jax.lax.psum(x, "data")
+
+        m = jax.jit(jax.shard_map(step, mesh=mesh8,
+                                  in_specs=(P("data"),),
+                                  out_specs=P("data"), check_vma=False))
+        text = m.lower(jnp.ones((8, 128))).compile().as_text()
+        assert [f for f in lint.lint_hlo_text(text)
+                if f.rule == "implicit-resharding"] == []
+
+    def test_zero_scatter_gather_scopes_known(self, mesh8):
+        # the ZeRO optimizer's own collectives run under
+        # zero/grad_scatter / zero/param_gather spans — planned, clean
+        from apex_tpu.optim.distributed import (_all_gather_shard,
+                                                _reduce_scatter_mean)
+
+        def step(x):
+            s = _reduce_scatter_mean(x, "data", 8)
+            return _all_gather_shard(s, "data")
+
+        m = jax.jit(jax.shard_map(step, mesh=mesh8, in_specs=(P(),),
+                                  out_specs=P(), check_vma=False))
+        text = m.lower(jnp.ones((64, 128))).compile().as_text()
+        assert [f for f in lint.lint_hlo_text(text)
+                if f.rule == "implicit-resharding"] == []
+
+
+class TestHostTransfer:
+    def test_fires_on_compiled_callback(self):
+        def f(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        rep = lint.lint_step(f, jnp.ones(4))
+        hits = rep.by_rule("host-transfer")
+        assert hits and hits[0].severity == "error"
+
+    def test_clean_step_has_no_host_traffic(self):
+        rep = lint.lint_step(lambda x: x * 2, jnp.ones(4))
+        assert rep.by_rule("host-transfer") == []
+
+
+class TestTilePadding:
+    def test_fires_on_off_grid_dot(self):
+        def mm(a, b):
+            return a @ b
+
+        text = prof.hlo.compiled_hlo(mm, jnp.zeros((9, 100)),
+                                     jnp.zeros((100, 130)))
+        hits = [f for f in lint.lint_hlo_text(text)
+                if f.rule == "tile-padding"]
+        assert hits
+        assert all((f.bytes or 0) > 0 for f in hits)
+        assert all(f.severity in ("info", "warning") for f in hits)
+
+    def test_aligned_dot_does_not_fire(self):
+        def mm(a, b):
+            return a @ b
+
+        text = prof.hlo.compiled_hlo(mm, jnp.zeros((8, 128)),
+                                     jnp.zeros((128, 128)))
+        assert [f for f in lint.lint_hlo_text(text)
+                if f.rule == "tile-padding"] == []
+
+
+# --- donation rule vs memory_report: the 5% agreement claim ------------------
+
+def _bert_style_step(layers=2, hidden=64, heads=2, vocab=1000,
+                     batch=2, seq=32):
+    """The BERT-LAMB step at test scale — the SAME construction the
+    bench row / apexlint flagship / prof_bert.py share
+    (bench._bert_step_builder), with a tiny encoder."""
+    import bench
+    enc = models.BertEncoder(vocab, hidden=hidden, layers=layers,
+                             heads=heads, max_len=seq * 2)
+    step, state, (toks, labels), policy, _enc, _vars = \
+        bench._bert_step_builder(batch, seq, encoder=enc, vocab=vocab)
+    return step, state, toks, labels, policy
+
+
+class TestDonationVsMemoryReport:
+    def test_prefix_wasted_bytes_agree_within_5pct(self):
+        """The PRE-fix (undonated) prof_bert-structure step: the
+        donation rule's wasted-bytes total must agree with the
+        memory_report params+optimizer_state attribution within 5% —
+        both read the same carried-state buffers off the same compiled
+        module."""
+        step, state, toks, labels, pol = _bert_style_step()
+        compiled = jax.jit(step).lower(state, toks, labels).compile()
+        rep = lint.lint_step(step, state, toks, labels, policy=pol,
+                             compiled=compiled, min_donation_bytes=0)
+        wasted = rep.wasted_bytes("donation-miss")
+        assert wasted > 0
+        mrep = prof.memory_report(compiled)
+        attr = (mrep.classes["params"]
+                + mrep.classes["optimizer_state"])
+        assert attr > 0
+        assert abs(wasted - attr) / attr < 0.05, (wasted, attr)
+
+    @pytest.mark.slow       # second full BERT-structure compile (~15s);
+    def test_postfix_step_lints_clean(self):     # smoke lints full-size
+        step, state, toks, labels, pol = _bert_style_step()
+        rep = lint.lint_step(jax.jit(step, donate_argnums=(0,)),
+                             state, toks, labels, policy=pol)
+        assert rep.errors == [], rep.table()
+
+
+# --- no-false-positive guard: flagship-structure steps -----------------------
+
+class TestFlagshipClean:
+    @pytest.mark.slow       # ResNet-50 compile ~35s on XLA:CPU; the
+    # full-size flagship guard is the run_tier1.sh --smoke apexlint
+    # gate (zero error-severity findings, --fail-on error)
+    def test_resnet_o2_structure_lints_clean(self):
+        """The bench flagship step structure (ResNet + amp O2 +
+        FusedSGD + donated carried state) at test scale: zero
+        error-severity findings — the guard behind the smoke gate's
+        full-size run."""
+        import bench
+        step, (state, batch_stats), (x, y) = bench._resnet_step_builder(
+            4, 32, "O2")
+        rep = lint.lint_step(jax.jit(step, donate_argnums=(0, 1)),
+                             state, batch_stats, x, y,
+                             policy=amp.Policy.from_opt_level("O2"))
+        assert rep.errors == [], rep.table()
+
+
+# --- Report / baseline / JSONL plumbing --------------------------------------
+
+class TestReportPlumbing:
+    def _report(self):
+        def f(x):
+            jax.debug.print("x={x}", x=x.sum())
+            return x * 2
+
+        return lint.lint_step(f, jnp.ones(4), fn_name="seeded")
+
+    def test_severity_ordering_and_table(self):
+        rep = self._report()
+        sevs = [f.severity for f in rep.findings]
+        assert sevs == sorted(sevs, key=F.SEVERITIES.index)
+        t = rep.table()
+        assert "APX004" in t and "fix:" in t
+
+    def test_rule_catalog_is_stable(self):
+        assert {r.id for r in F.RULES.values()} == {
+            "APX001", "APX002", "APX003", "APX004",
+            "APX101", "APX102", "APX103", "APX104"}
+        for r in F.RULES.values():
+            assert r.severity in F.SEVERITIES and r.fix and r.title
+
+    def test_baseline_round_trip(self, tmp_path):
+        rep = self._report()
+        assert rep.errors
+        path = tmp_path / "baseline.json"
+        n = lint.save_baseline(str(path), rep)
+        assert n >= 1
+        baseline = lint.load_baseline(str(path))
+        clean = rep.apply_baseline(baseline)
+        assert len(clean) == 0 and clean.suppressed == len(rep)
+        # a missing baseline file is an empty baseline (the committed
+        # CI file starts empty on purpose)
+        assert lint.load_baseline(str(tmp_path / "missing.json")) == []
+
+    def test_committed_baseline_starts_empty(self):
+        path = os.path.join(_REPO_ROOT, "scripts",
+                            "apexlint_baseline.json")
+        assert lint.load_baseline(path) == []
+
+    def test_jsonl_round_trip_validates(self, tmp_path):
+        """Report -> MetricsLogger lint channel -> JSONL ->
+        check_metrics_schema --kind lint (module-level and subprocess
+        CLI) — the round-trip acceptance test."""
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "scripts"))
+        try:
+            import check_metrics_schema as cms
+        finally:
+            sys.path.pop(0)
+        rep = self._report()
+        path = tmp_path / "lint.jsonl"
+        logger = monitor.MetricsLogger(
+            sinks=[], lint_sink=monitor.JSONLSink(str(path)))
+        logger.attach_lint_report(rep)
+        logger.close()
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 1 + len(rep)
+        assert json.loads(lines[0])["kind"] == "lint_report"
+        assert cms.check_lint_lines(lines) == []
+        proc = subprocess.run(
+            [sys.executable, _SCHEMA_SCRIPT, "--kind", "lint",
+             str(path)], capture_output=True, text=True, cwd=_REPO_ROOT)
+        assert proc.returncode == 0, proc.stderr
+        # and the validator actually rejects garbage
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text('{"kind": "lint_finding", "rule": "x"}\n')
+        assert cms.check_lint_lines(
+            bad.read_text().splitlines()) != []
+
+    def test_fingerprint_excludes_bytes(self):
+        a = F.Finding(rule="donation-miss", message="m", op="arg0",
+                      scope="state.params", bytes=100)
+        b = F.Finding(rule="donation-miss", message="m", op="arg0",
+                      scope="state.params", bytes=999)
+        assert a.fingerprint() == b.fingerprint()
+
+
+# --- compile-check cases ------------------------------------------------------
+
+class TestCompileCheckCases:
+    def _case(self, name):
+        from apex_tpu.ops import compile_check as cc
+        return dict(cc.CASES)[name]
+
+    def test_no_extra_dispatch_case(self):
+        self._case("lint/no-extra-dispatch")()
+
+    @pytest.mark.slow       # compiles 5 kernel families (~20s); also
+    def test_kernel_sweep_case(self):            # runs on-device via
+        self._case("lint/kernel-sweep")()        # python -m apex_tpu.ops

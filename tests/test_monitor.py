@@ -211,6 +211,57 @@ def test_logger_amortized_flush_and_sinks(tmp_path):
     assert len(csv_lines) == 7
 
 
+def test_logger_donation_safe_survives_donated_steps(tmp_path):
+    """A step jitted with donate_argnums over the state carrying the
+    metrics invalidates the buffers a buffered record points at;
+    donation_safe=True snapshots each record so the amortized flush
+    still lands every row (the bench --monitor/--trace loops and the
+    DDP example donate exactly like this)."""
+    jsonl = tmp_path / "m.jsonl"
+
+    @jax.jit
+    def make(m):
+        return m
+
+    def step(m):
+        return m.count_step(jnp.bool_(True)).record_loss(1.0)
+
+    jstep = jax.jit(step, donate_argnums=(0,))
+    logger = monitor.MetricsLogger(
+        sinks=[monitor.JSONLSink(str(jsonl))], flush_every=10,
+        donation_safe=True)
+    m = make(metrics_init())
+    for _ in range(4):
+        m = jstep(m)
+        logger.record(m)            # donated away by the NEXT call
+    logger.close()
+    lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+    assert [r["step"] for r in lines] == [1, 2, 3, 4]
+
+    # without the flag, the robust flush salvages what survives (the
+    # last record) instead of raising and losing the whole window
+    jsonl2 = tmp_path / "m2.jsonl"
+    logger2 = monitor.MetricsLogger(
+        sinks=[monitor.JSONLSink(str(jsonl2))], flush_every=10)
+    m = make(metrics_init())
+    for _ in range(4):
+        m = jstep(m)
+        logger2.record(m)
+    logger2.close()
+    lines2 = [json.loads(l) for l in jsonl2.read_text().splitlines()]
+    assert len(lines2) >= 1
+    assert lines2[-1]["step"] == 4
+
+
+def test_metrics_snapshot_copies_buffers():
+    m = metrics_init().count_step(jnp.bool_(True))
+    snap = monitor.metrics_snapshot(m)
+    assert float(snap.step) == float(m.step)
+    for a, b in zip(jax.tree_util.tree_leaves(m),
+                    jax.tree_util.tree_leaves(snap)):
+        assert a.unsafe_buffer_pointer() != b.unsafe_buffer_pointer()
+
+
 def test_logger_nulls_nonfinite_gauges(tmp_path):
     jsonl = tmp_path / "m.jsonl"
     logger = monitor.MetricsLogger(sinks=[monitor.JSONLSink(str(jsonl))],
