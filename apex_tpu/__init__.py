@@ -25,6 +25,10 @@ A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
 - ``apex_tpu.lint``      — apexlint: jaxpr/HLO static-analysis passes that
                            catch precision leaks, donation misses, implicit
                            resharding and host syncs before they cost a run.
+- ``apex_tpu.ckpt``      — elastic checkpointing + fault escalation: async
+                           donation-safe sharded snapshots, crash-safe
+                           manifest-last commits, resume on a different
+                           mesh shape, silent-rank → checkpoint-and-exit.
 
 Unlike the reference (an interception-based library over an eager framework),
 apex_tpu expresses the same capabilities as *policies, functional transforms and
@@ -39,6 +43,7 @@ __version__ = "0.1.0"
 from apex_tpu import _compat  # noqa: F401  (installs jax API shims first)
 from apex_tpu import amp
 from apex_tpu import arena
+from apex_tpu import ckpt
 from apex_tpu import fp16_utils
 from apex_tpu import lint
 from apex_tpu import monitor
@@ -50,6 +55,6 @@ from apex_tpu import reparam
 from apex_tpu import trace
 from apex_tpu import utils
 
-__all__ = ["amp", "arena", "fp16_utils", "lint", "monitor", "ops",
-           "optim", "parallel", "prof", "reparam", "trace", "utils",
-           "__version__"]
+__all__ = ["amp", "arena", "ckpt", "fp16_utils", "lint", "monitor",
+           "ops", "optim", "parallel", "prof", "reparam", "trace",
+           "utils", "__version__"]

@@ -60,7 +60,13 @@ class AmpState(NamedTuple):
     this tuple round-trips everything the reference saves across
     ``amp.state_dict`` + optimizer/model state dicts — and because masters
     are fp32, checkpoints are fp32 exactly like the O2 state-dict hook
-    guarantees (`apex/amp/_initialize.py:133-142`).
+    guarantees (`apex/amp/_initialize.py:133-142`). Hand the whole tuple to
+    :class:`apex_tpu.ckpt.CheckpointManager` (``mgr.save(step, state,
+    params=params0)``): every field — including a ZeRO
+    ``ShardedOptState`` in ``opt_state`` — saves where it lives and
+    restores onto a *different* mesh shape (docs/checkpointing.md);
+    donation-safe, so a step jitted with ``donate_argnums`` over this
+    state needs no special handling.
 
     ``metrics`` is the opt-in telemetry pytree (``Amp(..., monitor=True)``,
     see apex_tpu.monitor): ``None`` — a leafless pytree node — when

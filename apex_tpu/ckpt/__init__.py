@@ -1,0 +1,50 @@
+"""apex_tpu.ckpt — elastic, donation-safe, async sharded checkpointing.
+
+The resilience layer (ROADMAP item 5a; see docs/checkpointing.md):
+training state survives crash, preemption, and silent-rank hangs, and
+resumes on a *different* mesh shape. Four pieces:
+
+- **snapshot** (:mod:`~apex_tpu.ckpt.snapshot`): donation-safe async
+  device→host capture of the full training tuple (params/masters, ZeRO
+  optimizer shards, AmpState scalers, Metrics, RNG keys) — fresh device
+  copies + background D2H, double-buffered, so the step path pays only
+  the copy dispatch;
+- **format** (:mod:`~apex_tpu.ckpt.format`): one ``npz`` per process +
+  a content-hashed manifest, every file temp-then-rename and the
+  manifest committed LAST — a crash at any instant of a save leaves the
+  previous checkpoint loadable;
+- **elastic** (:mod:`~apex_tpu.ckpt.elastic`): restore re-partitions
+  ZeRO slot buffers to the target mesh's ``zero_size``
+  (gather-by-manifest → truncate/re-pad → re-scatter), bitwise-equal to
+  an uninterrupted run on the new mesh;
+- **escalate** (:mod:`~apex_tpu.ckpt.escalate`): the
+  ``HangWatchdog``/``FlightRecorder`` policy that turns a silent rank
+  or a SIGTERM preemption into checkpoint-save → crash-dump → nonzero
+  exit, which :func:`apex_tpu.parallel.launch.elastic_run` answers with
+  restart-on-a-smaller-mesh.
+
+::
+
+    mgr = ckpt.CheckpointManager("ckpts", event_sink=logger.record_ckpt)
+    policy = ckpt.EscalationPolicy(mgr, recorder=recorder)
+    wd = trace.HangWatchdog(120, recorder=recorder, on_stall=policy)
+"""
+
+from apex_tpu.ckpt.elastic import repartition_flat, zero_layout
+from apex_tpu.ckpt.escalate import (ESCALATION_EXIT_CODE,
+                                    EscalationPolicy, PreemptionError)
+from apex_tpu.ckpt.format import (CheckpointError, committed_steps,
+                                  gc_checkpoints, latest_checkpoint,
+                                  read_manifest, step_dir)
+from apex_tpu.ckpt.manager import CheckpointManager
+from apex_tpu.ckpt.snapshot import (HostSnapshot, ShardChunks,
+                                    Snapshotter, device_snapshot)
+
+__all__ = [
+    "CheckpointManager", "Snapshotter", "HostSnapshot", "ShardChunks",
+    "device_snapshot",
+    "CheckpointError", "latest_checkpoint", "committed_steps",
+    "gc_checkpoints", "read_manifest", "step_dir",
+    "repartition_flat", "zero_layout",
+    "EscalationPolicy", "PreemptionError", "ESCALATION_EXIT_CODE",
+]

@@ -1,0 +1,388 @@
+"""Durable sharded checkpoint format: one file per process + a manifest.
+
+The commit protocol is the whole point (veScale-style save-where-it-
+lives, crash-safe like a WAL):
+
+1. every process writes ``proc{rank:05d}.npz`` to a ``*.tmp`` path,
+   fsyncs, then renames — a crash mid-write leaves only a ``.tmp``
+   orphan;
+2. each process then writes ``proc{rank:05d}.files.json`` (content
+   hash + per-array chunk metadata) the same way — the data file is
+   now durable and described;
+3. rank 0 waits for every rank's files.json (shared-filesystem
+   barrier), then writes ``manifest.json`` **last** — again
+   temp-then-rename.
+
+``manifest.json`` IS the commit record: a checkpoint directory without
+one does not exist as far as :func:`latest_checkpoint` is concerned, so
+a crash at ANY point of a save leaves the previous committed checkpoint
+untouched and loadable (the mid-save-kill test in tests/test_ckpt.py
+proves it at every crash point).
+
+Arrays are addressed by pytree path string (``jax.tree_util.keystr``)
+and stored as **chunks**: a fully-addressable array is one whole-array
+chunk; a multi-process sharded array contributes each of this process's
+distinct addressable shards with its global index slices. Restore
+gathers chunks by manifest (any file layout → the full logical array)
+and re-scatters to the *target* sharding — which is how a checkpoint
+saved on one mesh loads onto another (see :mod:`apex_tpu.ckpt.elastic`
+for the ZeRO re-partitioning).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import signal
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["write_process_file", "commit_manifest", "read_manifest",
+           "assemble_arrays", "latest_checkpoint", "committed_steps",
+           "gc_checkpoints", "step_dir", "MANIFEST", "CheckpointError"]
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+
+#: test hook: crash the process (SIGKILL — no handlers, no atexit) at a
+#: named point of the save. Points: "before_data_rename" (data tmp
+#: written, not committed), "before_manifest" (data committed, manifest
+#: not). Used by the crash-consistency tests to prove every crash point
+#: leaves the previous checkpoint loadable.
+_CRASH_ENV = "APEX_TPU_CKPT_TEST_CRASH"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint could not be written or read consistently."""
+
+
+def _test_crash(point: str) -> None:
+    if os.environ.get(_CRASH_ENV) == point:
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"step_{int(step):08d}")
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass                     # not all filesystems allow dir fsync
+
+
+def _write_atomic(path: str, data: bytes, crash_point: str = "") -> None:
+    """temp → fsync → rename; durable against crash at any instant."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    if crash_point:
+        _test_crash(crash_point)
+    os.replace(tmp, path)
+    _fsync_dir(os.path.dirname(path))
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# --- chunk extraction ---------------------------------------------------------
+
+def _chunks_of(leaf, rank: int) -> Optional[List[Tuple[Optional[List],
+                                                       np.ndarray]]]:
+    """This process's chunks of one leaf: ``[(index, array), ...]``.
+
+    ``index`` is ``None`` for a whole-array chunk, else
+    ``[[start, stop], ...]`` per dim. Whole-array chunks are written by
+    rank 0 only (replicated leaves exist everywhere; N identical copies
+    on disk would be waste, and restore dedupes by index anyway).
+    Returns None when this rank has nothing to write for the leaf.
+    """
+    from apex_tpu.ckpt.snapshot import ShardChunks  # circular-free: late
+
+    if isinstance(leaf, ShardChunks):
+        out = []
+        for idx, arr in leaf.chunks:
+            whole = all(a == 0 and b == d
+                        for (a, b), d in zip(idx, leaf.shape))
+            if whole:
+                if rank == 0:
+                    out.append((None, arr))
+            else:
+                out.append(([list(p) for p in idx], arr))
+        return out or None
+    if rank != 0:
+        return None              # plain host array == replicated
+    arr = np.asarray(leaf)
+    return [(None, arr)]
+
+
+def write_process_file(ckpt_dir: str, rank: int,
+                       leaves: Sequence[Tuple[str, Any]]) -> Dict:
+    """Write this process's data file + its files.json piece.
+
+    ``leaves`` is ``[(path_str, leaf)]`` where a leaf is a numpy array,
+    a scalar, or a :class:`~apex_tpu.ckpt.snapshot.ShardChunks`. Returns
+    the files.json record (also written to disk, atomically, after the
+    data file commits).
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    fname = f"proc{rank:05d}.npz"
+    arrays: List[Dict] = []
+    payload: Dict[str, np.ndarray] = {}
+    key_i = 0
+    for path, leaf in leaves:
+        chunks = _chunks_of(leaf, rank)
+        if not chunks:
+            continue
+        for idx, arr in chunks:
+            arr = np.asarray(arr)
+            key = f"a{key_i:05d}"
+            key_i += 1
+            payload[key] = arr
+            # global shape: the chunk's own shape for whole-array
+            # chunks; recorded so assembly can allocate without a like
+            gshape = (list(arr.shape) if idx is None
+                      else [d for d in _global_shape_of(leaf)])
+            arrays.append({
+                "path": path, "key": key, "index": idx,
+                "shape": list(arr.shape), "dtype": str(arr.dtype),
+                "global_shape": gshape,
+            })
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    data = buf.getvalue()
+    _write_atomic(os.path.join(ckpt_dir, fname), data,
+                  crash_point="before_data_rename")
+    record = {"rank": rank, "file": fname, "sha256": _sha256(data),
+              "bytes": len(data), "arrays": arrays}
+    _write_atomic(os.path.join(ckpt_dir, f"proc{rank:05d}.files.json"),
+                  json.dumps(record).encode())
+    return record
+
+
+def _global_shape_of(leaf):
+    from apex_tpu.ckpt.snapshot import ShardChunks
+    if isinstance(leaf, ShardChunks):
+        return leaf.shape
+    return np.asarray(leaf).shape
+
+
+# --- commit -------------------------------------------------------------------
+
+def commit_manifest(ckpt_dir: str, *, step: int, process_count: int,
+                    meta: Optional[Dict] = None,
+                    zero: Optional[Dict[str, int]] = None,
+                    extra: Optional[Dict] = None,
+                    prng_impls: Optional[Dict[str, str]] = None,
+                    wait_for_ranks: bool = True,
+                    barrier_timeout_s: float = 120.0) -> str:
+    """Rank 0's commit: gather every rank's files.json, write the
+    manifest LAST. ``wait_for_ranks=False`` (the escalation path — dead
+    peers will never write theirs) commits with whatever files exist;
+    restore's coverage check decides whether the result is usable.
+    """
+    deadline = time.monotonic() + barrier_timeout_s
+    files: List[Dict] = []
+    while True:
+        files = []
+        missing = []
+        for r in range(process_count):
+            p = os.path.join(ckpt_dir, f"proc{r:05d}.files.json")
+            if os.path.exists(p):
+                with open(p) as f:
+                    files.append(json.load(f))
+            else:
+                missing.append(r)
+        if not missing or not wait_for_ranks:
+            break
+        if time.monotonic() > deadline:
+            raise CheckpointError(
+                f"checkpoint barrier timed out after {barrier_timeout_s}s"
+                f" waiting for ranks {missing} under {ckpt_dir} — NOT "
+                f"committing (the previous checkpoint stays the latest)")
+        time.sleep(0.05)
+    manifest = {
+        "format": FORMAT_VERSION, "step": int(step),
+        "wall_time": time.time(), "process_count": int(process_count),
+        "n_files": len(files),
+        "complete_barrier": len(files) == process_count,
+        "meta": dict(meta or {}),
+        "zero": dict(zero or {}),
+        "extra": dict(extra or {}),
+        "prng_impls": dict(prng_impls or {}),
+        "files": files,
+    }
+    path = os.path.join(ckpt_dir, MANIFEST)
+    _write_atomic(path, json.dumps(manifest, indent=1).encode(),
+                  crash_point="before_manifest")
+    return path
+
+
+# --- read side ----------------------------------------------------------------
+
+def read_manifest(ckpt_dir: str) -> Dict:
+    path = os.path.join(ckpt_dir, MANIFEST)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"no committed checkpoint at {ckpt_dir}: "
+                              f"{e}") from e
+
+
+def assemble_arrays(ckpt_dir: str, manifest: Dict, *,
+                    paths: Optional[Sequence[str]] = None,
+                    verify: bool = True) -> Dict[str, np.ndarray]:
+    """Gather-by-manifest: read every referenced data file and assemble
+    each leaf's full logical array from its chunks.
+
+    ``paths`` restricts assembly (restore only pulls what the like-tree
+    needs); ``verify`` checks each data file's sha256 against the
+    manifest before trusting it. Raises :class:`CheckpointError` on a
+    hash mismatch or a leaf whose chunks do not cover the full array
+    (e.g. a lone-rank escalation save of ZeRO-sharded state — the
+    actionable message names the uncovered leaf).
+    """
+    want = set(paths) if paths is not None else None
+    loaded: Dict[str, Any] = {}
+    per_path: Dict[str, List[Tuple[Optional[Tuple], np.ndarray,
+                                   List[int], str]]] = {}
+    for frec in manifest.get("files", []):
+        if want is not None and not any(a["path"] in want
+                                        for a in frec["arrays"]):
+            continue
+        fpath = os.path.join(ckpt_dir, frec["file"])
+        try:
+            with open(fpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise CheckpointError(
+                f"checkpoint data file missing: {fpath} ({e})") from e
+        if verify and _sha256(data) != frec["sha256"]:
+            raise CheckpointError(
+                f"content hash mismatch for {fpath} — the file does not "
+                f"match the committed manifest (corruption or a mixed-up "
+                f"directory); refusing to load")
+        npz = np.load(io.BytesIO(data))
+        for arec in frec["arrays"]:
+            p = arec["path"]
+            if want is not None and p not in want:
+                continue
+            idx = (None if arec["index"] is None else
+                   tuple(tuple(pair) for pair in arec["index"]))
+            arr = npz[arec["key"]]
+            want_dt = np.dtype(arec["dtype"])
+            if arr.dtype != want_dt:
+                # npz round-trips extension dtypes (bfloat16, fp8) as
+                # raw void records; the manifest's dtype restores them
+                if arr.dtype.itemsize != want_dt.itemsize:
+                    raise CheckpointError(
+                        f"stored dtype {arr.dtype} for {p!r} cannot "
+                        f"reinterpret as recorded {want_dt}")
+                arr = arr.view(want_dt)
+            per_path.setdefault(p, []).append(
+                (idx, arr, arec["global_shape"], str(want_dt)))
+    for p, chunks in per_path.items():
+        # dedupe identical indices (replicated shards saved by several
+        # ranks); distinct addressable shards of one array never overlap
+        seen = {}
+        for idx, arr, gshape, dt in chunks:
+            seen.setdefault(idx, (arr, gshape, dt))
+        whole = seen.pop(None, None)
+        if whole is not None:
+            loaded[p] = whole[0]          # whole copy wins; parts agree
+            continue
+        gshape = tuple(next(iter(seen.values()))[1])
+        dt = next(iter(seen.values()))[2]
+        out = np.zeros(gshape, dtype=dt)
+        covered = 0
+        for idx, (arr, _, _) in seen.items():
+            sl = tuple(slice(a, b) for a, b in idx)
+            out[sl] = arr
+            covered += int(np.prod([b - a for a, b in idx]))
+        total = int(np.prod(gshape)) if gshape else 1
+        if covered < total:
+            raise CheckpointError(
+                f"leaf {p!r} is only partially covered by the saved "
+                f"chunks ({covered}/{total} elements) — this manifest "
+                f"was committed without all ranks (a lone-rank "
+                f"escalation save of sharded state); restore from the "
+                f"previous fully-committed checkpoint instead")
+        loaded[p] = out
+    if want is not None:
+        missing = want - set(loaded)
+        if missing:
+            raise CheckpointError(
+                "checkpoint is missing required leaves: "
+                + ", ".join(sorted(missing)[:8])
+                + (" …" if len(missing) > 8 else "")
+                + " — was it saved from a state with a different "
+                  "structure?")
+    return loaded
+
+
+# --- discovery / retention ----------------------------------------------------
+
+def committed_steps(root: str) -> List[int]:
+    """Steps with a committed (manifest-bearing) checkpoint, ascending."""
+    out = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return out
+    for name in names:
+        if not name.startswith("step_"):
+            continue
+        if os.path.exists(os.path.join(root, name, MANIFEST)):
+            try:
+                out.append(int(name[len("step_"):]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Newest committed checkpoint directory under ``root`` (None when
+    nothing has ever committed). Partial directories — a crash mid-save
+    — have no manifest and are invisible here by construction."""
+    steps = committed_steps(root)
+    return step_dir(root, steps[-1]) if steps else None
+
+
+def gc_checkpoints(root: str, keep: int) -> List[str]:
+    """Delete committed checkpoints beyond the newest ``keep`` (and any
+    uncommitted partial dirs older than the newest committed one).
+    Returns the removed directory paths."""
+    import shutil
+    steps = committed_steps(root)
+    removed = []
+    for s in steps[:-keep] if keep > 0 else []:
+        d = step_dir(root, s)
+        shutil.rmtree(d, ignore_errors=True)
+        removed.append(d)
+    if steps:
+        newest = step_dir(root, steps[-1])
+        try:
+            names = os.listdir(root)
+        except OSError:
+            names = []
+        for name in names:
+            d = os.path.join(root, name)
+            if (name.startswith("step_") and d != newest
+                    and not os.path.exists(os.path.join(d, MANIFEST))
+                    and d < newest):
+                shutil.rmtree(d, ignore_errors=True)
+                removed.append(d)
+    return removed

@@ -1,0 +1,354 @@
+"""CheckpointManager: the save/restore orchestrator.
+
+Glues the three layers together — donation-safe async capture
+(:mod:`.snapshot`), the crash-safe one-file-per-process format
+(:mod:`.format`), and elastic ZeRO re-partitioning (:mod:`.elastic`) —
+and emits the ``ckpt`` JSONL event channel
+(``check_metrics_schema.py --kind ckpt``).
+
+::
+
+    mgr = ckpt.CheckpointManager("ckpts", keep=2,
+                                 event_sink=logger.record_ckpt)
+    for i, batch in enumerate(source.batches(...)):
+        state = train_step(state, batch)            # donated
+        if i % save_every == 0:
+            mgr.save(i, state, params=params0,
+                     extra={"cursor": source.state()})
+    mgr.wait()
+
+    # elastic resume — on any mesh shape:
+    like = build_state_on_new_mesh()
+    state, manifest = mgr.restore(like)
+    cursor = manifest["extra"]["cursor"]
+
+``save`` costs the step path only the device-copy dispatch (the
+``ckpt_save_stall_ms`` bench column); the host fetch, serialization,
+hashing and the temp-then-rename commit all happen on the snapshot
+worker thread. ``save_last_snapshot`` is the escalation entry point: it
+durably writes the newest already-fetched host snapshot without ever
+touching the (possibly wedged) device — see
+:class:`apex_tpu.ckpt.EscalationPolicy`.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.ckpt import elastic as _elastic
+from apex_tpu.ckpt import format as _format
+from apex_tpu.ckpt.format import CheckpointError
+from apex_tpu.ckpt.snapshot import (HostSnapshot, Snapshotter,
+                                    device_snapshot, is_prng_key,
+                                    tree_paths)
+
+__all__ = ["CheckpointManager"]
+
+
+def _rank() -> int:
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("RANK", "0"))
+
+
+def _process_count() -> int:
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("WORLD_SIZE", "1"))
+
+
+class CheckpointManager:
+    """See the module docstring.
+
+    ``event_sink`` is any callable taking one JSON-able dict (wire
+    ``MetricsLogger(ckpt_sink=...)`` via ``logger.record_ckpt``);
+    ``keep`` bounds retention (rank 0 deletes older committed
+    checkpoints after each commit); ``meta`` statics land in every
+    manifest (mesh shape, run tags, ...).
+    """
+
+    def __init__(self, root: str, *, keep: int = 2,
+                 event_sink: Optional[Callable[[Dict], None]] = None,
+                 meta: Optional[Dict] = None,
+                 barrier_timeout_s: float = 120.0):
+        self.root = root
+        self.keep = int(keep)
+        self.event_sink = event_sink
+        self.meta = dict(meta or {})
+        self.barrier_timeout_s = float(barrier_timeout_s)
+        self.rank = _rank()
+        self.process_count = _process_count()
+        self._snap = Snapshotter(on_ready=self._write_snapshot)
+        self._pending_zero: Dict[str, int] = {}
+        self._last_committed: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        # serializes _write between the snapshot worker and an
+        # escalation thread — two writers racing on the same step dir
+        # would interleave bytes under one manifest hash
+        self._write_lock = threading.Lock()
+        # zero_layout is static for a fixed (state structure, params):
+        # cache it so the per-step snapshot() cadence never re-walks
+        # the tree or re-plans the arena on the hot path
+        self._zero_cache: Optional[Tuple[Any, Any, Dict[str, int]]] = None
+
+    # -- events ----------------------------------------------------------------
+
+    def _emit(self, event: Dict) -> None:
+        if self.event_sink is None:
+            return
+        try:
+            self.event_sink(dict(event, rank=self.rank,
+                                 wall_time=time.time()))
+        except Exception:
+            pass                  # telemetry must never break a save
+
+    # -- save ------------------------------------------------------------------
+
+    def save(self, step: int, tree, *, params: Any = None,
+             zero: Optional[Dict[str, int]] = None,
+             extra: Optional[Dict] = None,
+             block: bool = False) -> float:
+        """Snapshot + asynchronously persist the training tuple.
+
+        ``params`` (the tree the sharded optimizer was initialized
+        from) lets the manager record each ZeRO slot buffer's logical
+        length for elastic restore; pass ``zero=`` directly to override.
+        Returns the step-path stall in ms (full duration when
+        ``block=True``). Raises any error a previous async write hit.
+        """
+        self.raise_pending()
+        self._pending_zero = self._layout_for(tree, params, zero)
+        return self._snap.capture(step, tree, extra=extra, block=block)
+
+    def snapshot(self, step: int, tree, *, params: Any = None,
+                 zero: Optional[Dict[str, int]] = None,
+                 extra: Optional[Dict] = None) -> float:
+        """Capture WITHOUT committing: refresh the host-side snapshot
+        (what an escalation persists) at step cadence while actual disk
+        commits run at a coarser ``save`` cadence — the cheap half of
+        the snapshot-every-step / commit-every-N pattern
+        (docs/checkpointing.md §escalation). Returns the stall in ms.
+        """
+        self.raise_pending()
+        self._pending_zero = self._layout_for(tree, params, zero)
+        return self._snap.capture(step, tree, extra=extra,
+                                  persist=False)
+
+    def _layout_for(self, tree, params, zero) -> Dict[str, int]:
+        """The manifest's ZeRO layout map, cached per (state structure,
+        params object) — static across steps, so the per-step
+        ``snapshot`` cadence never re-walks the tree or re-plans the
+        arena on the step path."""
+        import jax
+        if zero is not None:
+            return dict(zero)
+        td = jax.tree_util.tree_structure(tree)
+        if (self._zero_cache is not None
+                and self._zero_cache[0] == td
+                and self._zero_cache[1] is params):
+            return self._zero_cache[2]
+        layout = _elastic.zero_layout(tree, params=params)
+        self._zero_cache = (td, params, layout)
+        return layout
+
+    def _write_snapshot(self, snap: HostSnapshot) -> None:
+        if not snap.persist:
+            return                 # capture-only (snapshot() cadence)
+        try:
+            self._write(snap, wait_for_ranks=True)
+        except BaseException as e:     # surfaced on the next save/wait
+            self.error = e
+
+    def _write(self, snap: HostSnapshot, *, wait_for_ranks: bool,
+               reason: str = "periodic",
+               lock_timeout_s: Optional[float] = None) -> Optional[str]:
+        t0 = time.perf_counter()
+        # serialize writers: the snapshot worker and an escalation
+        # thread persisting the SAME HostSnapshot would otherwise race
+        # on one step dir (interleaved tmp bytes under one manifest
+        # hash). The escalation path bounds its wait — if the worker is
+        # wedged on the multi-rank commit barrier (dead peers), waiting
+        # longer is futile and the previous cooperative checkpoint is
+        # the restore point.
+        acquired = self._write_lock.acquire(
+            timeout=lock_timeout_s if lock_timeout_s is not None
+            else -1)
+        if not acquired:
+            return None
+        try:
+            d = _format.step_dir(self.root, snap.step)
+            if os.path.exists(os.path.join(d, _format.MANIFEST)):
+                return d           # this step already committed
+            leaves = tree_paths(snap.tree)
+            rec = _format.write_process_file(d, self.rank, leaves)
+            if self.rank == 0:
+                _format.commit_manifest(
+                    d, step=snap.step,
+                    process_count=self.process_count,
+                    meta=dict(self.meta, reason=reason),
+                    zero=self._pending_zero, extra=snap.extra,
+                    prng_impls=snap.prng_impls,
+                    wait_for_ranks=wait_for_ranks,
+                    barrier_timeout_s=self.barrier_timeout_s)
+                self._last_committed = d
+                # retention runs only after COOPERATIVE commits: a
+                # lone-rank escalation manifest may cover only this
+                # rank's leaves, and letting it gc the last
+                # fully-committed checkpoint would destroy the very
+                # fallback its own restore error points at
+                if self.keep > 0 and wait_for_ranks:
+                    _format.gc_checkpoints(self.root, self.keep)
+        finally:
+            self._write_lock.release()
+        self._emit({
+            "kind": "ckpt_save", "step": snap.step, "path": d,
+            "reason": reason, "bytes": int(rec.get("bytes", 0)),
+            "n_arrays": len(rec.get("arrays", [])),
+            "stall_ms": round(snap.stall_ms, 3),
+            "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+        if (self.rank != 0 and not wait_for_ranks
+                and not os.path.exists(os.path.join(d,
+                                                    _format.MANIFEST))):
+            # a lone-rank escalation on a non-zero rank wrote its data
+            # file but nothing will ever commit the manifest (rank 0 is
+            # the dead/preempted one) — don't report a checkpoint path
+            # that latest_checkpoint()/restore() can never find
+            return None
+        return d
+
+    def save_last_snapshot(self, reason: str = "escalation"
+                           ) -> Optional[str]:
+        """Durably persist the newest fetched host snapshot — the
+        escalation path. Never touches the device (a wedged runtime
+        cannot block it) and never waits for peer ranks (they may be
+        dead); the manifest commits with whatever files exist, and
+        restore's coverage check decides usability. Returns the
+        checkpoint dir, or None when no snapshot ever finished."""
+        snap = self._snap.last
+        if snap is None:
+            return None
+        try:
+            return self._write(snap, wait_for_ranks=False,
+                               reason=reason, lock_timeout_s=15.0)
+        except BaseException:
+            return None
+
+    def wait(self) -> None:
+        """Drain the in-flight snapshot + write; raise its error."""
+        self._snap.wait()
+        self.raise_pending()
+
+    def raise_pending(self) -> None:
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+
+    @property
+    def last_host_snapshot(self) -> Optional[HostSnapshot]:
+        return self._snap.last
+
+    # -- discovery -------------------------------------------------------------
+
+    def latest(self) -> Optional[str]:
+        return _format.latest_checkpoint(self.root)
+
+    def all_steps(self):
+        return _format.committed_steps(self.root)
+
+    # -- restore ---------------------------------------------------------------
+
+    def restore(self, like, *, ckpt_dir: Optional[str] = None,
+                verify: bool = True) -> Tuple[Any, Dict]:
+        """Load the newest committed checkpoint into the structure (and
+        onto the mesh) of ``like``.
+
+        ``like`` is a freshly-initialized state tree on the TARGET mesh
+        — its shapes and shardings define where every leaf lands:
+        replicated leaves must match shape exactly; ZeRO slot buffers
+        (named in the manifest's ``zero`` map) are gathered, truncated
+        to their logical length, re-padded to the like leaf's length and
+        re-scattered with its sharding — the elastic 8→4 (or 4→8) path.
+        Returns ``(tree, manifest)``; the data-pipeline cursor and any
+        other save-time ``extra`` ride in ``manifest["extra"]``.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        d = ckpt_dir or self.latest()
+        if d is None:
+            raise CheckpointError(
+                f"no committed checkpoint under {self.root!r} — nothing "
+                f"to restore (a crash before the first commit leaves "
+                f"only partial step_* dirs, which are not checkpoints)")
+        manifest = _format.read_manifest(d)
+        flat = jax.tree_util.tree_flatten_with_path(like)
+        want = [jax.tree_util.keystr(p) for p, _ in flat[0]]
+        loaded = _format.assemble_arrays(d, manifest, paths=want,
+                                         verify=verify)
+        zero = manifest.get("zero", {})
+        impls = manifest.get("prng_impls", {})
+        resharded = 0
+        out_leaves = []
+        for (path, leaf), pstr in zip(flat[0], want):
+            val = loaded[pstr]
+            if is_prng_key(leaf):
+                # compare/restore via the raw key_data view — the typed
+                # key's own shape hides the trailing impl lanes
+                kd_shape = tuple(jax.random.key_data(leaf).shape)
+                if tuple(val.shape) != kd_shape:
+                    raise CheckpointError(
+                        f"PRNG key data shape mismatch for {pstr}: "
+                        f"checkpoint has {tuple(val.shape)}, target key "
+                        f"expects {kd_shape}")
+                val = jax.random.wrap_key_data(
+                    jnp.asarray(val),
+                    impl=impls.get(pstr) or "threefry2x32")
+                if hasattr(leaf, "sharding"):
+                    val = jax.device_put(val, leaf.sharding)
+                out_leaves.append(val)
+                continue
+            if pstr in zero:
+                tgt_len = (int(np.prod(np.shape(leaf)))
+                           if np.ndim(leaf) == 1 else -1)
+                if np.ndim(leaf) != 1:
+                    raise CheckpointError(
+                        f"{pstr} is recorded as a ZeRO slot buffer but "
+                        f"the like leaf is not 1-D ({np.shape(leaf)})")
+                if tuple(val.shape) != (tgt_len,):
+                    resharded += 1
+                val = _elastic.repartition_flat(val, int(zero[pstr]),
+                                                tgt_len)
+            elif tuple(val.shape) != tuple(np.shape(leaf)):
+                raise CheckpointError(
+                    f"shape mismatch for {pstr}: checkpoint has "
+                    f"{tuple(val.shape)}, target expects "
+                    f"{tuple(np.shape(leaf))} — only ZeRO slot buffers "
+                    f"reshape across meshes; did the model change?")
+            if isinstance(leaf, jax.Array):
+                want_dt = np.dtype(leaf.dtype)
+                if np.dtype(val.dtype) != want_dt:
+                    raise CheckpointError(
+                        f"dtype mismatch for {pstr}: checkpoint "
+                        f"{val.dtype}, target {want_dt}")
+                val = jax.device_put(val, leaf.sharding)
+            out_leaves.append(val)
+        tree = jax.tree_util.tree_unflatten(flat[1], out_leaves)
+        self._emit({
+            "kind": "ckpt_restore", "step": int(manifest["step"]),
+            "path": d, "n_arrays": len(out_leaves),
+            "resharded": resharded,
+            "from_processes": int(manifest.get("process_count", 1)),
+            "dur_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        })
+        return tree, manifest

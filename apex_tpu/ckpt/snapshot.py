@@ -1,0 +1,260 @@
+"""Donation-safe async device→host snapshots — the step-path half.
+
+A checkpoint of a *donated* train state cannot simply hold references:
+the next dispatch invalidates the input buffers ("Array has been
+deleted" — the failure mode :func:`apex_tpu.monitor.metrics_snapshot`
+exists for). And a checkpoint that ``device_get``s synchronously stalls
+the step loop for the full HBM→host transfer. This module does neither:
+
+1. **Capture** (:func:`device_snapshot`): every leaf is copied into a
+   fresh device buffer (``a.copy()`` — async dispatch, exactly the
+   PR-5 donation-safety machinery generalized from Metrics scalars to
+   the whole training tuple), then ``copy_to_host_async`` starts the
+   D2H transfer in the background. The step path pays only the copy
+   dispatch — the bounded stall the bench row measures.
+2. **Materialize** (worker thread inside :class:`Snapshotter`): the
+   host numpy arrays land off the step path; the finished
+   :class:`HostSnapshot` becomes :attr:`Snapshotter.last` — the state
+   an escalation policy can persist *without touching the (possibly
+   wedged) device*.
+
+Double-buffered: at most one capture is in flight. Starting a new one
+while the previous is still materializing waits for it first, so HBM
+holds at most one extra copy of the snapshot tree and the stall stays
+bounded instead of queueing unboundedly behind a slow disk.
+
+Typed PRNG keys (``jax.random.key``) are snapshotted as their raw
+``key_data`` plus the impl name, and re-wrapped on restore — numpy
+cannot hold an opaque key dtype.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["HostSnapshot", "ShardChunks", "Snapshotter",
+           "device_snapshot", "tree_paths", "is_prng_key"]
+
+
+class ShardChunks:
+    """This process's pieces of a multi-process sharded array.
+
+    ``chunks`` is ``[(index, np.ndarray)]`` where ``index`` is a tuple
+    of ``(start, stop)`` pairs per dim into the global ``shape``. A
+    fully-addressable array never becomes one of these (it materializes
+    as a plain numpy array); the format layer writes each chunk with its
+    global index so restore can gather by manifest.
+    """
+
+    __slots__ = ("shape", "dtype", "chunks")
+
+    def __init__(self, shape, dtype, chunks):
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        self.chunks = list(chunks)
+
+
+def is_prng_key(x) -> bool:
+    """True for typed PRNG key arrays (opaque dtype, needs unwrapping)."""
+    try:
+        return isinstance(x, jax.Array) and jax.dtypes.issubdtype(
+            x.dtype, jax.dtypes.prng_key)
+    except Exception:
+        return False
+
+
+def tree_paths(tree) -> list:
+    """``(path_str, leaf)`` pairs in flatten order — the stable leaf
+    addressing shared by capture (here) and the on-disk format
+    (:mod:`apex_tpu.ckpt.format`). Path strings come from
+    ``jax.tree_util.keystr``, so two trees with the same structure
+    always produce the same names."""
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+@jax.jit
+def _copy_leaves(leaves):
+    """One program copying every leaf: N fresh buffers for ONE dispatch
+    (per-leaf ``.copy()`` costs a dispatch each — measured 100s of ms
+    of step stall on wide trees; this is the bounded-stall half of the
+    <5%-of-step claim). Without donation XLA cannot alias inputs to
+    outputs, so the results are genuinely fresh, donation-safe buffers.
+    """
+    return [jax.numpy.asarray(l).copy() for l in leaves]
+
+
+def device_snapshot(tree):
+    """Donation-safe device copy of a pytree + async D2H start.
+
+    Returns ``(copies, keys)``: ``copies`` mirrors ``tree`` with every
+    device leaf replaced by a fresh buffer (typed PRNG keys by their
+    uint32 ``key_data``); ``keys`` maps path→impl-name for the key
+    leaves so a restore can re-wrap them. Host leaves (ints, numpy)
+    pass through untouched.
+    """
+    keys: Dict[str, str] = {}
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    out_leaves = []
+    to_copy = []                   # (position, leaf) device leaves
+    for path, leaf in flat[0]:
+        if is_prng_key(leaf):
+            keys[jax.tree_util.keystr(path)] = str(
+                jax.random.key_impl(leaf))
+            leaf = jax.random.key_data(leaf)
+        if isinstance(leaf, jax.Array):
+            to_copy.append((len(out_leaves), leaf))
+        out_leaves.append(leaf)
+    if to_copy:
+        copied = _copy_leaves([l for _, l in to_copy])
+        for (pos, _), c in zip(to_copy, copied):
+            try:
+                c.copy_to_host_async()
+            except Exception:
+                pass          # backends without async D2H still work
+            out_leaves[pos] = c
+    copies = jax.tree_util.tree_unflatten(flat[1], out_leaves)
+    return copies, keys
+
+
+class HostSnapshot:
+    """One fully-materialized host-side snapshot of the training tuple.
+
+    ``tree`` holds numpy leaves (typed keys already unwrapped —
+    ``prng_impls`` carries their impl names); ``extra`` is the host-side
+    side-channel (data-pipeline cursor, user tags) captured atomically
+    with the device state.
+    """
+
+    __slots__ = ("step", "tree", "prng_impls", "extra", "wall_time",
+                 "stall_ms", "persist")
+
+    def __init__(self, step: int, tree, prng_impls: Dict[str, str],
+                 extra: Optional[Dict[str, Any]], stall_ms: float,
+                 persist: bool = True):
+        self.step = int(step)
+        self.tree = tree
+        self.prng_impls = dict(prng_impls)
+        self.extra = dict(extra) if extra else {}
+        self.wall_time = time.time()
+        self.stall_ms = float(stall_ms)
+        #: False = capture-only (kept as ``Snapshotter.last`` for an
+        #: escalation to persist on demand; nothing written eagerly)
+        self.persist = persist
+
+
+def _materialize_leaf(a):
+    if not isinstance(a, jax.Array):
+        return a
+    if a.is_fully_addressable:
+        return np.asarray(a)
+    # multi-process: this host only sees its shards — keep each distinct
+    # addressable shard with its global index (gather happens at restore,
+    # by manifest)
+    seen, chunks = set(), []
+    for sh in a.addressable_shards:
+        idx = tuple(
+            (0 if s.start is None else int(s.start),
+             int(d) if s.stop is None else int(s.stop))
+            for s, d in zip(sh.index, a.shape))
+        if not a.shape:
+            idx = ()
+        if idx in seen:
+            continue
+        seen.add(idx)
+        chunks.append((idx, np.asarray(sh.data)))
+    return ShardChunks(a.shape, a.dtype, chunks)
+
+
+def _materialize(copies):
+    """Device copies → numpy leaves (the worker-thread fetch)."""
+    return jax.tree_util.tree_map(_materialize_leaf, copies)
+
+
+class Snapshotter:
+    """Double-buffered async snapshot pipeline.
+
+    ::
+
+        snap = ckpt.Snapshotter()
+        for i, batch in enumerate(data):
+            state = train_step(state, batch)       # donated
+            if i % 100 == 0:
+                snap.capture(i, state, extra={"cursor": src.state()})
+        snap.wait()
+        snap.last                                  # newest HostSnapshot
+
+    ``capture`` is the only call on the step path; its wall time is the
+    snapshot's step stall (recorded as ``HostSnapshot.stall_ms``).
+    ``on_ready`` fires on the worker thread with each finished snapshot
+    — the CheckpointManager's async-write hook.
+    """
+
+    def __init__(self, on_ready: Optional[Callable[[HostSnapshot],
+                                                   None]] = None):
+        self.on_ready = on_ready
+        self.last: Optional[HostSnapshot] = None
+        #: first error the worker thread hit (materialization OR
+        #: on_ready); re-raised by the next capture()/wait() so a dead
+        #: snapshot pipeline can never silently stop checkpointing
+        self.error: Optional[BaseException] = None
+        self._pending: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def capture(self, step: int, tree, *,
+                extra: Optional[Dict[str, Any]] = None,
+                block: bool = False, persist: bool = True) -> float:
+        """Snapshot ``tree`` (donation-safe). Returns the step-path
+        stall in milliseconds. ``block=True`` waits for the host copy
+        and any ``on_ready`` work before returning (the sync-save
+        comparison mode in the bench). ``persist=False`` marks the
+        snapshot capture-only — ``on_ready`` consumers that write to
+        disk honor the flag (``CheckpointManager.snapshot``)."""
+        t0 = time.perf_counter()
+        self.wait()                      # double-buffer: one in flight
+        copies, keys = device_snapshot(tree)
+        stall_ms = (time.perf_counter() - t0) * 1e3
+
+        def work():
+            try:
+                host = _materialize(copies)
+                snap = HostSnapshot(step, host, keys, extra, stall_ms,
+                                    persist=persist)
+                with self._lock:
+                    self.last = snap
+                if self.on_ready is not None:
+                    self.on_ready(snap)
+            except BaseException as e:   # surfaced on next capture/wait
+                with self._lock:
+                    if self.error is None:
+                        self.error = e
+
+        t = threading.Thread(target=work, daemon=True,
+                             name="apex_tpu.ckpt.snapshot")
+        self._pending = t
+        t.start()
+        if block:
+            self.wait()
+            stall_ms = (time.perf_counter() - t0) * 1e3
+        return stall_ms
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Drain the in-flight materialization (no-op when idle);
+        re-raises any error the worker hit."""
+        t = self._pending
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        if t is not None and not t.is_alive():
+            self._pending = None
+        self.raise_pending()
+
+    def raise_pending(self) -> None:
+        with self._lock:
+            err, self.error = self.error, None
+        if err is not None:
+            raise err

@@ -78,6 +78,23 @@ def _random_resized_crop(img, size: int, rng: np.random.RandomState,
     return img.resize((size, size), Image.BILINEAR, box=box)
 
 
+def _stable_seed(seed: int, epoch: int, pos: int) -> int:
+    """Per-image augmentation seed as a pure function of
+    ``(source seed, epoch, position in the epoch's order)`` — a
+    splitmix-style avalanche instead of a sequentially-consumed
+    RandomState, so resuming an epoch at batch k reproduces the exact
+    augmentation stream without replaying the first k batches (the
+    checkpoint cursor contract, docs/checkpointing.md)."""
+    x = (seed * 0x9E3779B9 + epoch * 0x85EBCA6B + pos * 0xC2B2AE35
+         + 0x27D4EB2F) & 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * 0x7FEB352D) & 0xFFFFFFFF
+    x ^= x >> 15
+    x = (x * 0x846CA68B) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x & 0x7FFFFFFF
+
+
 def _decode_one(path: str, size: int, seed: int, train: bool):
     from PIL import Image
 
@@ -105,13 +122,67 @@ class ImageFolderSource:
     drops the GIL in libjpeg); batches come out as one contiguous NHWC
     array scaled to [0, 1) in ``dtype``. Iteration order reshuffles per
     epoch like the reference's ``shuffle=True`` loader.
+
+    **Multi-host**: pass ``process_index``/``process_count`` (defaults:
+    the JAX process topology) and each rank reads a *disjoint* strided
+    slice of the sorted file list — ranks never open overlapping files,
+    so N hosts divide the decode work instead of duplicating it
+    (ROADMAP item 5b).
+
+    **Resumable**: :meth:`state` returns the ``(epoch, shard, batch)``
+    cursor — capture it in the checkpoint tuple
+    (``CheckpointManager.save(..., extra={"cursor": src.state()})``)
+    and :meth:`load_state` resumes the stream at exactly the next
+    batch: the epoch order is a pure function of ``seed + epoch`` and
+    per-image augmentation seeds are position-derived
+    (:func:`_stable_seed`), so nothing depends on consumed RNG state.
     """
 
     def __init__(self, root: str, batch: int, size: int = 224, *,
                  workers: Optional[int] = None, train: bool = True,
                  seed: int = 0, dtype=np.float32,
-                 drop_last: bool = True):
+                 drop_last: bool = True,
+                 process_index: Optional[int] = None,
+                 process_count: Optional[int] = None):
         self.paths, self.labels, self.classes = _list_imagefolder(root)
+        # each field independently falls back to the JAX topology: a
+        # caller passing only process_count must still land on ITS OWN
+        # rank's shard, not silently on shard 0 everywhere
+        if process_count is None:
+            try:
+                import jax
+                process_count = jax.process_count()
+            except Exception:
+                process_count = 1
+        if process_index is None:
+            try:
+                import jax
+                process_index = jax.process_index()
+            except Exception:
+                process_index = 0
+        self.process_count = max(int(process_count), 1)
+        self.process_index = int(process_index)
+        if not (0 <= self.process_index < self.process_count):
+            raise ValueError(f"process_index {self.process_index} out of "
+                             f"range for process_count "
+                             f"{self.process_count}")
+        if self.process_count > 1:
+            # strided file-shard assignment over the (sorted) global
+            # list, EQUALIZED to exactly floor(N/world) files per rank:
+            # disjoint by construction, and every rank yields the same
+            # number of batches per epoch — ranks driving one lockstep
+            # collective step per batch stay synchronized at the epoch
+            # tail (the ≤world-1 remainder files are dropped, the
+            # drop_last convention applied across ranks)
+            per = len(self.paths) // self.process_count
+            if per == 0:
+                raise ValueError(
+                    f"rank {self.process_index}/{self.process_count} "
+                    f"got an empty file shard — fewer files than ranks")
+            sl = slice(self.process_index, per * self.process_count,
+                       self.process_count)
+            self.paths = self.paths[sl]
+            self.labels = self.labels[sl]
         self.batch = batch
         self.size = size
         self.train = train
@@ -121,6 +192,7 @@ class ImageFolderSource:
         self.workers = workers or min(16, (os.cpu_count() or 1))
         self._pool = concurrent.futures.ThreadPoolExecutor(self.workers)
         self._epoch = 0
+        self._batch = 0            # next batch index within the epoch
 
     def __len__(self):
         n = len(self.paths) // self.batch
@@ -140,24 +212,71 @@ class ImageFolderSource:
     def __exit__(self, *exc):
         self.close()
 
+    # -- the resumable cursor ------------------------------------------------
+
+    def state(self) -> dict:
+        """The ``(epoch, batch)`` cursor of the NEXT batch this source
+        will yield, plus the shard identity — everything a checkpoint
+        needs to resume the stream exactly (host ints only; JSON-safe).
+        """
+        return {"epoch": int(self._epoch), "batch": int(self._batch),
+                "shard": int(self.process_index),
+                "n_shards": int(self.process_count),
+                "seed": int(self.seed), "n_files": len(self.paths),
+                "batch_size": int(self.batch),
+                "drop_last": bool(self.drop_last)}
+
+    def load_state(self, cursor: dict) -> "ImageFolderSource":
+        """Resume from a :meth:`state` cursor. Refuses a cursor from a
+        different file shard, a changed file set, or a different batch
+        geometry (batch size / drop_last shift where batch index k
+        starts) — silently resuming a mismatched stream would double-
+        or skip-read data."""
+        for key, have in (("shard", self.process_index),
+                          ("n_shards", self.process_count),
+                          ("seed", self.seed),
+                          ("n_files", len(self.paths)),
+                          ("batch_size", self.batch),
+                          ("drop_last", self.drop_last)):
+            want = cursor.get(key, have)
+            if int(want) != int(have):
+                raise ValueError(
+                    f"data cursor mismatch: checkpoint has {key}="
+                    f"{want}, this source has {have} — rebuild the "
+                    f"source with the same seed and shard assignment "
+                    f"(or the dataset changed under the checkpoint)")
+        self._epoch = int(cursor["epoch"])
+        self._batch = int(cursor["batch"])
+        return self
+
     def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
-        rng = np.random.RandomState(self.seed + self._epoch)
-        order = rng.permutation(len(self.paths))
-        self._epoch += 1
+        """Iterate the current epoch from the cursor position (batch 0
+        on a fresh source; mid-epoch after :meth:`load_state`). The
+        order is ``RandomState(seed + epoch)``'s permutation and each
+        image's augmentation seed derives from its position — both pure
+        functions of the cursor, never of consumed RNG state."""
+        e = self._epoch
+        order = np.random.RandomState(self.seed + e).permutation(
+            len(self.paths))
         b = self.batch
-        for start in range(0, len(order) - (b - 1 if self.drop_last
-                                            else 0), b):
+        starts = range(0, len(order) - (b - 1 if self.drop_last
+                                        else 0), b)
+        for bi, start in enumerate(starts):
+            if bi < self._batch:
+                continue                 # cursor skip: nothing decoded
             idx = order[start:start + b]
-            futs = [self._pool.submit(_decode_one, self.paths[i],
-                                      self.size,
-                                      int(rng.randint(1 << 31)),
-                                      self.train)
-                    for i in idx]
+            futs = [self._pool.submit(
+                _decode_one, self.paths[i], self.size,
+                _stable_seed(self.seed, e, start + j), self.train)
+                    for j, i in enumerate(idx)]
             x = np.empty((len(idx), self.size, self.size, 3), self.dtype)
             for j, f in enumerate(futs):
                 x[j] = f.result().astype(self.dtype)
             x *= np.asarray(1.0 / 255.0, self.dtype)
+            self._batch = bi + 1
             yield x, self.labels[idx]
+        self._epoch += 1
+        self._batch = 0
 
     def batches(self, steps: int) -> Iterator[Tuple[np.ndarray,
                                                     np.ndarray]]:

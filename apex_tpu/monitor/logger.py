@@ -68,6 +68,7 @@ class MetricsLogger:
                  trace_sink: Optional[Sink] = None,
                  memory_sink: Optional[Sink] = None,
                  lint_sink: Optional[Sink] = None,
+                 ckpt_sink: Optional[Sink] = None,
                  donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
@@ -85,6 +86,11 @@ class MetricsLogger:
         #: ``check_metrics_schema.py --kind lint``)
         self.lint_sink = lint_sink
         self.lint_report = None        # last attached lint.Report
+        #: the ``ckpt`` event channel (kind="ckpt_save"/"ckpt_restore"/
+        #: "ckpt_escalation" events from apex_tpu.ckpt — validate with
+        #: ``check_metrics_schema.py --kind ckpt``). Wire a
+        #: CheckpointManager with ``event_sink=logger.record_ckpt``.
+        self.ckpt_sink = ckpt_sink
         #: snapshot each recorded metrics pytree into fresh device
         #: buffers (async scalar copies). REQUIRED when the step is
         #: jitted with donate_argnums over the state carrying the
@@ -294,6 +300,24 @@ class MetricsLogger:
                 self.record_lint(ev)
         return self
 
+    # -- ckpt channel --------------------------------------------------------
+
+    def record_ckpt(self, event: Dict) -> None:
+        """Emit one checkpoint event (``kind="ckpt_save"|"ckpt_restore"
+        |"ckpt_escalation"``) through the ckpt channel — plain-dict
+        pass-through like :meth:`record_event` (saves are rare and the
+        escalation path must never buffer: a record that only lands at
+        flush time would be lost to the very crash it documents).
+        Non-finite numbers are nulled to keep the strict-JSON
+        contract."""
+        if self.ckpt_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+        self.ckpt_sink.emit(rec)
+
     def close(self) -> None:
         if self._closed:
             return
@@ -306,6 +330,8 @@ class MetricsLogger:
             self.memory_sink.close()
         if self.lint_sink is not None:
             self.lint_sink.close()
+        if self.ckpt_sink is not None:
+            self.ckpt_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

@@ -979,7 +979,64 @@ def _():
               f"0 errors")
 
 
-# --- ddp: bucketed-overlap & exact-mode contracts ----------------------------
+# --- ckpt: host-side-only snapshot contract ----------------------------------
+
+@case("ckpt/no-extra-dispatch")
+def _():
+    """Checkpointing attached to a train loop must leave the step's
+    compiled HLO BIT-IDENTICAL — donated and undonated: the snapshot is
+    device copies + host-side writes BETWEEN dispatches, never ops
+    inside the step program (the claim behind the <5%-of-step async
+    overhead bound: only the copy dispatch rides the step path). Also
+    pins the donation-safety contract itself: the state saved right
+    before a donating dispatch restores bitwise after that dispatch
+    invalidated the original buffers."""
+    import tempfile
+
+    from apex_tpu import amp, ckpt
+    from apex_tpu.monitor.check import module_count_and_host_ops
+    from apex_tpu.optim import FusedSGD
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+    amp_opt, state0 = amp.initialize(
+        params, FusedSGD(lr=0.1), "O2", half_dtype=jnp.float16,
+        verbosity=0)
+
+    def train_step(state, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        state, loss, _ = amp_opt.step(state, loss_fn)
+        return state, loss
+
+    for donate in ((), (0,)):
+        jitted = jax.jit(train_step, donate_argnums=donate)
+        before = jitted.lower(state0, x, y).compile().as_text()
+        with tempfile.TemporaryDirectory() as tmp:
+            mgr = ckpt.CheckpointManager(tmp)
+            state = state0
+            for i in range(3):
+                state, loss = jitted(state, x, y)
+                if i == 1:
+                    mgr.save(i, state)      # the NEXT dispatch donates
+            mgr.wait()                      # `state`'s buffers away
+            after = jitted.lower(state0, x, y).compile().as_text()
+            assert after == before, \
+                f"checkpointing changed the compiled step (donate=" \
+                f"{donate})"
+            _n, host = module_count_and_host_ops(
+                jax.jit(train_step, donate_argnums=donate), state0, x, y)
+            assert not host, f"step compiled host traffic: {host}"
+            if donate:
+                # the donation-safety half: the original `saved` buffers
+                # were invalidated by the i=2 dispatch, yet the
+                # checkpoint restores the step-1 state bitwise
+                restored, _m = mgr.restore(state0)
+                rs = jax.tree_util.tree_leaves(restored.params)
+                assert all(np.isfinite(np.asarray(l)).all() for l in rs)
+                assert int(restored.step) == 2, int(restored.step)
 
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)

@@ -193,6 +193,24 @@ class DistributedFusedAdam(FusedOptimizer):
             slots["v"][dt] = jnp.zeros_like(shard)
         return ShardedOptState(count=jnp.int32(0), slots=slots)
 
+    # -- checkpoint layout ---------------------------------------------------
+
+    def checkpoint_layout(self, params) -> Dict[str, int]:
+        """``dtype → logical buffer length`` of this optimizer's slot
+        shards — the numbers an elastic checkpoint records so a restore
+        can re-partition to a different ``zero_size``
+        (:mod:`apex_tpu.ckpt.elastic`; docs/checkpointing.md). The
+        logical content of every slot buffer is its first
+        ``buffer_len`` elements: the arena pads with zeros, zero grads
+        keep zero moments at zero, and a zero master under AdamW decay
+        stays zero — so truncate + re-pad across world sizes is
+        bitwise. Host-side shape arithmetic only; delegates to the one
+        shared derivation (``ckpt.elastic.partition_lengths``) that
+        ``ckpt.zero_layout`` also uses for the manifest's per-leaf
+        map, so the two can never drift."""
+        from apex_tpu.ckpt.elastic import partition_lengths
+        return partition_lengths(arena.plan(params))
+
     # -- memory accounting ---------------------------------------------------
 
     def state_bytes(self, params, world: Optional[int] = None) -> Dict:

@@ -23,8 +23,8 @@ from apex_tpu.parallel.distributed import (
 )
 from apex_tpu.parallel.larc import LARC, larc_rewrite_grads
 from apex_tpu.parallel.launch import (
-    distributed_init, enable_crash_dumps, is_distributed, process_index,
-    process_count, maybe_print,
+    distributed_init, elastic_run, enable_crash_dumps, is_distributed,
+    process_index, process_count, maybe_print, shrink_schedule,
 )
 from apex_tpu.parallel.ring import ring_attention, ulysses_attention
 from apex_tpu.parallel.sync_batchnorm import (
@@ -41,8 +41,9 @@ __all__ = [
     "bucket_plan", "bucket_table", "bucketed_all_reduce",
     "init_residual", "wire_bytes",
     "LARC", "larc_rewrite_grads",
-    "distributed_init", "enable_crash_dumps", "is_distributed",
-    "process_index", "process_count", "maybe_print",
+    "distributed_init", "elastic_run", "enable_crash_dumps",
+    "is_distributed", "process_index", "process_count", "maybe_print",
+    "shrink_schedule",
     "ring_attention", "ulysses_attention",
     "SyncBatchNorm", "sync_batch_norm", "sync_moments",
     "syncbn_stats_groups", "convert_sync_batchnorm",

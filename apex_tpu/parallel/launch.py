@@ -21,7 +21,8 @@ from typing import Optional
 import jax
 
 __all__ = ["distributed_init", "is_distributed", "process_index",
-           "process_count", "maybe_print", "enable_crash_dumps"]
+           "process_count", "maybe_print", "enable_crash_dumps",
+           "elastic_run", "shrink_schedule"]
 
 _initialized = False
 
@@ -66,7 +67,8 @@ def distributed_init(coordinator_address: Optional[str] = None,
 
 def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
                        capacity: int = 64,
-                       hang_deadline_s: Optional[float] = None):
+                       hang_deadline_s: Optional[float] = None,
+                       escalation=None):
     """One-call forensics bring-up for (multi-host) launches.
 
     Builds a :class:`apex_tpu.trace.Tracer`, a per-rank
@@ -77,6 +79,12 @@ def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
     started :class:`~apex_tpu.trace.HangWatchdog`. Call after
     :func:`distributed_init` so rank resolution sees the cluster.
 
+    ``escalation`` (an :class:`apex_tpu.ckpt.EscalationPolicy`) wires
+    fault *recovery* on top of the forensics: SIGTERM preemption saves
+    the last host checkpoint snapshot before the dump, and a watchdog
+    stall escalates to checkpoint-save → crash-dump → nonzero exit
+    (docs/checkpointing.md §escalation).
+
     Returns ``(tracer, recorder, watchdog-or-None)``; enter the tracer
     around the train loop and wrap steps in ``trace.step()`` /
     ``trace.span`` so dumps carry span timelines (docs/tracing.md).
@@ -84,12 +92,88 @@ def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
     from apex_tpu import trace as _trace
     tracer = _trace.Tracer()
     recorder = _trace.FlightRecorder(path, capacity=capacity,
-                                     tracer=tracer).install()
+                                     tracer=tracer,
+                                     escalation=escalation).install()
+    if escalation is not None and getattr(escalation, "recorder",
+                                          None) is None:
+        escalation.recorder = recorder
     watchdog = None
     if hang_deadline_s:
-        watchdog = _trace.HangWatchdog(hang_deadline_s, recorder=recorder,
-                                       tracer=tracer).start()
+        watchdog = _trace.HangWatchdog(
+            hang_deadline_s, recorder=recorder, tracer=tracer,
+            on_stall=escalation).start()
     return tracer, recorder, watchdog
+
+
+# --- elastic restart-on-smaller-mesh -----------------------------------------
+
+def shrink_schedule(world: int, *, min_world: int = 1,
+                    factor: int = 2) -> list:
+    """The default mesh-shrink ladder: ``world, world//factor, ...``
+    down to ``min_world`` — each entry a size every apex_tpu ZeRO/DDP
+    axis accepts (shards re-partition to any size; see
+    docs/checkpointing.md elasticity matrix)."""
+    if int(factor) < 2:
+        raise ValueError(f"shrink factor must be >= 2, got {factor} "
+                         f"(factor 1 would never shrink)")
+    out, w = [], int(world)
+    while w >= max(int(min_world), 1):
+        out.append(w)
+        if w == 1:
+            break
+        w //= int(factor)
+    return out
+
+
+def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
+                = None, escalation_exit_codes=(75,)):
+    """Restart-on-smaller-mesh: the single-controller recovery loop.
+
+    ``train_fn(world, attempt)`` runs the training job on ``world``
+    devices (restoring from the latest committed checkpoint itself —
+    ``ckpt.CheckpointManager.restore`` makes resume mesh-agnostic). A
+    completed call returns its result; an escalation —
+    :class:`apex_tpu.ckpt.PreemptionError`, or ``SystemExit`` with a
+    code in ``escalation_exit_codes`` (the watchdog policy's
+    ``os._exit(75)`` surfaces this way when ``train_fn`` wraps a
+    subprocess) — shrinks to the next mesh size and continues instead
+    of dying. Any other exception propagates: escalation is for
+    capacity loss, not for masking bugs.
+
+    On a multi-process pod the same contract holds one level up: the
+    process manager re-launches ranks with the smaller ``WORLD_SIZE``
+    when a rank exits with :data:`apex_tpu.ckpt.ESCALATION_EXIT_CODE`;
+    this helper is that loop for single-controller (one-process,
+    many-device) jobs and for tests.
+    """
+    from apex_tpu.ckpt import PreemptionError
+    sizes = list(world_sizes)
+    if not sizes:
+        raise ValueError("world_sizes must name at least one mesh size")
+    i, attempt = 0, 0
+    while True:
+        world = sizes[i]
+        try:
+            return train_fn(world, attempt)
+        except PreemptionError as e:
+            maybe_print(f"apex_tpu.elastic: escalated on world={world} "
+                        f"({e.reason}); shrinking", rank0=True)
+        except SystemExit as e:
+            if e.code not in escalation_exit_codes:
+                raise
+            maybe_print(f"apex_tpu.elastic: exit code {e.code} on "
+                        f"world={world}; shrinking", rank0=True)
+        attempt += 1
+        if max_restarts is not None and attempt > max_restarts:
+            raise RuntimeError(
+                f"elastic_run: {attempt} restarts exhausted "
+                f"max_restarts={max_restarts}")
+        if i + 1 < len(sizes):
+            i += 1
+        else:
+            raise RuntimeError(
+                f"elastic_run: escalated at the smallest mesh size "
+                f"{sizes[-1]} — no capacity left to shrink to")
 
 
 def is_distributed() -> bool:

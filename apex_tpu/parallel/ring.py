@@ -130,14 +130,34 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
 
     Re-shards (seq-sharded, all heads) → (all seq, head-sharded), runs
     local fused attention, and restores. Requires H % axis_size == 0.
+
+    **Softmax dropout is a deliberate, load-bearing refusal** (tested:
+    ``tests/test_ring_attention.py::test_ulysses_dropout_raises``). The
+    fused kernels' keep-mask is a counter-based hash of the score
+    element's *global* grid coordinates, and its batch·head term is the
+    kernel grid row ``b·H + h`` (``ops.attention._keep_mask``). After
+    the Ulysses head re-shard, device d computes head-row ``b·(H/w) +
+    h_local`` where the single-device mask needs ``b·H + d·(H/w) +
+    h_local`` — not an affine shift of the local row (the ``H/w → H``
+    stride change mixes batch and head), so unlike the sequence-shard
+    case there is no ``dropout_block_offset``-style traced offset that
+    repairs it; the kernels would need a head-reshard coordinate remap
+    in all four mask sites plus the dense bias-grad replica. Until
+    then, a silently-local mask would break train/eval parity with the
+    single-device model — refusing loudly is the correct behavior.
     """
     if dropout_rate > 0.0:
         raise NotImplementedError(
             "ulysses_attention does not support softmax dropout: after "
-            "the head re-shard the kernels' batch·head hash coordinate "
-            "is local, so the mask would not match the single-device "
-            "mask; use ring_attention(dropout_rate=..., "
-            "dropout_seed=...), whose mask is bitwise-identical")
+            "the all-to-all head re-shard the kernels' batch-head mask "
+            "coordinate is local (b*H_local + h_local, stride H_local) "
+            "while the single-device mask hashes b*H + h_global (stride "
+            "H) — the masks would silently diverge from the "
+            "single-device model. Use ring_attention(q, k, v, "
+            f"{axis_name!r}, dropout_rate={dropout_rate}, "
+            "dropout_seed=...) instead: its sequence-block offsets keep "
+            "the mask bitwise-identical to the single-device kernel "
+            "(docs/parallel.md#ulysses-dropout).")
     del dropout_seed
     world = jax.lax.axis_size(axis_name)
     h = q.shape[2]

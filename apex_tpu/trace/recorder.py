@@ -138,8 +138,15 @@ class FlightRecorder:
 
     def __init__(self, path: str = "apex_tpu_crash.jsonl", *,
                  capacity: int = 64, tracer: Optional[Tracer] = None,
-                 collective_bytes: Optional[int] = None):
+                 collective_bytes: Optional[int] = None,
+                 escalation=None):
         self.path = rank_path(path)
+        #: optional :class:`apex_tpu.ckpt.EscalationPolicy`: its
+        #: ``on_preempt`` runs FIRST in the SIGTERM handler, so a
+        #: managed-cluster preemption commits the last host checkpoint
+        #: snapshot durably *before* the crash dump is written — lost
+        #: work becomes a resume point (docs/checkpointing.md)
+        self.escalation = escalation
         self.capacity = max(int(capacity), 1)
         self._ring: "collections.deque[StepRecord]" = collections.deque(
             maxlen=self.capacity)
@@ -270,6 +277,11 @@ class FlightRecorder:
 
     def _sigterm(self, signum, frame) -> None:
         self._abnormal_seen = True
+        if self.escalation is not None:
+            try:
+                self.escalation.on_preempt()
+            except Exception:
+                pass          # the dump below must still land
         self.dump(reason="signal:SIGTERM")
         prev = self._prev_sigterm
         if callable(prev):

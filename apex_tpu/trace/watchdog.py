@@ -64,6 +64,15 @@ class HangWatchdog:
     Fires at most once per stall (re-arms when heartbeats resume);
     ``on_fire`` (called with the dump dict) hooks alerting. The thread is
     a daemon — it never blocks interpreter exit.
+
+    ``on_stall`` is the *escalation* hook — typically an
+    :class:`apex_tpu.ckpt.EscalationPolicy` — invoked AFTER the hang
+    dump is written: it may save the last host checkpoint snapshot and
+    hard-exit the process (``os._exit``), turning a silent wedged rank
+    into a restartable failure instead of an indefinite hang
+    (docs/checkpointing.md §escalation). Unlike ``on_fire`` (alerting;
+    exceptions swallowed), an ``on_stall`` that exits is the intended
+    behavior.
     """
 
     def __init__(self, deadline_s: float = 300.0, *,
@@ -71,6 +80,7 @@ class HangWatchdog:
                  tracer: Optional[Tracer] = None,
                  path: Optional[str] = None,
                  on_fire: Optional[Callable[[Dict], None]] = None,
+                 on_stall: Optional[Callable[[Dict], None]] = None,
                  poll_s: Optional[float] = None):
         self.deadline_s = float(deadline_s)
         self.recorder = recorder
@@ -85,6 +95,7 @@ class HangWatchdog:
         else:
             self.path = rank_path(path) if path else None
         self.on_fire = on_fire
+        self.on_stall = on_stall
         self.poll_s = poll_s if poll_s is not None else \
             max(self.deadline_s / 10.0, 0.05)
         self._beat = time.monotonic()
@@ -186,4 +197,13 @@ class HangWatchdog:
                 self.on_fire(event)
             except Exception:
                 pass
+        if self.on_stall is not None:
+            # escalation LAST, after the hang dump is safely on disk:
+            # an exit-mode policy never returns (checkpoint-save →
+            # crash-dump → os._exit — the designed shrink-and-continue
+            # trigger), and a raise-mode policy invoked on this daemon
+            # thread completes the save/dump and records its `tripped`
+            # flag instead of raising (a raise here could not unwind
+            # the wedged main thread; _loop's guard would swallow it).
+            self.on_stall(event)
         return event

@@ -10,9 +10,12 @@ exact/bf16/int8 gradient sync — see apex_tpu.parallel.comm), the
 apex_tpu.prof.memory report estimate elsewhere — AOT, zero extra
 dispatches on the measured path), ``n_compiles`` (process-wide
 backend-compile count from apex_tpu.prof.compile_watch — a step
-silently retracing per call explodes this column), and
+silently retracing per call explodes this column),
 ``lint_findings``/``lint_errors`` (apexlint finding counts on the
-compiled headline step — see apex_tpu.lint / docs/linting.md).
+compiled headline step — see apex_tpu.lint / docs/linting.md), and
+``ckpt_save_stall_ms`` (per-step stall of an async apex_tpu.ckpt
+snapshot vs a synchronous save — the checkpoint-overhead claim of
+docs/checkpointing.md as a measured column).
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
@@ -615,6 +618,59 @@ def _bert_row(on_tpu: bool):
             "batch": b, "seq": s}
 
 
+def _ckpt_row(batch: int, size: int, steps: int = 4):
+    """The ``ckpt_save_stall_ms`` column: per-step stall of an async
+    checkpoint snapshot (apex_tpu.ckpt) vs a fully synchronous
+    save-and-wait, against the measured plain step time — the
+    <5%-of-step async-overhead claim as a measured number
+    (docs/checkpointing.md). A short wall-clock loop on the headline
+    step (the scan-differencing regime can't interleave host-side
+    saves), small-N medians, temp dir discarded."""
+    import statistics
+    import tempfile
+
+    from apex_tpu import ckpt as _ckpt
+
+    step, (state, batch_stats), (x, y) = _resnet_step_builder(batch, size)
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+
+    def run(mgr, mode, state, batch_stats):
+        """steps plain steps (warm), then ONE measured save — the
+        save-every-N cadence's marginal cost, not back-to-back saves
+        serialized on the double buffer. The save path itself is warmed
+        first (a throwaway save+wait): the first capture jit-compiles
+        the batched copy program, a once-per-process cost that would
+        otherwise masquerade as steady-state stall."""
+        walls, stalls = [], []
+        for i in range(steps):
+            t0 = time.perf_counter()
+            state, batch_stats, loss = jstep(state, batch_stats, x, y)
+            float(np.asarray(loss))           # sync: true step wall
+            walls.append((time.perf_counter() - t0) * 1e3)
+            if mgr is not None:
+                s = mgr.save(i, state, block=(mode == "sync"))
+                mgr.wait()        # quiesce: isolate the NEXT stall
+                if i > 0:         # i==0 warms (copy-program compile)
+                    stalls.append(s)
+        stall = min(stalls) if stalls else None      # best-of, like
+        return (statistics.median(walls), stall,     # _scan_device_time
+                state, batch_stats)
+
+    step_ms, _, state, batch_stats = run(None, "none", state, batch_stats)
+    with tempfile.TemporaryDirectory() as tmp:
+        mgr = _ckpt.CheckpointManager(tmp + "/a", keep=1)
+        _, async_ms, state, batch_stats = run(mgr, "async", state,
+                                              batch_stats)
+        mgr = _ckpt.CheckpointManager(tmp + "/s", keep=1)
+        _, sync_ms, state, batch_stats = run(mgr, "sync", state,
+                                             batch_stats)
+    return {"async_stall_ms": round(async_ms, 3),
+            "sync_save_ms": round(sync_ms, 3),
+            "step_ms": round(step_ms, 3),
+            "stall_frac_of_step": round(async_ms / step_ms, 4)
+            if step_ms else None}
+
+
 def _memory_row(batch: int, size: int):
     """The `peak_hbm_bytes` + `lint_findings` columns: AOT-compile the
     headline step (one compile, ZERO dispatches — the measured path is
@@ -700,6 +756,10 @@ def main():
         mem = _memory_row(best_batch, size)
     except Exception as e:
         mem = {"failed": type(e).__name__}
+    try:
+        ckpt_row = _ckpt_row(8 if not on_tpu else 64, size)
+    except Exception as e:
+        ckpt_row = {"failed": type(e).__name__}
     # every trace/lowering/backend-compile the bench performed — a
     # steady-state regression (a step silently retracing per call)
     # shows up here as n_compiles exploding
@@ -735,6 +795,10 @@ def main():
                   "lint_errors": mem.get("lint", {}).get(
                       "by_severity", {}).get("error"),
                   "n_compiles": n_compiles,
+                  # async checkpoint overhead on the step path (median
+                  # per-step capture stall vs a synchronous
+                  # save-and-wait; apex_tpu.ckpt, docs/checkpointing.md)
+                  "ckpt_save_stall_ms": ckpt_row,
                   "bert_large_lamb": bert,
                   "ddp_comm_modes": ddp_comm},
     }))

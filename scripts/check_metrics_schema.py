@@ -44,12 +44,23 @@ per-severity breakdown; each ``lint_finding`` names its rule (stable
 id), severity in {error, warning, info}, message, fix-it hint, and
 evidence (op / scope / bytes).
 
+``--kind ckpt`` — the checkpoint event channel
+(``MetricsLogger(ckpt_sink=...)``; keep in lockstep with
+``apex_tpu/ckpt/manager.py`` and ``escalate.py``): ``kind`` in
+{ckpt_save, ckpt_restore, ckpt_escalation}. A ``ckpt_save`` names the
+committed directory with its step, payload bytes, the step-path stall
+(``stall_ms`` — the async-save overhead the bench row tracks) and the
+write duration; a ``ckpt_restore`` carries the restored step and how
+many leaves were elastically re-partitioned (``resharded``); a
+``ckpt_escalation`` records the stall/preempt reason, the action taken
+and the (nullable — no snapshot may exist yet) checkpoint path.
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
-           [--kind metrics|trace|memory|lint] FILE
+           [--kind metrics|trace|memory|lint|ckpt] FILE
 """
 
 from __future__ import annotations
@@ -129,6 +140,78 @@ LINT_NULLABLE = {
     "lint_report": ("step", "fn"),
     "lint_finding": ("step", "fn", "op", "scope", "bytes", "fix"),
 }
+
+
+# --- ckpt channel schema ------------------------------------------------------
+
+CKPT_KINDS = ("ckpt_save", "ckpt_restore", "ckpt_escalation")
+CKPT_ACTIONS = ("checkpoint+dump+exit", "checkpoint+dump")
+#: required keys per ckpt-event kind (beyond "kind" itself)
+CKPT_REQUIRED = {
+    "ckpt_save": ("step", "path", "bytes", "stall_ms", "dur_ms"),
+    "ckpt_restore": ("step", "path", "dur_ms"),
+    "ckpt_escalation": ("reason", "action"),
+}
+#: keys that may be null per kind (everything else non-null when present)
+CKPT_NULLABLE = {
+    "ckpt_save": (),
+    "ckpt_restore": (),
+    "ckpt_escalation": ("path", "step", "exit_code"),
+}
+
+
+def check_ckpt_lines(lines) -> List[str]:
+    """All ckpt-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates save commits, (elastic) restores, and
+    escalation records."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in CKPT_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{CKPT_KINDS}, got {kind!r}")
+            continue
+        for key in CKPT_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = CKPT_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        _check_counter(i, rec, "step", errors, what="field")
+        _check_counter(i, rec, "bytes", errors, what="byte field")
+        for key in ("n_arrays", "resharded", "from_processes",
+                    "exit_code"):
+            _check_counter(i, rec, key, errors, what="field")
+        for dk in ("stall_ms", "dur_ms", "wall_time"):
+            v = rec.get(dk)
+            if dk not in rec or v is None:
+                continue
+            if not _is_number(v) or v < 0:
+                errors.append(f"line {i}: {dk!r} must be a non-negative "
+                              f"number, got {v!r}")
+        if kind != "ckpt_escalation":
+            p = rec.get("path")
+            if "path" in rec and not isinstance(p, str):
+                errors.append(f"line {i}: 'path' must be a string, "
+                              f"got {p!r}")
+        if kind == "ckpt_escalation":
+            if not isinstance(rec.get("reason"), str):
+                errors.append(f"line {i}: escalation 'reason' must be a "
+                              "string")
+            act = rec.get("action")
+            if act is not None and act not in CKPT_ACTIONS:
+                errors.append(f"line {i}: 'action' must be one of "
+                              f"{CKPT_ACTIONS}, got {act!r}")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
 
 
 # --- shared core -------------------------------------------------------------
@@ -411,7 +494,8 @@ def check_lint_lines(lines) -> List[str]:
 
 
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
-            "memory": check_memory_lines, "lint": check_lint_lines}
+            "memory": check_memory_lines, "lint": check_lint_lines,
+            "ckpt": check_ckpt_lines}
 
 
 def main(argv=None) -> int:

@@ -7,6 +7,8 @@
 #                                   # + the DDP overlap audit (8-device
 #                                   #   CPU variant of pod_comm_budget)
 #                                   # + the memory-budget audit (--cpu8)
+#                                   # + the ckpt save->kill->elastic-
+#                                   #   restore roundtrip (--cpu8)
 #                                   # + apexlint on both flagship steps
 #                                   #   (asserts zero error findings)
 #
@@ -67,6 +69,14 @@ EOF
     # (b) ZeRO optimizer state ~1/N vs replicated, (c) compile_watch
     # 1 steady-state compile + named changed arg on a forced retrace
     JAX_PLATFORMS=cpu python scripts/memory_budget.py --cpu8
+
+    echo "== smoke: checkpoint save->kill->elastic-restore roundtrip"
+    # asserts: (a) SIGKILL mid-save (both crash points) leaves the
+    # previous committed checkpoint as latest + hash-verified loadable,
+    # (b) ZeRO run saved on the 8-mesh resumes on a 4-mesh bitwise vs
+    # an uninterrupted 4-mesh run, (c) async capture stall bounded by
+    # the full save, (d) the ckpt event stream passes --kind ckpt
+    JAX_PLATFORMS=cpu python scripts/ckpt_roundtrip.py --cpu8
 
     echo "== smoke: apexlint flagship steps (--fail-on error)"
     # lints the flagship ResNet-O2 and BERT-LAMB steps (CPU structural

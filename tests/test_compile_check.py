@@ -12,8 +12,14 @@ def test_case_registry_nonempty_and_named():
     assert len(names) >= 20
     assert len(set(names)) == len(names)
     for family in ("attention", "layer_norm", "mlp", "xentropy",
-                   "multi_tensor", "optim", "bn_act"):
+                   "multi_tensor", "optim", "bn_act", "ckpt"):
         assert any(n.startswith(family + "/") for n in names), family
+
+
+def test_ckpt_case_runs_green():
+    """The ISSUE-6 acceptance case: a step with checkpointing attached
+    compiles bit-identical HLO, donated and undonated."""
+    assert cc.run(pattern="ckpt")
 
 
 def test_fast_subset_runs_green(tmp_path):

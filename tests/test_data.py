@@ -90,6 +90,98 @@ def test_too_small_dataset_raises(tmp_path):
         next(src.batches(1))
 
 
+# --- per-process file shards + the resumable cursor (ROADMAP 5b) ------------
+
+
+def test_process_file_shards_are_disjoint_and_cover(tree):
+    """Ranks never read overlapping files, and together they cover the
+    whole dataset — the no-duplicate-decode contract."""
+    full = ImageFolderSource(tree, batch=1, size=16, workers=1)
+    shards = [ImageFolderSource(tree, batch=1, size=16, workers=1,
+                                process_index=r, process_count=3)
+              for r in range(3)]
+    sets = [set(s.paths) for s in shards]
+    for i in range(3):
+        for j in range(i + 1, 3):
+            assert not (sets[i] & sets[j]), (i, j)
+    assert set().union(*sets) == set(full.paths)   # 12 % 3 == 0
+    # labels follow their files through the shard slice
+    for s in shards:
+        for p, l in zip(s.paths, s.labels):
+            k = full.paths.index(p)
+            assert full.labels[k] == l
+    # non-divisible case: shards are EQUALIZED (same batch count per
+    # rank → lockstep collectives never desync at the epoch tail); the
+    # <world remainder is dropped, not assigned lopsidedly
+    uneven = [ImageFolderSource(tree, batch=1, size=16, workers=1,
+                                process_index=r, process_count=5)
+              for r in range(5)]
+    ns = [len(s.paths) for s in uneven]
+    assert len(set(ns)) == 1 and ns[0] == len(full.paths) // 5
+    got = set().union(*(set(s.paths) for s in uneven))
+    assert len(set(full.paths) - got) == len(full.paths) % 5
+
+
+def test_shard_rank_out_of_range_and_empty_raise(tree, tmp_path):
+    with pytest.raises(ValueError, match="out of range"):
+        ImageFolderSource(tree, batch=1, size=16, workers=1,
+                          process_index=3, process_count=3)
+    make_fake_imagefolder(str(tmp_path / "tiny"), n_classes=1,
+                          per_class=2, size=32)
+    with pytest.raises(ValueError, match="empty file shard"):
+        ImageFolderSource(str(tmp_path / "tiny"), batch=1, size=16,
+                          workers=1, process_index=5, process_count=9)
+
+
+def test_cursor_resume_is_exact(tree):
+    """The checkpoint contract: a source resumed from a cursor yields
+    the exact remaining stream — batches bitwise-equal to the
+    uninterrupted run, across an epoch boundary."""
+    ref = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
+    stream = [(x.copy(), y.copy()) for x, y in ref.batches(5)]
+
+    src = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
+    it = src.batches(5)
+    for k in range(2):
+        next(it)
+    cursor = src.state()
+    it.close()
+
+    resumed = ImageFolderSource(tree, batch=4, size=32, workers=2,
+                                seed=5).load_state(cursor)
+    rest = [(x, y) for x, y in resumed.batches(3)]
+    assert len(rest) == 3
+    for (xa, ya), (xb, yb) in zip(stream[2:], rest):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_cursor_mismatch_is_refused(tree):
+    src = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
+    cursor = src.state()
+    other = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=6)
+    with pytest.raises(ValueError, match="cursor mismatch"):
+        other.load_state(cursor)
+    # a different batch geometry shifts where batch index k starts —
+    # resuming would double-/skip-read, so it must be refused too
+    other_batch = ImageFolderSource(tree, batch=2, size=32, workers=2,
+                                    seed=5)
+    with pytest.raises(ValueError, match="batch_size"):
+        other_batch.load_state(cursor)
+
+
+def test_cursor_json_roundtrips(tree):
+    """The cursor must survive the checkpoint manifest (JSON)."""
+    import json
+
+    src = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=1)
+    next(src.batches(1))
+    cur = json.loads(json.dumps(src.state()))
+    src2 = ImageFolderSource(tree, batch=4, size=32, workers=2,
+                             seed=1).load_state(cur)
+    assert src2.state() == src.state()
+
+
 # --- packed pre-decoded cache (the DALI-class path) -------------------------
 
 from apex_tpu.data import PackedSource, build_cache

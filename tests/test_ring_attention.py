@@ -191,10 +191,32 @@ class TestRingDropout:
             _run(mesh, ring, q, k, v)
 
     def test_ulysses_dropout_raises(self):
+        """The load-bearing refusal (docs/parallel.md#ulysses-dropout):
+        after the head re-shard the kernels' batch·head mask coordinate
+        cannot reproduce the single-device mask, so the call must fail
+        LOUDLY, name the working alternative with its arguments, and
+        point at the docs — not silently train with a divergent mask."""
         mesh = self._mesh2()
         rng = np.random.RandomState(6)
         q, k, v = rand_qkv(rng, 1, 2 * 128, 2, 64)
-        with pytest.raises(NotImplementedError, match="ring_attention"):
+        with pytest.raises(NotImplementedError) as ei:
             _run(mesh, lambda q, k, v: parallel.ulysses_attention(
                 q, k, v, "data", dropout_rate=0.1, dropout_seed=0),
                  q, k, v)
+        msg = str(ei.value)
+        # actionable: the exact alternative call, with the axis and
+        # rate the user passed, plus the docs anchor and the why
+        assert "ring_attention(q, k, v, 'data', dropout_rate=0.1" in msg
+        assert "docs/parallel.md#ulysses-dropout" in msg
+        assert "batch-head mask coordinate" in msg
+
+    def test_ulysses_dropout_zero_rate_still_works(self):
+        """The refusal is scoped to dropout_rate > 0 — rate 0 (eval, or
+        train without dropout) must run, not raise."""
+        mesh = self._mesh2()
+        rng = np.random.RandomState(7)
+        q, k, v = rand_qkv(rng, 1, 2 * 128, 2, 64)
+        out = _run(mesh, lambda q, k, v: parallel.ulysses_attention(
+            q, k, v, "data", dropout_rate=0.0, dropout_seed=None),
+            q, k, v)
+        assert np.isfinite(np.asarray(out)).all()
