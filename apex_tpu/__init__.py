@@ -29,6 +29,11 @@ A brand-new JAX/XLA/Pallas framework with the capabilities of NVIDIA Apex
                            donation-safe sharded snapshots, crash-safe
                            manifest-last commits, resume on a different
                            mesh shape, silent-rank → checkpoint-and-exit.
+- ``apex_tpu.guard``     — self-healing training: in-graph anomaly
+                           detection (loss spikes, grad explosions,
+                           nonfinite params), a skip→backoff→rewind→
+                           escalate policy ladder, and a deterministic
+                           chaos-injection harness.
 
 Unlike the reference (an interception-based library over an eager framework),
 apex_tpu expresses the same capabilities as *policies, functional transforms and
@@ -45,6 +50,7 @@ from apex_tpu import amp
 from apex_tpu import arena
 from apex_tpu import ckpt
 from apex_tpu import fp16_utils
+from apex_tpu import guard
 from apex_tpu import lint
 from apex_tpu import monitor
 from apex_tpu import ops
@@ -55,6 +61,6 @@ from apex_tpu import reparam
 from apex_tpu import trace
 from apex_tpu import utils
 
-__all__ = ["amp", "arena", "ckpt", "fp16_utils", "lint", "monitor",
-           "ops", "optim", "parallel", "prof", "reparam", "trace",
-           "utils", "__version__"]
+__all__ = ["amp", "arena", "ckpt", "fp16_utils", "guard", "lint",
+           "monitor", "ops", "optim", "parallel", "prof", "reparam",
+           "trace", "utils", "__version__"]

@@ -225,7 +225,8 @@ class Amp:
 
     # -- update --------------------------------------------------------------
 
-    def apply_gradients(self, state: AmpState, grads, grads_finite) -> AmpState:
+    def apply_gradients(self, state: AmpState, grads, grads_finite, *,
+                        metrics_grad_norm=None) -> AmpState:
         """Optimizer update committed only where grads were finite.
 
         The skipped step neither moves params nor advances optimizer
@@ -264,20 +265,59 @@ class Amp:
             # overflow/skip counts); garbage-grad norms would poison the
             # logged stream with inf.
             fin = jnp.asarray(grads_finite, jnp.bool_)
+            # metrics_grad_norm: the TRUE gradient norm when the caller
+            # pre-scaled `grads` (the guard's LR backoff) — the gauge
+            # must report gradient health, not the damped update
+            gnorm = (metrics_grad_norm if metrics_grad_norm is not None
+                     else global_norm(grads))
             metrics = metrics.count_step(grads_finite).record_norms(
-                grad_norm=jnp.where(fin, global_norm(grads),
-                                    metrics.grad_norm),
+                grad_norm=jnp.where(fin, gnorm, metrics.grad_norm),
                 param_norm=global_norm(committed_params))
         return state._replace(step=new_step, params=committed_params,
                               opt_state=committed_opt, metrics=metrics)
 
     def step(self, state: AmpState, loss_fn: Callable, *args,
-             loss_id: int = 0, has_aux: bool = False, **kwargs):
-        """backward + apply in one call. Returns (state', out, finite)."""
+             loss_id: int = 0, has_aux: bool = False, guard=None,
+             **kwargs):
+        """backward + apply in one call. Returns (state', out, finite).
+
+        ``guard=(guard_state, guard_config)`` threads an
+        :class:`apex_tpu.guard.GuardState` through the step: the
+        anomaly detectors observe the unscaled loss, the fp32 grads and
+        the committed params between backward and apply, and the commit
+        predicate becomes ``finite AND no skip-class anomaly`` — the
+        loss scaler's overflow skip generalized to loss spikes, grad
+        explosions and nonfinite state (docs/resilience.md). The
+        guard's LR-backoff rung applies as **gradient scaling**: grads
+        are multiplied by ``gs.lr_scale`` before the optimizer (exact
+        LR-equivalence for the SGD family; adaptive optimizers like
+        Adam normalize much of a pure scale away — own the LR directly
+        in your schedule when you need a stronger brake there). The
+        return grows a fourth element:
+        ``(state', out, committed, guard_state')``. All of it is
+        in-graph arithmetic riding the existing dispatch (the
+        ``guard/no-extra-dispatch`` compile-check case)."""
         out, grads, state, finite = self.backward(
             state, loss_fn, *args, loss_id=loss_id, has_aux=has_aux, **kwargs)
-        state = self.apply_gradients(state, grads, finite)
-        return state, out, finite
+        if guard is None:
+            state = self.apply_gradients(state, grads, finite)
+            return state, out, finite
+        from apex_tpu.guard import guard_observe, guard_ok
+        gs, gcfg = guard
+        loss_val = out[0] if has_aux else out
+        true_norm = global_norm(grads)
+        gs = guard_observe(gs, gcfg, loss=loss_val,
+                           grad_norm=true_norm,
+                           params=state.params, grads_finite=finite)
+        grads = jax.tree_util.tree_map(
+            lambda g: g * gs.lr_scale.astype(g.dtype)
+            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
+            grads)
+        committed = jnp.logical_and(jnp.asarray(finite, jnp.bool_),
+                                    guard_ok(gs, gcfg))
+        state = self.apply_gradients(state, grads, committed,
+                                     metrics_grad_norm=true_norm)
+        return state, out, committed, gs
 
     # -- memory accounting ---------------------------------------------------
 

@@ -125,10 +125,13 @@ class EscalationPolicy:
 
     # -- hooks -----------------------------------------------------------------
 
-    def on_stall(self, event: Optional[Dict] = None) -> None:
-        """HangWatchdog ``on_stall`` hook: the silent-rank path."""
+    def trip(self, reason: str) -> None:
+        """Escalate NOW with a caller-supplied reason — the public entry
+        point for in-band policies (:class:`apex_tpu.guard.GuardPolicy`
+        trips it with ``"guard:..."`` when the rewind budget runs out).
+        Same ladder as the watchdog path: checkpoint-save → crash-dump →
+        ``os._exit`` / :class:`PreemptionError` per ``mode``."""
         import threading
-        reason = "stall"
         exit_after = self.mode == "exit"
         path = self._escalate(reason, exit_after=exit_after)
         if exit_after:
@@ -140,6 +143,10 @@ class EscalationPolicy:
             # is the observable (see class docstring)
             return
         raise PreemptionError(reason, path)
+
+    def on_stall(self, event: Optional[Dict] = None) -> None:
+        """HangWatchdog ``on_stall`` hook: the silent-rank path."""
+        return self.trip("stall")
 
     def on_preempt(self) -> Optional[str]:
         """FlightRecorder SIGTERM hook: graceful preemption. Saves the

@@ -60,6 +60,14 @@ class CheckpointError(RuntimeError):
     """A checkpoint could not be written or read consistently."""
 
 
+# shared-filesystem polls (the rank barrier, manifest reads, restore
+# gathers) MUST NOT poll in lockstep: N ranks hammering one metadata
+# server at a fixed 50 ms phase is exactly the thundering herd that
+# turns a slow NFS into a stalled commit — hence the de-phased,
+# seed-independent jittered backoff (see apex_tpu/utils/backoff.py)
+from apex_tpu.utils.backoff import backoff_sleep as _backoff_sleep
+
+
 def _test_crash(point: str) -> None:
     if os.environ.get(_CRASH_ENV) == point:
         os.kill(os.getpid(), signal.SIGKILL)
@@ -195,6 +203,7 @@ def commit_manifest(ckpt_dir: str, *, step: int, process_count: int,
     """
     deadline = time.monotonic() + barrier_timeout_s
     files: List[Dict] = []
+    attempt = 0
     while True:
         files = []
         missing = []
@@ -210,9 +219,20 @@ def commit_manifest(ckpt_dir: str, *, step: int, process_count: int,
         if time.monotonic() > deadline:
             raise CheckpointError(
                 f"checkpoint barrier timed out after {barrier_timeout_s}s"
-                f" waiting for ranks {missing} under {ckpt_dir} — NOT "
-                f"committing (the previous checkpoint stays the latest)")
-        time.sleep(0.05)
+                f" waiting for ranks {missing} (have "
+                f"{sorted(f['rank'] for f in files)}) under {ckpt_dir} "
+                f"— NOT committing (the previous checkpoint stays the "
+                f"latest); the named ranks never wrote their files.json "
+                f"(dead, preempted, or a shared-fs visibility lag "
+                f"longer than the timeout)")
+        # jittered exponential poll: fast while peers are mid-write,
+        # backed off once something is clearly slow — and never in
+        # phase across waiters. Cap 0.2 s: a blocking save's commit
+        # barrier can sit on the MAIN thread (save(block=True)) where
+        # every extra poll latency is step-heartbeat latency a
+        # HangWatchdog with a tight deadline would misread as a stall
+        _backoff_sleep(attempt, cap_s=0.2)
+        attempt += 1
     manifest = {
         "format": FORMAT_VERSION, "step": int(step),
         "wall_time": time.time(), "process_count": int(process_count),
@@ -232,28 +252,82 @@ def commit_manifest(ckpt_dir: str, *, step: int, process_count: int,
 
 # --- read side ----------------------------------------------------------------
 
-def read_manifest(ckpt_dir: str) -> Dict:
+def read_manifest(ckpt_dir: str, *, attempts: int = 3) -> Dict:
+    """Read the commit record, retrying transient shared-fs failures.
+
+    A manifest is written atomically (temp → fsync → rename), but on a
+    networked filesystem a reader racing the rename — or a brief NFS
+    staleness window — can see ENOENT/EIO/short-read for a file that is
+    durably there. Bounded jittered retries absorb that; a manifest
+    still unreadable after ``attempts`` is genuinely absent or broken.
+    """
     path = os.path.join(ckpt_dir, MANIFEST)
-    try:
-        with open(path) as f:
-            return json.load(f)
-    except (OSError, ValueError) as e:
-        raise CheckpointError(f"no committed checkpoint at {ckpt_dir}: "
-                              f"{e}") from e
+    last: Optional[Exception] = None
+    for k in range(max(int(attempts), 1)):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            last = e
+            if k + 1 < attempts:
+                _backoff_sleep(k, base_s=0.05)
+    raise CheckpointError(f"no committed checkpoint at {ckpt_dir}: "
+                          f"{last} (after {attempts} attempts)") from last
+
+
+def _read_file_deadline(fpath: str, deadline_s: float) -> bytes:
+    """Read a checkpoint data file with jittered retries under one
+    overall deadline — the timeout on the elastic-restore *gather*: a
+    multi-rank restore pulling dozens of shard files over a shared fs
+    must degrade to an actionable refusal naming the file, never hang
+    a whole relaunch on one stuck read."""
+    t0 = time.monotonic()
+    attempt = 0
+    not_found = 0
+    last: Optional[Exception] = None
+    while True:
+        try:
+            with open(fpath, "rb") as f:
+                return f.read()
+        except FileNotFoundError as e:
+            # absence is retried only briefly (rename-visibility lag);
+            # a file still absent after that is deleted/never-written —
+            # fail fast with the actionable "missing" message instead
+            # of burning the whole gather deadline on it
+            last = e
+            not_found += 1
+            if not_found >= 3:
+                raise CheckpointError(
+                    f"checkpoint data file missing: {fpath} ({e})"
+                ) from e
+        except OSError as e:
+            last = e
+        if time.monotonic() - t0 >= deadline_s:
+            raise CheckpointError(
+                f"checkpoint data file unreadable within "
+                f"{deadline_s:.0f}s: {fpath} ({last}) — the restore "
+                f"gather timed out; restore from another checkpoint or "
+                f"raise io_deadline_s if the filesystem is just slow"
+            ) from last
+        _backoff_sleep(attempt, base_s=0.05)
+        attempt += 1
 
 
 def assemble_arrays(ckpt_dir: str, manifest: Dict, *,
                     paths: Optional[Sequence[str]] = None,
-                    verify: bool = True) -> Dict[str, np.ndarray]:
+                    verify: bool = True,
+                    io_deadline_s: float = 30.0) -> Dict[str, np.ndarray]:
     """Gather-by-manifest: read every referenced data file and assemble
     each leaf's full logical array from its chunks.
 
     ``paths`` restricts assembly (restore only pulls what the like-tree
     needs); ``verify`` checks each data file's sha256 against the
-    manifest before trusting it. Raises :class:`CheckpointError` on a
-    hash mismatch or a leaf whose chunks do not cover the full array
-    (e.g. a lone-rank escalation save of ZeRO-sharded state — the
-    actionable message names the uncovered leaf).
+    manifest before trusting it; ``io_deadline_s`` bounds each file
+    read (transient shared-fs errors are retried with jittered backoff
+    inside the deadline). Raises :class:`CheckpointError` on a hash
+    mismatch, a read timeout, or a leaf whose chunks do not cover the
+    full array (e.g. a lone-rank escalation save of ZeRO-sharded state
+    — the actionable message names the uncovered leaf).
     """
     want = set(paths) if paths is not None else None
     loaded: Dict[str, Any] = {}
@@ -264,12 +338,7 @@ def assemble_arrays(ckpt_dir: str, manifest: Dict, *,
                                         for a in frec["arrays"]):
             continue
         fpath = os.path.join(ckpt_dir, frec["file"])
-        try:
-            with open(fpath, "rb") as f:
-                data = f.read()
-        except OSError as e:
-            raise CheckpointError(
-                f"checkpoint data file missing: {fpath} ({e})") from e
+        data = _read_file_deadline(fpath, io_deadline_s)
         if verify and _sha256(data) != frec["sha256"]:
             raise CheckpointError(
                 f"content hash mismatch for {fpath} — the file does not "

@@ -268,7 +268,8 @@ class CheckpointManager:
     # -- restore ---------------------------------------------------------------
 
     def restore(self, like, *, ckpt_dir: Optional[str] = None,
-                verify: bool = True) -> Tuple[Any, Dict]:
+                verify: bool = True,
+                io_deadline_s: float = 30.0) -> Tuple[Any, Dict]:
         """Load the newest committed checkpoint into the structure (and
         onto the mesh) of ``like``.
 
@@ -280,6 +281,10 @@ class CheckpointManager:
         re-scattered with its sharding — the elastic 8→4 (or 4→8) path.
         Returns ``(tree, manifest)``; the data-pipeline cursor and any
         other save-time ``extra`` ride in ``manifest["extra"]``.
+        ``io_deadline_s`` bounds each data-file read of the gather
+        (jittered retries inside it) — an elastic relaunch must refuse
+        with the file named rather than hang on one stuck shared-fs
+        read.
         """
         import jax
         import jax.numpy as jnp
@@ -295,7 +300,8 @@ class CheckpointManager:
         flat = jax.tree_util.tree_flatten_with_path(like)
         want = [jax.tree_util.keystr(p) for p, _ in flat[0]]
         loaded = _format.assemble_arrays(d, manifest, paths=want,
-                                         verify=verify)
+                                         verify=verify,
+                                         io_deadline_s=io_deadline_s)
         zero = manifest.get("zero", {})
         impls = manifest.get("prng_impls", {})
         resharded = 0

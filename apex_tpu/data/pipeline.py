@@ -34,6 +34,33 @@ import numpy as np
 
 IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp")
 
+#: bounded retries for transient decode-path IO (shared-fs blips,
+#: networked storage hiccups) — override with APEX_TPU_DATA_IO_RETRIES
+_IO_ATTEMPTS = max(int(os.environ.get("APEX_TPU_DATA_IO_RETRIES", "3")), 1)
+
+
+def _read_rgb_with_retry(path: str, attempts: int = None):
+    """Open + fully decode one image, retrying transient ``OSError``s
+    with jittered backoff. A loader thread that dies on one NFS blip
+    kills the whole batch future; a file that stays unreadable after
+    ``attempts`` tries raises with the path and attempt count named —
+    the poisoned-input case is then the guard's problem, not a hang."""
+    from PIL import Image
+
+    attempts = _IO_ATTEMPTS if attempts is None else max(int(attempts), 1)
+    last = None
+    for k in range(attempts):
+        try:
+            with Image.open(path) as img:
+                return img.convert("RGB")   # convert forces the decode
+        except OSError as e:
+            last = e
+            if k + 1 < attempts:
+                from apex_tpu.utils.backoff import backoff_sleep
+                backoff_sleep(k, base_s=0.05, cap_s=0.5)
+    raise OSError(f"failed to read image {path!r} after {attempts} "
+                  f"attempts: {last}") from last
+
 
 def _list_imagefolder(root: str):
     """(paths, labels, class_names) for a torchvision-ImageFolder-style
@@ -99,17 +126,16 @@ def _decode_one(path: str, size: int, seed: int, train: bool):
     from PIL import Image
 
     rng = np.random.RandomState(seed & 0x7FFFFFFF)
-    with Image.open(path) as img:
-        img = img.convert("RGB")
-        if train:
-            img = _random_resized_crop(img, size, rng)
-        else:
-            s = min(img.size)
-            w, h = img.size
-            img = img.resize((size, size), Image.BILINEAR,
-                             box=((w - s) // 2, (h - s) // 2,
-                                  (w + s) // 2, (h + s) // 2))
-        arr = np.asarray(img, np.uint8)
+    img = _read_rgb_with_retry(path)
+    if train:
+        img = _random_resized_crop(img, size, rng)
+    else:
+        s = min(img.size)
+        w, h = img.size
+        img = img.resize((size, size), Image.BILINEAR,
+                         box=((w - s) // 2, (h - s) // 2,
+                              (w + s) // 2, (h + s) // 2))
+    arr = np.asarray(img, np.uint8)
     if train and rng.rand() < 0.5:
         arr = arr[:, ::-1]
     return arr
@@ -247,6 +273,46 @@ class ImageFolderSource:
                     f"(or the dataset changed under the checkpoint)")
         self._epoch = int(cursor["epoch"])
         self._batch = int(cursor["batch"])
+        return self
+
+    def cursor_index(self) -> int:
+        """Linear batch index of the cursor: ``epoch · batches_per_epoch
+        + batch`` — the total batches this source has yielded (or
+        skipped) since construction. The coordinate
+        :meth:`apex_tpu.guard.GuardPolicy.rewind` differences to size
+        the offending window. A cursor captured right after an epoch's
+        last batch (the transient ``batch == batches_per_epoch`` state,
+        before the generator's epilogue wraps it) maps to the same
+        index as the next epoch's batch 0 — the two states are the same
+        stream position."""
+        return self._epoch * len(self) + self._batch
+
+    def skip_batches(self, n: int) -> "ImageFolderSource":
+        """Advance the cursor ``n`` batches WITHOUT decoding anything —
+        the guard's poison-batch fast-forward: after a rewind restores
+        the checkpoint cursor, skipping the offending window costs zero
+        image reads, and the stream continues exactly where a run that
+        never saw those batches would be (epoch order and per-image
+        augmentation seeds are pure functions of the cursor, so the
+        downstream stream is bitwise-identical). Crosses epoch
+        boundaries. Call between batches — a live :meth:`epoch`
+        generator does not see cursor mutations; rebuild iteration
+        after calling this (as after :meth:`load_state`)."""
+        per = len(self)
+        if per == 0:
+            raise ValueError("cannot skip batches on a source that "
+                             "yields none (fewer files than batch size)")
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"skip_batches needs n >= 0, got {n}")
+        # linear-index arithmetic, NOT increment-then-wrap: a cursor
+        # loaded from the post-epoch transient (batch == per, captured
+        # right after an epoch's last yielded batch) aliases the next
+        # epoch's batch 0, and incrementing it before wrapping would
+        # swallow one skip — landing a guard rewind one batch short of
+        # the offending window's end
+        idx = self._epoch * per + self._batch + n
+        self._epoch, self._batch = divmod(idx, per)
         return self
 
     def epoch(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:

@@ -1,0 +1,49 @@
+"""apex_tpu.guard — self-healing training.
+
+Three layers close the detect→recover→prove loop (docs/resilience.md):
+
+- **in-graph detection** (:mod:`~apex_tpu.guard.detect`): a
+  :class:`GuardState` pytree carried through the jitted step — rolling
+  robust-z loss-spike detection, grad-norm explosion flags, nonfinite
+  grad/loss/param probes, and an amp-style LR-backoff schedule — all
+  pure ``jnp`` with zero extra dispatches (the
+  ``guard/no-extra-dispatch`` compile-check case); skip-class anomalies
+  never commit (:func:`guard_commit`, amp's overflow skip generalized).
+- **the policy ladder** (:mod:`~apex_tpu.guard.policy`):
+  :class:`GuardPolicy` escalates per anomaly class with hysteresis and
+  budgets — in-graph skip/backoff → **rewind** to the last good
+  :mod:`apex_tpu.ckpt` snapshot with the :mod:`apex_tpu.data` cursor
+  fast-forwarded past the offending window (bitwise-equal to a run that
+  never saw those batches) → hand-off to
+  :class:`apex_tpu.ckpt.EscalationPolicy` (checkpoint + dump + exit 75).
+- **deterministic chaos** (:mod:`~apex_tpu.guard.chaos`): a seeded,
+  replayable :class:`FaultPlan` keyed by (step, rank, site) injecting
+  NaN/Inf grads, poisoned batches, param bit-flips, overflow storms,
+  stalled collectives, SIGKILL and truncated checkpoints — consumed by
+  ``tests/test_guard.py`` and the asserted
+  ``scripts/chaos_audit.py --cpu8`` soak.
+"""
+
+from apex_tpu.guard import chaos
+from apex_tpu.guard.chaos import (ChaosHarness, Fault, FaultPlan,
+                                  inject_activation, inject_grads)
+from apex_tpu.guard.detect import (A_GRAD_EXPLOSION, A_LOSS_SPIKE,
+                                   A_NONFINITE_GRAD, A_NONFINITE_LOSS,
+                                   A_NONFINITE_PARAM, ANOMALY_CLASSES,
+                                   LR_BACKOFF_MASK, REWIND_MASK,
+                                   SKIP_MASK, GuardConfig, GuardState,
+                                   anomaly_classes, guard_commit,
+                                   guard_init, guard_observe, guard_ok)
+from apex_tpu.guard.policy import (GuardAction, GuardEscalation,
+                                   GuardPolicy)
+
+__all__ = [
+    "GuardConfig", "GuardState", "guard_init", "guard_observe",
+    "guard_ok", "guard_commit", "anomaly_classes", "ANOMALY_CLASSES",
+    "A_LOSS_SPIKE", "A_GRAD_EXPLOSION", "A_NONFINITE_GRAD",
+    "A_NONFINITE_LOSS", "A_NONFINITE_PARAM",
+    "SKIP_MASK", "REWIND_MASK", "LR_BACKOFF_MASK",
+    "GuardPolicy", "GuardAction", "GuardEscalation",
+    "FaultPlan", "Fault", "ChaosHarness", "chaos",
+    "inject_grads", "inject_activation",
+]

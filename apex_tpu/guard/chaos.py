@@ -1,0 +1,356 @@
+"""Deterministic chaos injection: prove recovery, don't hope for it.
+
+A resilience stack that has never seen a fault is a liability — the
+chaos harness makes faults a *reproducible input*. A :class:`FaultPlan`
+is a pure function of its construction (explicit faults, or
+:meth:`FaultPlan.random` from a seed): keyed by ``(step, rank, site)``,
+JSON round-trippable, and replayable bit-for-bit — the same plan run
+twice injects the same faults at the same instants, which is what lets
+``scripts/chaos_audit.py`` compare a faulted run against a fault-free
+oracle bitwise.
+
+Injection sites span the layers a real pod run fails at:
+
+========== ============================ ================================
+site       kinds                        mechanism
+========== ============================ ================================
+batch      nan, inf, corrupt, overflow  host: poison the input batch
+grads      nan, inf                     in-graph (`inject_grads` + the
+                                        per-step ``fault_code`` input)
+activations nan                         in-graph (`inject_activation`)
+params     nan, bitflip                 host: corrupt committed state
+                                        AFTER the step (silent-DMA /
+                                        bit-flip model)
+collective stall                        host: sleep — a peer wedged in a
+                                        collective (watchdog territory)
+proc       sigkill                      host: SIGKILL this process
+ckpt       truncate                     host: truncate the newest
+                                        committed checkpoint's data file
+========== ============================ ================================
+
+In-graph sites work through one extra i32 scalar step input (the
+``fault_code``): the instrumented step calls
+``grads = chaos.inject_grads(grads, code)`` and XLA folds the
+``jnp.where`` selects in; a plan with no in-graph faults passes code 0
+every step and the selects choose the clean branch. Chaos
+instrumentation is for test/audit builds — production steps simply never
+take the argument.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from typing import Dict, Iterable, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["Fault", "FaultPlan", "ChaosHarness",
+           "inject_grads", "inject_activation",
+           "C_GRAD_NAN", "C_GRAD_INF", "C_ACT_NAN", "SITES"]
+
+#: fault_code bits for the in-graph sites
+C_GRAD_NAN = 1
+C_GRAD_INF = 2
+C_ACT_NAN = 4
+
+SITES: Dict[str, Tuple[str, ...]] = {
+    "batch": ("nan", "inf", "corrupt", "overflow"),
+    "grads": ("nan", "inf"),
+    "activations": ("nan",),
+    "params": ("nan", "bitflip"),
+    "collective": ("stall",),
+    "proc": ("sigkill",),
+    "ckpt": ("truncate",),
+}
+
+
+class Fault(NamedTuple):
+    """One planned fault. ``arg`` is the site-specific magnitude:
+    corrupt amplitude / overflow factor / stall seconds / bit index."""
+    step: int
+    site: str
+    kind: str
+    rank: int = 0
+    arg: float = 0.0
+
+
+class FaultPlan:
+    """A replayable, (step, rank, site)-keyed fault schedule."""
+
+    def __init__(self, faults: Iterable[Fault] = (), *, seed: int = 0):
+        self.seed = int(seed)
+        self._by_key: Dict[Tuple[int, int, str], Fault] = {}
+        for f in faults:
+            self.add(f.step, f.site, f.kind, rank=f.rank, arg=f.arg)
+
+    def add(self, step: int, site: str, kind: str, *, rank: int = 0,
+            arg: float = 0.0) -> "FaultPlan":
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r} — one of "
+                             f"{sorted(SITES)}")
+        if kind not in SITES[site]:
+            raise ValueError(f"site {site!r} supports kinds "
+                             f"{SITES[site]}, got {kind!r}")
+        key = (int(step), int(rank), site)
+        if key in self._by_key:
+            raise ValueError(f"duplicate fault at (step={step}, "
+                             f"rank={rank}, site={site})")
+        self._by_key[key] = Fault(int(step), site, kind, int(rank),
+                                  float(arg))
+        return self
+
+    @classmethod
+    def random(cls, seed: int, n_steps: int, *, rates: Dict[str, float],
+               ranks: int = 1) -> "FaultPlan":
+        """A deterministic random plan: per (step, rank), each named
+        ``site:kind`` (e.g. ``{"grads:nan": 0.05}``) fires with its
+        rate. Pure function of ``(seed, n_steps, rates, ranks)`` — two
+        calls build identical plans. At most one rate key per SITE:
+        the plan is keyed by (step, rank, site), so two kinds on one
+        site would silently under-deliver whichever loses the
+        collision — build multi-kind-per-site plans with explicit
+        :meth:`add` calls at distinct steps instead."""
+        rng = np.random.RandomState(int(seed))
+        plan = cls(seed=seed)
+        specs = []
+        seen_sites: Dict[str, str] = {}
+        for name, rate in sorted(rates.items()):
+            site, sep, kind = name.partition(":")
+            if not sep or site not in SITES or kind not in SITES[site]:
+                raise ValueError(
+                    f"unknown fault rate key {name!r} — use "
+                    f"'site:kind' with site in {sorted(SITES)} and a "
+                    f"kind that site supports (a typo here would make "
+                    f"a chaos soak pass vacuously)")
+            if site in seen_sites:
+                raise ValueError(
+                    f"rate keys {seen_sites[site]!r} and {name!r} "
+                    f"share the site {site!r}: plans are keyed by "
+                    f"(step, rank, site), so one of them would be "
+                    f"silently dropped on every collision — use "
+                    f"explicit add() calls for multi-kind sites")
+            seen_sites[site] = name
+            specs.append((name, site, kind, float(rate)))
+        for step in range(int(n_steps)):
+            for rank in range(int(ranks)):
+                for name, site, kind, rate in specs:
+                    if rng.rand() < rate:
+                        key = (step, rank, site)
+                        if key not in plan._by_key:
+                            plan._by_key[key] = Fault(step, site, kind,
+                                                      rank, 0.0)
+        return plan
+
+    def at(self, step: int, rank: int, site: str) -> Optional[Fault]:
+        return self._by_key.get((int(step), int(rank), site))
+
+    def faults(self):
+        return sorted(self._by_key.values())
+
+    def fault_code(self, step: int, rank: int = 0) -> int:
+        """The i32 bitmask driving the in-graph sites at this step."""
+        code = 0
+        g = self.at(step, rank, "grads")
+        if g is not None:
+            code |= C_GRAD_NAN if g.kind == "nan" else C_GRAD_INF
+        a = self.at(step, rank, "activations")
+        if a is not None:
+            code |= C_ACT_NAN
+        return code
+
+    # -- replayable artifact ---------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "faults": [list(f) for f in self.faults()]})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls((Fault(int(s), site, kind, int(r), float(a))
+                    for s, site, kind, r, a in d["faults"]),
+                   seed=d.get("seed", 0))
+
+    def __eq__(self, other):
+        return (isinstance(other, FaultPlan)
+                and self._by_key == other._by_key)
+
+    def __len__(self):
+        return len(self._by_key)
+
+
+# -- in-graph injection helpers ------------------------------------------------
+
+def _poison_first(x, bad, val):
+    """NaN/Inf element 0 of ``x`` when ``bad`` (a traced bool scalar)."""
+    import jax.numpy as jnp
+    flat = jnp.reshape(x, (-1,))
+    flat = flat.at[0].set(jnp.where(bad, jnp.asarray(val, flat.dtype),
+                                    flat[0]))
+    return jnp.reshape(flat, jnp.shape(x))
+
+
+def inject_grads(grads, code):
+    """Poison element 0 of every float grad leaf with NaN (code bit
+    ``C_GRAD_NAN``) or Inf (``C_GRAD_INF``). Identity when neither bit
+    is set — the clean-path select XLA folds."""
+    import jax
+    import jax.numpy as jnp
+    code = jnp.asarray(code, jnp.int32)
+    bad_nan = (code & C_GRAD_NAN) != 0
+    bad_inf = (code & C_GRAD_INF) != 0
+    bad = jnp.logical_or(bad_nan, bad_inf)
+    val = jnp.where(bad_nan, jnp.float32(jnp.nan), jnp.float32(jnp.inf))
+
+    def _one(g):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        return _poison_first(g, bad, val)
+
+    return jax.tree_util.tree_map(_one, grads)
+
+
+def inject_activation(x, code):
+    """Poison element 0 of an activation with NaN when ``C_ACT_NAN``."""
+    import jax.numpy as jnp
+    code = jnp.asarray(code, jnp.int32)
+    return _poison_first(x, (code & C_ACT_NAN) != 0, jnp.nan)
+
+
+# -- the host driver -----------------------------------------------------------
+
+class ChaosHarness:
+    """Applies a :class:`FaultPlan` to a training loop's host seams.
+
+    ::
+
+        harness = chaos.ChaosHarness(plan)
+        for step, (x, y) in enumerate(batches):
+            x, y = harness.filter_batch(step, (x, y))
+            code = harness.fault_code(step)
+            state, gs, loss = jstep(state, gs, x, y, code)
+            state = harness.post_step(step, state, ckpt_root=root)
+
+    Host injections are a pure function of ``(plan, step, rank)`` —
+    the corrupt-batch noise derives its RandomState from
+    ``plan.seed ^ step``, never from consumed global RNG.
+    """
+
+    def __init__(self, plan: FaultPlan, *, rank: int = 0):
+        self.plan = plan
+        self.rank = int(rank)
+        #: host log of injections performed: (step, site, kind)
+        self.injected: list = []
+
+    def _note(self, step, f: Fault):
+        self.injected.append((int(step), f.site, f.kind))
+
+    def fault_code(self, step: int) -> int:
+        code = self.plan.fault_code(step, self.rank)
+        for site in ("grads", "activations"):
+            f = self.plan.at(step, self.rank, site)
+            if f is not None:
+                self._note(step, f)
+        return code
+
+    def filter_batch(self, step: int, batch):
+        """Apply any ``batch``-site fault to an ``(x, y, ...)`` tuple of
+        host numpy arrays; returns the (possibly poisoned) batch."""
+        f = self.plan.at(step, self.rank, "batch")
+        if f is None:
+            return batch
+        x = np.array(batch[0], copy=True)
+        if f.kind == "nan":
+            x.reshape(-1)[0] = np.nan
+        elif f.kind == "inf":
+            x.reshape(-1)[0] = np.inf
+        elif f.kind == "corrupt":
+            amp = f.arg or 1e4
+            rng = np.random.RandomState((self.plan.seed ^ step)
+                                        & 0x7FFFFFFF)
+            x = rng.uniform(-amp, amp, x.shape).astype(x.dtype)
+        elif f.kind == "overflow":
+            x = x * np.asarray(f.arg or 1e30, x.dtype)
+        self._note(step, f)
+        return (x,) + tuple(batch[1:])
+
+    def post_step(self, step: int, state, *, ckpt_root: Optional[str]
+                  = None):
+        """Apply after-the-commit faults: param corruption, a stalled
+        collective, SIGKILL, checkpoint truncation. Returns the
+        (possibly corrupted) state tree."""
+        f = self.plan.at(step, self.rank, "params")
+        if f is not None:
+            state = self._corrupt_params(state, f)
+            self._note(step, f)
+        f = self.plan.at(step, self.rank, "collective")
+        if f is not None:
+            self._note(step, f)
+            time.sleep(float(f.arg or 1.0))
+        f = self.plan.at(step, self.rank, "ckpt")
+        if f is not None:
+            if ckpt_root is None:
+                raise ValueError("ckpt fault planned but post_step got "
+                                 "no ckpt_root")
+            self._note(step, f)
+            self.truncate_latest_checkpoint(ckpt_root)
+        f = self.plan.at(step, self.rank, "proc")
+        if f is not None:
+            self._note(step, f)
+            os.kill(os.getpid(), signal.SIGKILL)
+        return state
+
+    # -- host corruption mechanics --------------------------------------------
+
+    @staticmethod
+    def _corrupt_params(state, f: Fault):
+        """Poison element 0 of the FIRST float leaf (deterministic under
+        a fixed tree structure): NaN, or a real bit flip of the float32
+        representation (``arg`` = bit index, default 30 — the top
+        exponent bit, turning a weight into ~1e38)."""
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        for i, leaf in enumerate(leaves):
+            arr = np.array(np.asarray(leaf), copy=True)
+            if not np.issubdtype(arr.dtype, np.floating) or arr.size == 0:
+                continue
+            flat = arr.reshape(-1)
+            if f.kind == "nan":
+                flat[0] = np.nan
+            else:
+                bit = int(f.arg) or 30
+                if arr.dtype == np.float32:
+                    iv = flat[:1].view(np.uint32)
+                    iv[0] ^= np.uint32(1 << bit)
+                else:
+                    flat[0] = -flat[0] * 3.4e38
+            new = arr.reshape(np.shape(leaf))
+            if hasattr(leaf, "sharding"):
+                new = jax.device_put(new, leaf.sharding)
+            leaves = list(leaves)
+            leaves[i] = new
+            return jax.tree_util.tree_unflatten(treedef, leaves)
+        return state
+
+    @staticmethod
+    def truncate_latest_checkpoint(root: str) -> Optional[str]:
+        """Truncate the newest committed checkpoint's largest data file
+        to half — the manifest hash no longer matches, so a restore of
+        this checkpoint must refuse (and a guard rewind falls back to
+        the previous one). Returns the truncated path."""
+        from apex_tpu.ckpt import format as _fmt
+        d = _fmt.latest_checkpoint(root)
+        if d is None:
+            return None
+        npz = [os.path.join(d, n) for n in os.listdir(d)
+               if n.endswith(".npz")]
+        if not npz:
+            return None
+        target = max(npz, key=os.path.getsize)
+        size = os.path.getsize(target)
+        with open(target, "r+b") as fh:
+            fh.truncate(max(size // 2, 1))
+        return target

@@ -1,0 +1,332 @@
+"""In-graph anomaly detection: the ``GuardState`` pytree.
+
+Apex's dynamic loss scaler is the prototype in-band anomaly policy —
+detect a bad step from *inside* the program, skip it, adapt, continue
+(`apex/amp/scaler.py:197-215`) — but it only covers fp16 grad overflow.
+This module generalizes the pattern to the anomalies that actually cost
+pod-scale wall-clock: loss spikes (poisoned batches, data corruption),
+gradient-norm explosions (instability before divergence), and
+non-finite *parameters* (silent state corruption — a bit flip, a bad
+DMA — which the grad-overflow check can never see because the damage is
+already committed).
+
+Design rules (the :mod:`apex_tpu.monitor` zero-extra-dispatch pattern):
+
+- ``GuardState`` is a small pytree of on-device scalars plus two fixed-
+  length rolling windows, carried through the jitted train step like the
+  loss-scaler state itself. Every update is pure ``jnp`` arithmetic
+  riding the existing step dispatch — detection costs **no extra
+  dispatches and no host syncs** (the ``guard/no-extra-dispatch``
+  compile-check case pins it).
+- Spike detection is a **robust z-score** against the rolling loss
+  window: ``z = (loss - median) / (1.4826·MAD + floor)``. Median/MAD
+  (not mean/std) so a previous outlier cannot drag the baseline, with a
+  relative floor so a converged flat loss curve does not turn numerical
+  jitter into anomalies. Anomalous losses are never pushed into the
+  window — one poisoned step cannot poison the detector.
+- The skipped step is ``jnp.where`` commit-or-keep
+  (:func:`guard_commit`), exactly amp's functional overflow skip
+  (:func:`apex_tpu.amp.scaler.select_if_finite`) widened to every
+  skip-class anomaly.
+- The LR backoff ladder rung is in-graph too: ``lr_scale`` follows the
+  amp loss-scale schedule (backoff on spike/explosion, recover ×2 after
+  ``lr_growth_interval`` clean steps) so transient instability is damped
+  with zero host involvement. Multiply it into your update
+  (``p - lr * gs.lr_scale * g``); a run that never trips keeps
+  ``lr_scale == 1.0`` identically.
+
+Escalation beyond skip/backoff (rewind to a checkpoint, hand-off to the
+exit-75 path) is inherently host-side — see
+:class:`apex_tpu.guard.GuardPolicy`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.utils import global_norm, tree_all_finite, tree_select
+
+__all__ = [
+    "GuardConfig", "GuardState", "guard_init", "guard_observe",
+    "guard_ok", "guard_commit", "anomaly_classes",
+    "A_LOSS_SPIKE", "A_GRAD_EXPLOSION", "A_NONFINITE_GRAD",
+    "A_NONFINITE_LOSS", "A_NONFINITE_PARAM",
+    "SKIP_MASK", "REWIND_MASK", "LR_BACKOFF_MASK", "ANOMALY_CLASSES",
+]
+
+# -- anomaly bitmask -----------------------------------------------------------
+
+A_LOSS_SPIKE = 1        #: finite loss, robust z-score above threshold
+A_GRAD_EXPLOSION = 2    #: grad norm >> rolling median grad norm
+A_NONFINITE_GRAD = 4    #: NaN/Inf gradients (amp overflow generalized)
+A_NONFINITE_LOSS = 8    #: NaN/Inf loss value
+A_NONFINITE_PARAM = 16  #: NaN/Inf *committed parameters* — state corruption
+
+ANOMALY_CLASSES = {
+    A_LOSS_SPIKE: "loss_spike",
+    A_GRAD_EXPLOSION: "grad_explosion",
+    A_NONFINITE_GRAD: "nonfinite_grad",
+    A_NONFINITE_LOSS: "nonfinite_loss",
+    A_NONFINITE_PARAM: "nonfinite_param",
+}
+
+#: classes whose step is vetoed in-graph (commit-or-keep select). Note
+#: nonfinite params are NOT here: the corruption already lives in the
+#: committed state, so refusing this step's update cannot help — that
+#: class is the host policy's rewind trigger instead.
+SKIP_MASK = (A_LOSS_SPIKE | A_GRAD_EXPLOSION | A_NONFINITE_GRAD
+             | A_NONFINITE_LOSS)
+
+#: classes that mean the committed state itself is bad — skip/backoff
+#: cannot recover; the host policy rewinds to the last good snapshot.
+REWIND_MASK = A_NONFINITE_PARAM
+
+#: classes that back the in-graph lr_scale off. Deliberately excludes
+#: nonfinite grads: under amp that event is fp16 overflow and the loss
+#: *scale* schedule already owns the response — backing the LR off too
+#: would double-penalize every routine overflow.
+LR_BACKOFF_MASK = A_LOSS_SPIKE | A_GRAD_EXPLOSION
+
+
+def anomaly_classes(mask: int):
+    """Host-side helper: bitmask → sorted list of class names."""
+    m = int(mask)
+    return [name for bit, name in sorted(ANOMALY_CLASSES.items())
+            if m & bit]
+
+
+class GuardConfig(NamedTuple):
+    """Static detector configuration (hashable; safe to close over in
+    jit). Thresholds are deliberately loose by default — a guard that
+    false-positives on healthy noise is worse than no guard (the chaos
+    audit's clean-run check pins zero interventions)."""
+
+    window: int = 32            #: rolling window length (losses + norms)
+    min_history: int = 8        #: detections armed only after this many
+                                #: accepted observations
+    z_threshold: float = 8.0    #: robust z above which a loss is a spike
+    z_rel_floor: float = 0.05   #: MAD floor as a fraction of |median| —
+                                #: a flat converged loss curve must not
+                                #: turn jitter into infinite z-scores
+    grad_factor: float = 20.0   #: grad norm > factor × rolling median
+                                #: grad norm = explosion
+    check_params: bool = True   #: enable the nonfinite-param probe
+    skip_on_spike: bool = True  #: veto the commit on a loss spike (off:
+                                #: spikes are observed/counted only)
+    lr_backoff: float = 0.5     #: lr_scale multiplier on backoff-class
+                                #: anomalies (amp schedule, LR edition)
+    lr_growth_interval: int = 50  #: clean steps before lr_scale recovers
+                                  #: one ×(1/lr_backoff) notch (→ 1.0)
+    min_lr_scale: float = 1.0 / 64.0
+
+
+class GuardState(NamedTuple):
+    """The in-graph guard: rolling windows + flags + counters.
+
+    Every field is a device scalar except the two fixed-length windows —
+    the tree is checkpointable (save it inside the training tuple so a
+    rewind restores the detector's memory too), donate-able, and
+    ``lax.scan``-carryable. ``anomaly``/``z`` describe the LAST observed
+    step (transient, refreshed by :func:`guard_observe`); the ``*_count``
+    fields are cumulative and never reset, so a host poll at any cadence
+    can difference them to recover missed events.
+    """
+
+    loss_window: jax.Array        # f32[window]; NaN = empty slot
+    gnorm_window: jax.Array       # f32[window]; NaN = empty slot
+    pos: jax.Array                # i32 ring write position
+    count: jax.Array              # i32 observations accepted into windows
+    step: jax.Array               # i32 observed (attempted) steps
+    anomaly: jax.Array            # i32 bitmask for the last step
+    z: jax.Array                  # f32 last robust z-score (NaN when
+                                  # unarmed or the loss was non-finite)
+    lr_scale: jax.Array           # f32 in-graph LR backoff multiplier
+    lr_tracker: jax.Array         # i32 clean steps since last backoff
+    consecutive: jax.Array        # i32 consecutive anomalous steps
+    spike_count: jax.Array        # i32 cumulative per-class counters…
+    grad_explosion_count: jax.Array
+    nonfinite_grad_count: jax.Array
+    nonfinite_loss_count: jax.Array
+    nonfinite_param_count: jax.Array
+    skip_count: jax.Array         # i32 cumulative in-graph vetoed steps
+
+
+def guard_init(cfg: GuardConfig = GuardConfig()) -> GuardState:
+    """Fresh guard state — thread through the step like scaler state."""
+    w = int(cfg.window)
+    if w < 4:
+        raise ValueError(f"GuardConfig.window must be >= 4, got {w} "
+                         f"(a robust median needs history)")
+    nan = jnp.full((w,), jnp.nan, jnp.float32)
+    z0 = jnp.int32(0)
+    return GuardState(
+        loss_window=nan, gnorm_window=nan,
+        pos=z0, count=z0, step=z0,
+        anomaly=z0, z=jnp.float32(0.0),
+        lr_scale=jnp.float32(1.0), lr_tracker=z0, consecutive=z0,
+        spike_count=z0, grad_explosion_count=z0,
+        nonfinite_grad_count=z0, nonfinite_loss_count=z0,
+        nonfinite_param_count=z0, skip_count=z0,
+    )
+
+
+def _robust_z(loss, window, cfg: GuardConfig):
+    """Signed robust z-score of ``loss`` against the rolling window.
+
+    ``nanmedian`` treats empty (NaN) slots as missing; an all-empty
+    window yields NaN which compares False against the threshold — the
+    un-armed guard can never fire."""
+    med = jnp.nanmedian(window)
+    mad = jnp.nanmedian(jnp.abs(window - med))
+    scale = (1.4826 * mad + cfg.z_rel_floor * jnp.abs(med) + 1e-12)
+    return (loss - med) / scale
+
+
+def guard_observe(gs: GuardState, cfg: GuardConfig, *, loss,
+                  grads=None, grad_norm=None, params=None,
+                  grads_finite=None) -> GuardState:
+    """Observe one step: compute this step's anomaly bitmask against the
+    PRE-update windows, advance windows/counters/LR schedule. Pure
+    ``jnp``; rides the existing step dispatch.
+
+    ``loss`` is required. ``grads`` (a pytree) enables the nonfinite-grad
+    check and, unless ``grad_norm`` is given, the explosion check;
+    ``grads_finite`` (a precomputed flag — e.g. amp's) substitutes for
+    the finiteness traversal. ``params`` enables the nonfinite-param
+    probe (pass the *committed* params the step started from — the probe
+    exists to catch corruption that is already state).
+    """
+    loss = jnp.asarray(loss, jnp.float32)
+    armed = gs.count >= cfg.min_history
+
+    # --- per-class detections ------------------------------------------------
+    z = _robust_z(loss, gs.loss_window, cfg)
+    loss_finite = jnp.isfinite(loss)
+    spike = jnp.logical_and(jnp.logical_and(armed, loss_finite),
+                            z > cfg.z_threshold)
+
+    gnorm = None
+    if grad_norm is not None:
+        gnorm = jnp.asarray(grad_norm, jnp.float32)
+    elif grads is not None:
+        gnorm = global_norm(grads)
+    if gnorm is not None:
+        gmed = jnp.nanmedian(gs.gnorm_window)
+        explosion = jnp.logical_and(
+            armed, gnorm > cfg.grad_factor * gmed)
+        # an exploding-to-inf norm belongs to the nonfinite class below
+        explosion = jnp.logical_and(explosion, jnp.isfinite(gnorm))
+    else:
+        explosion = jnp.bool_(False)
+
+    if grads_finite is not None:
+        g_fin = jnp.asarray(grads_finite, jnp.bool_)
+    elif grads is not None:
+        g_fin = tree_all_finite(grads)
+    elif gnorm is not None:
+        g_fin = jnp.isfinite(gnorm)
+    else:
+        g_fin = jnp.bool_(True)
+
+    if cfg.check_params and params is not None:
+        p_fin = tree_all_finite(params)
+    else:
+        p_fin = jnp.bool_(True)
+
+    def _bit(cond, bit):
+        return jnp.where(cond, jnp.int32(bit), jnp.int32(0))
+
+    anomaly = (_bit(spike, A_LOSS_SPIKE)
+               + _bit(explosion, A_GRAD_EXPLOSION)
+               + _bit(jnp.logical_not(g_fin), A_NONFINITE_GRAD)
+               + _bit(jnp.logical_not(loss_finite), A_NONFINITE_LOSS)
+               + _bit(jnp.logical_not(p_fin), A_NONFINITE_PARAM))
+    if not cfg.skip_on_spike:
+        skip_mask = SKIP_MASK & ~A_LOSS_SPIKE
+    else:
+        skip_mask = SKIP_MASK
+    skipped = (anomaly & skip_mask) != 0
+    anomalous = anomaly != 0
+
+    # --- window advance (clean, finite observations only) --------------------
+    accept = jnp.logical_and(jnp.logical_not(anomalous), loss_finite)
+    old_l = gs.loss_window[gs.pos]
+    new_lw = gs.loss_window.at[gs.pos].set(
+        jnp.where(accept, loss, old_l))
+    if gnorm is not None:
+        old_g = gs.gnorm_window[gs.pos]
+        new_gw = gs.gnorm_window.at[gs.pos].set(
+            jnp.where(accept, gnorm, old_g))
+    else:
+        new_gw = gs.gnorm_window
+    w = gs.loss_window.shape[0]
+    new_pos = jnp.where(accept, (gs.pos + 1) % w, gs.pos)
+    new_count = gs.count + jnp.where(accept, 1, 0).astype(jnp.int32)
+
+    # --- LR backoff schedule (amp's loss-scale schedule, LR edition) ---------
+    # the recovery tracker counts CLEAN steps only: a skipped anomaly
+    # step (e.g. a NaN storm of nonfinite grads) holds the tracker —
+    # lr_scale must not recover to 1.0 across a stretch in which
+    # nothing actually committed
+    backoff_now = (anomaly & LR_BACKOFF_MASK) != 0
+    backed = jnp.maximum(gs.lr_scale * cfg.lr_backoff,
+                         cfg.min_lr_scale)
+    clean = anomaly == 0
+    grown_tracker = gs.lr_tracker + jnp.where(clean, 1, 0)
+    should_grow = jnp.logical_and(clean,
+                                  grown_tracker >= cfg.lr_growth_interval)
+    grown = jnp.minimum(gs.lr_scale / cfg.lr_backoff, 1.0)
+    new_lr = jnp.where(backoff_now, backed,
+                       jnp.where(should_grow, grown,
+                                 gs.lr_scale)).astype(jnp.float32)
+    new_tracker = jnp.where(backoff_now, 0,
+                            jnp.where(should_grow, 0,
+                                      grown_tracker)).astype(jnp.int32)
+
+    def _cnt(cond):
+        return jnp.where(cond, 1, 0).astype(jnp.int32)
+
+    return gs._replace(
+        loss_window=new_lw, gnorm_window=new_gw,
+        pos=new_pos, count=new_count, step=gs.step + 1,
+        anomaly=anomaly,
+        # NaN z (empty window, or a NaN loss) propagates as-is: the
+        # event layers null non-finite gauges on the wire (the schema's
+        # nullable-z contract); clamping to 0.0 here would read as
+        # "no spike" in dashboards for exactly the steps that matter
+        z=jnp.asarray(z, jnp.float32),
+        lr_scale=new_lr, lr_tracker=new_tracker,
+        consecutive=jnp.where(anomalous, gs.consecutive + 1,
+                              0).astype(jnp.int32),
+        spike_count=gs.spike_count + _cnt(spike),
+        grad_explosion_count=gs.grad_explosion_count + _cnt(explosion),
+        nonfinite_grad_count=(gs.nonfinite_grad_count
+                              + _cnt(jnp.logical_not(g_fin))),
+        nonfinite_loss_count=(gs.nonfinite_loss_count
+                              + _cnt(jnp.logical_not(loss_finite))),
+        nonfinite_param_count=(gs.nonfinite_param_count
+                               + _cnt(jnp.logical_not(p_fin))),
+        skip_count=gs.skip_count + _cnt(skipped),
+    )
+
+
+def guard_ok(gs: GuardState, cfg: Optional[GuardConfig] = None):
+    """Commit predicate for the step :func:`guard_observe` just scored:
+    True when no skip-class anomaly fired. Rewind-class anomalies
+    (nonfinite params) do NOT veto — the update is irrelevant there and
+    the host policy owns the response."""
+    mask = SKIP_MASK
+    if cfg is not None and not cfg.skip_on_spike:
+        mask &= ~A_LOSS_SPIKE
+    return (gs.anomaly & mask) == 0
+
+
+def guard_commit(gs: GuardState, new_tree, old_tree,
+                 cfg: Optional[GuardConfig] = None):
+    """Commit ``new_tree`` unless this step was anomalous — amp's
+    functional skipped step (:func:`~apex_tpu.amp.scaler.select_if_finite`)
+    generalized to every skip-class anomaly."""
+    return tree_select(guard_ok(gs, cfg), new_tree, old_tree)

@@ -1,0 +1,369 @@
+"""The guard policy ladder: skip → backoff → rewind → escalate.
+
+The in-graph half (:mod:`apex_tpu.guard.detect`) already *acted* on the
+common case before the host ever sees it: skip-class anomalies never
+commit and the LR backs off, all inside the step program. This module is
+the host-side escalation on top — the rungs that need the checkpoint
+manager and the data pipeline:
+
+1. **skip / backoff** (in-graph, observed here): each new anomaly is
+   emitted as a ``guard_anomaly`` event; the in-graph veto is reported
+   as a ``guard_action`` with ``action="skip"``.
+2. **rewind** — when the committed state itself is corrupt
+   (nonfinite-param class) or skipping stopped converging (more than
+   ``skip_budget`` skips inside a ``skip_window``-step window): restore
+   the last *good* snapshot via :class:`apex_tpu.ckpt.CheckpointManager`
+   and fast-forward the exact :mod:`apex_tpu.data.pipeline` cursor past
+   the offending window, so the resumed run is bitwise-equal to a run
+   that never saw those batches (``scripts/chaos_audit.py`` asserts
+   this). Snapshots whose params are non-finite, or whose files fail the
+   manifest hash (a truncated/corrupted checkpoint), are rejected and
+   the policy falls back to the next-older committed checkpoint.
+3. **escalate** — the rewind budget is exhausted (or no loadable
+   checkpoint exists): hand off to the existing
+   :class:`apex_tpu.ckpt.EscalationPolicy` (checkpoint + crash dump +
+   exit 75), the same path the hang watchdog takes.
+
+Hysteresis: a ``cooldown_steps`` window after each rewind during which
+the skip-budget accounting restarts from zero — one rough patch of data
+must not chain-rewind; rewind-class (state-corruption) anomalies are
+exempt, because waiting cannot un-corrupt params.
+
+Every decision is a ``guard`` JSONL event
+(``check_metrics_schema.py --kind guard``); wire
+``MetricsLogger(guard_sink=...)`` via ``event_sink=logger.record_guard``
+and a :class:`apex_tpu.trace.FlightRecorder` via ``recorder=`` so crash
+dumps carry the recent interventions.
+
+The per-step host poll (`update`) fetches a handful of scalars from the
+``GuardState`` — with JAX's async dispatch this rides the sync the loss
+read already forces. ``poll_every=N`` amortizes it further: the in-graph
+skip/backoff protection is always on regardless of polling, and the
+cumulative counters let a coarse poll recover every missed event; the
+only cost of coarser polling is rewind latency (≤ N extra steps inside
+the offending window).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from apex_tpu.guard.detect import (REWIND_MASK, SKIP_MASK, GuardState,
+                                   anomaly_classes)
+
+__all__ = ["GuardPolicy", "GuardAction", "GuardEscalation"]
+
+
+class GuardAction(NamedTuple):
+    """One `update` verdict. ``kind`` ∈ none | skip | rewind | escalate
+    (observe-only policies report what they *would* do in ``reason``
+    but always return kind="none")."""
+    kind: str
+    step: int
+    classes: Tuple[str, ...] = ()
+    reason: str = ""
+
+
+class GuardEscalation(RuntimeError):
+    """Raised by `escalate` when no :class:`~apex_tpu.ckpt.EscalationPolicy`
+    is wired — the guard refuses to train on irrecoverable state."""
+
+
+def _rank() -> int:
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:
+        import os
+        return int(os.environ.get("RANK", "0"))
+
+
+class GuardPolicy:
+    """See the module docstring.
+
+    ``manager`` is a :class:`apex_tpu.ckpt.CheckpointManager` (required
+    for the rewind rung); ``escalation`` an
+    :class:`apex_tpu.ckpt.EscalationPolicy` (required for the final
+    rung — without one, `escalate` raises :class:`GuardEscalation`).
+    ``observe_only=True`` turns the policy into a pure witness: every
+    event is still emitted, no action is ever taken and `update` never
+    asks for one — the zero-intervention contract the chaos audit's
+    clean-run check and the ``guard/no-extra-dispatch`` compile check
+    both pin.
+    """
+
+    def __init__(self, *, manager=None, escalation=None,
+                 event_sink: Optional[Callable[[Dict], None]] = None,
+                 recorder=None, observe_only: bool = False,
+                 rewind_budget: int = 2, skip_budget: int = 4,
+                 skip_window: int = 32, cooldown_steps: int = 16,
+                 poll_every: int = 1):
+        self.manager = manager
+        self.escalation = escalation
+        self.event_sink = event_sink
+        self.recorder = recorder
+        self.observe_only = bool(observe_only)
+        self.rewind_budget = int(rewind_budget)
+        self.skip_budget = int(skip_budget)
+        self.skip_window = int(skip_window)
+        self.cooldown_steps = int(cooldown_steps)
+        self.poll_every = max(int(poll_every), 1)
+        self.rank = _rank()
+        #: rewinds performed so far (the budget's odometer)
+        self.rewinds_done = 0
+        #: loop step below which skip-budget accounting is suspended
+        self.cooldown_until = -1
+        self._skip_steps: list = []      # loop steps of recent skips
+        self._prev: Optional[Dict[str, int]] = None
+        self._last_poll = -1
+
+    # -- events ----------------------------------------------------------------
+
+    def _emit(self, event: Dict) -> None:
+        ev = dict(event, rank=self.rank, wall_time=time.time())
+        # strict-JSON contract: non-finite gauges (a NaN-loss anomaly's
+        # z-score) become null before ANY consumer — the crash-dump ring
+        # serializes these verbatim
+        for k, v in ev.items():
+            if isinstance(v, float) and not np.isfinite(v):
+                ev[k] = None
+        if self.recorder is not None:
+            try:
+                self.recorder.note_guard(ev)
+            except Exception:
+                pass
+        if self.event_sink is None:
+            return
+        try:
+            self.event_sink(ev)
+        except Exception:
+            pass                  # telemetry must never break recovery
+
+    # -- the per-step poll ------------------------------------------------------
+
+    @staticmethod
+    def _fetch(gs: GuardState) -> Dict[str, float]:
+        """One small host fetch of the policy-relevant scalars."""
+        import jax
+        vals = jax.device_get((
+            gs.anomaly, gs.z, gs.lr_scale, gs.consecutive,
+            gs.skip_count, gs.spike_count, gs.grad_explosion_count,
+            gs.nonfinite_grad_count, gs.nonfinite_loss_count,
+            gs.nonfinite_param_count, gs.step))
+        keys = ("anomaly", "z", "lr_scale", "consecutive", "skip_count",
+                "spike_count", "grad_explosion_count",
+                "nonfinite_grad_count", "nonfinite_loss_count",
+                "nonfinite_param_count", "step")
+        return {k: (float(v) if k in ("z", "lr_scale") else int(v))
+                for k, v in zip(keys, vals)}
+
+    def update(self, step: int, gs: GuardState) -> GuardAction:
+        """Poll the guard state after loop step ``step`` and decide.
+
+        Returns the ladder verdict; the CALLER performs the returned
+        action (`rewind`/`escalate`) — the policy never mutates training
+        state behind the loop's back. ``kind="skip"`` is informational:
+        the in-graph veto already protected the state.
+        """
+        step = int(step)
+        if (step - self._last_poll) < self.poll_every and step != 0:
+            return GuardAction("none", step)
+        self._last_poll = step
+        cur = self._fetch(gs)
+        prev = self._prev or {k: 0 for k in cur}
+        self._prev = cur
+
+        # new-event deltas since the last poll (counters are cumulative,
+        # so a poll_every > 1 cadence still sees every event)
+        deltas = {k: cur[k] - prev.get(k, 0)
+                  for k in ("skip_count", "spike_count",
+                            "grad_explosion_count", "nonfinite_grad_count",
+                            "nonfinite_loss_count",
+                            "nonfinite_param_count")}
+        new_any = any(v > 0 for v in deltas.values())
+        classes = tuple(
+            name for key, name in (
+                ("spike_count", "loss_spike"),
+                ("grad_explosion_count", "grad_explosion"),
+                ("nonfinite_grad_count", "nonfinite_grad"),
+                ("nonfinite_loss_count", "nonfinite_loss"),
+                ("nonfinite_param_count", "nonfinite_param"))
+            if deltas[key] > 0)
+        if not new_any:
+            return GuardAction("none", step)
+
+        self._emit({"kind": "guard_anomaly", "step": step,
+                    "classes": list(classes),
+                    "z": cur["z"], "lr_scale": cur["lr_scale"],
+                    "consecutive": cur["consecutive"],
+                    "skip_count": cur["skip_count"]})
+
+        # ladder: rewind-class corruption, or skip budget exhausted
+        want_rewind = deltas["nonfinite_param_count"] > 0
+        reason = "nonfinite_param" if want_rewind else ""
+        if deltas["skip_count"] > 0:
+            in_cooldown = step < self.cooldown_until
+            # one entry PER skip, not per poll — a coarse poll_every
+            # must not undercount a storm of skips into never reaching
+            # the budget. Skips during the cooldown are NOT recorded:
+            # "accounting restarts from zero" means the rough patch the
+            # rewind just handled cannot be banked toward an immediate
+            # chain-rewind the moment the cooldown expires
+            if not in_cooldown:
+                self._skip_steps.extend(
+                    [step] * int(deltas["skip_count"]))
+                self._skip_steps = [s for s in self._skip_steps
+                                    if s > step - self.skip_window]
+            if (not want_rewind and not in_cooldown
+                    and len(self._skip_steps) > self.skip_budget):
+                want_rewind = True
+                reason = (f"skip_budget: {len(self._skip_steps)} skips "
+                          f"in {self.skip_window} steps")
+
+        if want_rewind:
+            if self.observe_only:
+                self._emit({"kind": "guard_action", "step": step,
+                            "action": "observe", "classes": list(classes),
+                            "reason": f"would rewind ({reason})"})
+                return GuardAction("none", step, classes, reason)
+            if self.rewinds_done >= self.rewind_budget:
+                self._emit({"kind": "guard_action", "step": step,
+                            "action": "escalate",
+                            "classes": list(classes),
+                            "reason": f"rewind budget exhausted "
+                                      f"({self.rewinds_done}/"
+                                      f"{self.rewind_budget}); {reason}"})
+                return GuardAction("escalate", step, classes, reason)
+            self._emit({"kind": "guard_action", "step": step,
+                        "action": "rewind", "classes": list(classes),
+                        "reason": reason})
+            return GuardAction("rewind", step, classes, reason)
+
+        act = "observe" if self.observe_only else "skip"
+        self._emit({"kind": "guard_action", "step": step, "action": act,
+                    "classes": list(classes),
+                    "reason": f"in-graph skip; lr_scale="
+                              f"{cur['lr_scale']:.4g}"})
+        return GuardAction("none" if self.observe_only else "skip",
+                           step, classes)
+
+    # -- rewind -----------------------------------------------------------------
+
+    @staticmethod
+    def _params_finite(tree) -> bool:
+        """Every float leaf finite — EXCEPT inside GuardState nodes,
+        whose rolling windows use NaN as the empty-slot marker by
+        design (a checkpointed young guard would otherwise read as
+        corruption and torpedo every rewind)."""
+        import jax
+        for leaf in jax.tree_util.tree_leaves(
+                tree, is_leaf=lambda x: isinstance(x, GuardState)):
+            if isinstance(leaf, GuardState):
+                continue
+            arr = np.asarray(leaf)
+            if (np.issubdtype(arr.dtype, np.floating)
+                    and not np.isfinite(arr).all()):
+                return False
+        return True
+
+    def rewind(self, step: int, like, source, *,
+               reason: str = "") -> Tuple[Any, Dict]:
+        """Restore the newest *good* snapshot and fast-forward ``source``
+        past the offending window.
+
+        ``like`` is the current training tuple (structure + shardings
+        define restore targets, exactly :meth:`CheckpointManager.restore`);
+        ``source`` any cursor-bearing pipeline
+        (:class:`apex_tpu.data.ImageFolderSource` or duck-typed:
+        ``state()/load_state()/skip_batches()/cursor_index()``) whose
+        cursor was captured in each checkpoint's ``extra["cursor"]``.
+
+        Fallback chain: a candidate checkpoint is rejected — and the
+        next-older one tried — when its files fail the manifest hash
+        (truncation/corruption) or its restored params are non-finite
+        (the corruption predates the snapshot). Returns
+        ``(restored_tree, manifest)``; raises :class:`GuardEscalation`
+        (or trips ``escalation``) when nothing loadable remains.
+        """
+        from apex_tpu.ckpt import format as _fmt
+        from apex_tpu.ckpt.format import CheckpointError
+        if self.manager is None:
+            return self.escalate(f"rewind requested but no "
+                                 f"CheckpointManager wired ({reason})")
+        cur_index = int(source.cursor_index())
+        steps = list(self.manager.all_steps())
+        fallbacks = 0
+        restored = manifest = None
+        for s in reversed(steps):
+            d = _fmt.step_dir(self.manager.root, s)
+            try:
+                cand, mf = self.manager.restore(like, ckpt_dir=d)
+            except CheckpointError:
+                fallbacks += 1
+                continue
+            if not self._params_finite(cand):
+                fallbacks += 1
+                continue
+            restored, manifest = cand, mf
+            break
+        if restored is None:
+            return self.escalate(
+                f"rewind found no loadable finite checkpoint under "
+                f"{self.manager.root!r} ({fallbacks} rejected; {reason})")
+        cursor = (manifest.get("extra") or {}).get("cursor")
+        if cursor is None:
+            return self.escalate(
+                f"checkpoint at step {manifest['step']} carries no data "
+                f"cursor in extra['cursor'] — cannot fast-forward past "
+                f"the offending window ({reason})")
+        source.load_state(cursor)
+        skipped = cur_index - int(source.cursor_index())
+        if skipped < 0:
+            return self.escalate(
+                f"data cursor moved backwards across the rewind "
+                f"({cur_index} -> {source.cursor_index()}) — the source "
+                f"does not match the checkpointed stream ({reason})")
+        source.skip_batches(skipped)
+        self.rewinds_done += 1
+        self.cooldown_until = int(step) + self.cooldown_steps
+        self._skip_steps = []
+        # resync the counter baseline to the RESTORED guard state: its
+        # cumulative counters rewound below the cached high-water mark,
+        # and without this a post-rewind anomaly whose counter has not
+        # yet re-crossed the stale baseline would difference to <= 0
+        # and be silently missed
+        import jax
+        for leaf in jax.tree_util.tree_leaves(
+                restored, is_leaf=lambda x: isinstance(x, GuardState)):
+            if isinstance(leaf, GuardState):
+                self._prev = self._fetch(leaf)
+                break
+        self._emit({"kind": "guard_rewind", "step": int(step),
+                    "from_step": int(step),
+                    "to_step": int(manifest["step"]),
+                    "path": str(self.manager.root),
+                    "skipped_batches": int(skipped),
+                    "fallbacks": int(fallbacks),
+                    "reason": reason or None})
+        return restored, manifest
+
+    # -- the last rung ----------------------------------------------------------
+
+    def escalate(self, reason: str):
+        """Hand off to the wired :class:`~apex_tpu.ckpt.EscalationPolicy`
+        (checkpoint + dump + exit 75 / PreemptionError), or raise
+        :class:`GuardEscalation` when none is wired."""
+        self._emit({"kind": "guard_action",
+                    "step": int(self._prev["step"]) if self._prev else 0,
+                    "action": "escalate", "classes": [],
+                    "reason": reason})
+        if self.escalation is not None:
+            self.escalation.trip(f"guard:{reason}")
+            # trip() only returns in raise-mode off the main thread
+            # (its documented polling contract); callers of
+            # rewind()/escalate() expect a raise or an exit, never a
+            # None return they would unpack into a TypeError
+        raise GuardEscalation(reason)

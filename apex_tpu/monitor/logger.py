@@ -69,6 +69,7 @@ class MetricsLogger:
                  memory_sink: Optional[Sink] = None,
                  lint_sink: Optional[Sink] = None,
                  ckpt_sink: Optional[Sink] = None,
+                 guard_sink: Optional[Sink] = None,
                  donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
@@ -91,6 +92,11 @@ class MetricsLogger:
         #: ``check_metrics_schema.py --kind ckpt``). Wire a
         #: CheckpointManager with ``event_sink=logger.record_ckpt``.
         self.ckpt_sink = ckpt_sink
+        #: the ``guard`` event channel (kind="guard_anomaly"/
+        #: "guard_action"/"guard_rewind" events from apex_tpu.guard —
+        #: validate with ``check_metrics_schema.py --kind guard``). Wire
+        #: a GuardPolicy with ``event_sink=logger.record_guard``.
+        self.guard_sink = guard_sink
         #: snapshot each recorded metrics pytree into fresh device
         #: buffers (async scalar copies). REQUIRED when the step is
         #: jitted with donate_argnums over the state carrying the
@@ -318,6 +324,25 @@ class MetricsLogger:
                 rec[k] = None
         self.ckpt_sink.emit(rec)
 
+    # -- guard channel -------------------------------------------------------
+
+    def record_guard(self, event: Dict) -> None:
+        """Emit one guard event (``kind="guard_anomaly"|"guard_action"
+        |"guard_rewind"``) through the guard channel — plain-dict
+        pass-through like :meth:`record_ckpt` (interventions are rare
+        and forensic; nothing is buffered — a rewind record that only
+        landed at flush time could be lost to the very escalation it
+        precedes). Non-finite numbers are nulled to keep the
+        strict-JSON contract (a NaN-loss anomaly's z-score is NaN by
+        construction)."""
+        if self.guard_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+        self.guard_sink.emit(rec)
+
     def close(self) -> None:
         if self._closed:
             return
@@ -332,6 +357,8 @@ class MetricsLogger:
             self.lint_sink.close()
         if self.ckpt_sink is not None:
             self.ckpt_sink.close()
+        if self.guard_sink is not None:
+            self.guard_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

@@ -1038,6 +1038,69 @@ def _():
                 assert all(np.isfinite(np.asarray(l)).all() for l in rs)
                 assert int(restored.step) == 2, int(restored.step)
 
+# --- guard: in-graph detection zero-dispatch contract -------------------------
+
+@case("guard/no-extra-dispatch")
+def _():
+    """Two halves of the guard's observability contract: (1) the
+    in-graph detectors ride the existing step program — a guarded step
+    compiles to the same number of HLO modules as its unguarded twin
+    (one executable) with no host traffic (detection costs no extra
+    dispatches); (2) attaching the HOST side — an observe-only
+    GuardPolicy polling every step into a guard_sink — leaves the
+    guarded step's compiled HLO BIT-IDENTICAL: observation is pure
+    host-side reads, never ops."""
+    import io
+
+    from apex_tpu import guard, monitor
+    from apex_tpu.monitor.check import module_count_and_host_ops
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+    cfg = guard.GuardConfig(window=8, min_history=3)
+
+    def loss_fn(p):
+        return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+
+    def plain_step(p):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g), \
+            loss
+
+    def guarded_step(p, gs):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        gs = guard.guard_observe(gs, cfg, loss=loss, grads=g, params=p)
+        new_p = jax.tree_util.tree_map(
+            lambda a, b: a - 0.1 * gs.lr_scale * b, p, g)
+        return guard.guard_commit(gs, new_p, p, cfg), gs, loss
+
+    gs0 = guard.guard_init(cfg)
+    n_g, host_g = module_count_and_host_ops(jax.jit(guarded_step),
+                                            params, gs0)
+    n_p, _ = module_count_and_host_ops(jax.jit(plain_step), params)
+    assert n_g == n_p, (n_g, n_p)
+    assert not host_g, f"guarded step compiled host traffic: {host_g}"
+
+    # half 2: observe-only host policy + sink attached — bit-identical
+    jitted = jax.jit(guarded_step)
+    before = jitted.lower(params, gs0).compile().as_text()
+    logger = monitor.MetricsLogger(
+        sinks=[], guard_sink=monitor.JSONLSink(io.StringIO()))
+    policy = guard.GuardPolicy(observe_only=True,
+                               event_sink=logger.record_guard)
+    p, gs = params, gs0
+    for i in range(3):
+        p, gs, loss = jitted(p, gs)
+        act = policy.update(i, gs)
+        assert act.kind == "none", act
+    logger.close()
+    after = jitted.lower(params, gs0).compile().as_text()
+    assert after == before, \
+        "observe-only guard observation changed the compiled program"
+
+
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)
     regardless of cwd — the module lives next to the package root."""

@@ -126,7 +126,9 @@ def shrink_schedule(world: int, *, min_world: int = 1,
 
 
 def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
-                = None, escalation_exit_codes=(75,)):
+                = None, escalation_exit_codes=(75,),
+                restart_backoff_s: float = 0.0,
+                restart_backoff_cap_s: float = 60.0):
     """Restart-on-smaller-mesh: the single-controller recovery loop.
 
     ``train_fn(world, attempt)`` runs the training job on ``world``
@@ -145,8 +147,17 @@ def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
     when a rank exits with :data:`apex_tpu.ckpt.ESCALATION_EXIT_CODE`;
     this helper is that loop for single-controller (one-process,
     many-device) jobs and for tests.
+
+    ``restart_backoff_s`` > 0 sleeps a jittered exponential delay
+    (``backoff · 2^(attempt-1)``, capped at ``restart_backoff_cap_s``,
+    ×[0.5, 1.5) jitter) before each relaunch: a pod-wide preemption
+    makes every controller escalate in the same instant, and N jobs
+    re-attaching to the scheduler/checkpoint filesystem in lockstep is
+    a thundering herd the chaos runs exercise. Default 0 keeps tests
+    instant.
     """
     from apex_tpu.ckpt import PreemptionError
+    from apex_tpu.utils.backoff import backoff_sleep
     sizes = list(world_sizes)
     if not sizes:
         raise ValueError("world_sizes must name at least one mesh size")
@@ -174,6 +185,12 @@ def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
             raise RuntimeError(
                 f"elastic_run: escalated at the smallest mesh size "
                 f"{sizes[-1]} — no capacity left to shrink to")
+        # backoff only before an actual relaunch — sleeping ahead of
+        # the capacity check above would burn the whole delay right
+        # before a guaranteed-fatal raise
+        if restart_backoff_s > 0:
+            backoff_sleep(attempt - 1, base_s=restart_backoff_s,
+                          cap_s=restart_backoff_cap_s)
 
 
 def is_distributed() -> bool:

@@ -159,6 +159,11 @@ class FlightRecorder:
         # (prof.MemoryReport.summary()) — embedded in the crash header
         # so an OOM dump names the biggest buffers instead of just dying
         self.memory_report: Optional[Dict[str, Any]] = None
+        # bounded ring of recent guard interventions (note_guard) —
+        # embedded in the crash header: a post-mortem must show whether
+        # the run was already skipping/rewinding before it died
+        self._guard_events: "collections.deque[Dict]" = collections.deque(
+            maxlen=16)
         self._installed = False
         self._dumped = False
         self._abnormal_seen = False
@@ -231,6 +236,17 @@ class FlightRecorder:
         else:
             self.memory_report = report.summary()
         return self
+
+    def note_guard(self, event: Dict) -> None:
+        """Record one :mod:`apex_tpu.guard` event (anomaly / action /
+        rewind) for crash forensics — wire ``GuardPolicy(recorder=...)``.
+        Plain-dict copy into a bounded ring; the newest 16 land in the
+        crash header as ``guard_events``. No device access, never
+        raises."""
+        try:
+            self._guard_events.append(dict(event))
+        except Exception:
+            pass
 
     @property
     def last_completed_span(self) -> Optional[str]:
@@ -313,6 +329,8 @@ class FlightRecorder:
         }
         if self.memory_report is not None:
             hdr["memory_report"] = self.memory_report
+        if self._guard_events:
+            hdr["guard_events"] = list(self._guard_events)
         from apex_tpu.trace.debug_nans import first_nan
         hit = first_nan()
         if hit is not None:

@@ -1,3 +1,4 @@
+from apex_tpu.utils.backoff import backoff_sleep
 from apex_tpu.utils.tree import (
     tree_cast,
     tree_all_finite,
@@ -14,4 +15,5 @@ __all__ = [
     "tree_zeros_like",
     "tree_size",
     "global_norm",
+    "backoff_sleep",
 ]

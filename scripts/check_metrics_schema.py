@@ -44,6 +44,17 @@ per-severity breakdown; each ``lint_finding`` names its rule (stable
 id), severity in {error, warning, info}, message, fix-it hint, and
 evidence (op / scope / bytes).
 
+``--kind guard`` — the self-healing guard event channel
+(``MetricsLogger(guard_sink=...)``; keep in lockstep with
+``apex_tpu/guard/policy.py``): ``kind`` in {guard_anomaly,
+guard_action, guard_rewind}. A ``guard_anomaly`` names the anomaly
+classes the in-graph detectors flagged (with the robust z-score,
+nullable — a NaN-loss step has no finite z); a ``guard_action``
+records the ladder's decision (action in {skip, rewind, escalate,
+observe}); a ``guard_rewind`` records a restore-and-fast-forward
+(from_step/to_step, checkpoint root, how many batches the data
+cursor skipped, how many corrupt/nonfinite candidates were rejected).
+
 ``--kind ckpt`` — the checkpoint event channel
 (``MetricsLogger(ckpt_sink=...)``; keep in lockstep with
 ``apex_tpu/ckpt/manager.py`` and ``escalate.py``): ``kind`` in
@@ -60,7 +71,7 @@ jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
-           [--kind metrics|trace|memory|lint|ckpt] FILE
+           [--kind metrics|trace|memory|lint|ckpt|guard] FILE
 """
 
 from __future__ import annotations
@@ -158,6 +169,84 @@ CKPT_NULLABLE = {
     "ckpt_restore": (),
     "ckpt_escalation": ("path", "step", "exit_code"),
 }
+
+
+# --- guard channel schema -----------------------------------------------------
+
+GUARD_KINDS = ("guard_anomaly", "guard_action", "guard_rewind")
+GUARD_ACTIONS = ("skip", "rewind", "escalate", "observe")
+GUARD_CLASSES = ("loss_spike", "grad_explosion", "nonfinite_grad",
+                 "nonfinite_loss", "nonfinite_param")
+#: required keys per guard-event kind (beyond "kind" itself)
+GUARD_REQUIRED = {
+    "guard_anomaly": ("step", "classes"),
+    "guard_action": ("step", "action"),
+    "guard_rewind": ("step", "from_step", "to_step", "path",
+                     "skipped_batches"),
+}
+#: keys that may be null per kind (everything else non-null when present)
+GUARD_NULLABLE = {
+    "guard_anomaly": ("z",),
+    "guard_action": ("reason",),
+    "guard_rewind": ("reason",),
+}
+
+
+def check_guard_lines(lines) -> List[str]:
+    """All guard-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates anomaly reports, ladder decisions and
+    rewind records."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in GUARD_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{GUARD_KINDS}, got {kind!r}")
+            continue
+        for key in GUARD_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = GUARD_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        for key in ("step", "from_step", "to_step", "skipped_batches",
+                    "fallbacks", "consecutive", "skip_count"):
+            _check_counter(i, rec, key, errors, what="field")
+        classes = rec.get("classes")
+        if classes is not None:
+            if not isinstance(classes, list):
+                errors.append(f"line {i}: 'classes' must be a list")
+            else:
+                for c in classes:
+                    if c not in GUARD_CLASSES:
+                        errors.append(f"line {i}: classes entry {c!r} "
+                                      f"not in {GUARD_CLASSES}")
+        if kind == "guard_action":
+            act = rec.get("action")
+            if act is not None and act not in GUARD_ACTIONS:
+                errors.append(f"line {i}: 'action' must be one of "
+                              f"{GUARD_ACTIONS}, got {act!r}")
+        if kind == "guard_rewind":
+            p = rec.get("path")
+            if "path" in rec and not isinstance(p, str):
+                errors.append(f"line {i}: 'path' must be a string, "
+                              f"got {p!r}")
+            fs, ts = rec.get("from_step"), rec.get("to_step")
+            if (isinstance(fs, int) and isinstance(ts, int)
+                    and not isinstance(fs, bool)
+                    and not isinstance(ts, bool) and ts > fs):
+                errors.append(f"line {i}: rewind goes forwards "
+                              f"(to_step {ts} > from_step {fs})")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
 
 
 def check_ckpt_lines(lines) -> List[str]:
@@ -495,7 +584,7 @@ def check_lint_lines(lines) -> List[str]:
 
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
-            "ckpt": check_ckpt_lines}
+            "ckpt": check_ckpt_lines, "guard": check_guard_lines}
 
 
 def main(argv=None) -> int:

@@ -9,6 +9,11 @@
 #                                   # + the memory-budget audit (--cpu8)
 #                                   # + the ckpt save->kill->elastic-
 #                                   #   restore roundtrip (--cpu8)
+#                                   # + the guard chaos audit (--cpu8):
+#                                   #   clean-run zero interventions,
+#                                   #   NaN-spike rewind bitwise vs a
+#                                   #   fault-free oracle, skip-class
+#                                   #   convergence, guard schema
 #                                   # + apexlint on both flagship steps
 #                                   #   (asserts zero error findings)
 #
@@ -77,6 +82,16 @@ EOF
     # an uninterrupted 4-mesh run, (c) async capture stall bounded by
     # the full save, (d) the ckpt event stream passes --kind ckpt
     JAX_PLATFORMS=cpu python scripts/ckpt_roundtrip.py --cpu8
+
+    echo "== smoke: guard chaos audit (8-device CPU mesh)"
+    # asserts: (a) a fault-free guarded run triggers ZERO guard events
+    # and compiles bit-identical HLO under observation, (b) an injected
+    # param-NaN spike rewinds (rejecting the corrupted newer ckpt) and
+    # its post-rewind losses + final params bitwise-match an oracle
+    # that never saw the poison window, (c) grad-NaN/Inf + corrupt-
+    # batch faults are skipped in-graph and still converge, (d) the
+    # guard event stream passes --kind guard
+    JAX_PLATFORMS=cpu python scripts/chaos_audit.py --cpu8
 
     echo "== smoke: apexlint flagship steps (--fail-on error)"
     # lints the flagship ResNet-O2 and BERT-LAMB steps (CPU structural

@@ -156,6 +156,40 @@ def test_cursor_resume_is_exact(tree):
         np.testing.assert_array_equal(ya, yb)
 
 
+def test_skip_batches_fast_forward_is_exact(tree):
+    """The guard's poison-batch skip: a zero-decode skip_batches(n)
+    lands on the exact same stream as consuming n batches — including
+    from the post-epoch transient cursor (batch == batches_per_epoch,
+    captured right after an epoch's last yielded batch), where an
+    increment-then-wrap skip would swallow one batch and land a rewind
+    one short of the offending window's end."""
+    ref = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
+    stream = [(x.copy(), y.copy()) for x, y in ref.batches(7)]
+
+    # plain mid-epoch skip
+    src = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
+    next(src.batches(1))
+    src.skip_batches(3)
+    assert src.cursor_index() == 4
+    x, y = next(src.batches(1))
+    np.testing.assert_array_equal(x, stream[4][0])
+
+    # post-epoch transient: consume a FULL epoch via a live generator
+    # (cursor records batch == 3 == batches_per_epoch), then skip
+    src = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
+    it = src.epoch()
+    for _ in range(len(src)):
+        next(it)
+    cursor = src.state()
+    assert cursor["batch"] == len(src)       # the transient state
+    resumed = ImageFolderSource(tree, batch=4, size=32, workers=2,
+                                seed=5).load_state(cursor)
+    resumed.skip_batches(2)
+    assert resumed.cursor_index() == len(src) + 2
+    x, y = next(resumed.batches(1))
+    np.testing.assert_array_equal(x, stream[len(src) + 2][0])
+
+
 def test_cursor_mismatch_is_refused(tree):
     src = ImageFolderSource(tree, batch=4, size=32, workers=2, seed=5)
     cursor = src.state()

@@ -1,0 +1,733 @@
+"""apex_tpu.guard: in-graph detection, the policy ladder, chaos.
+
+The heavy end-to-end story (rewind bitwise vs a fault-free oracle on
+the real ImageFolder pipeline) lives in ``scripts/chaos_audit.py``
+(run by ``run_tier1.sh --smoke``); these tests pin the units: detector
+semantics with negative twins, ladder decisions/budgets/hysteresis,
+chaos-plan determinism, the guard event schema, and the
+``guard/no-extra-dispatch`` compile-check case. The multi-fault soak
+(SIGKILL + stalled-collective + random plan) is ``slow``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import ckpt, guard, monitor
+
+CFG = guard.GuardConfig(window=8, min_history=4, z_threshold=6.0,
+                        grad_factor=10.0, lr_growth_interval=3)
+
+
+def _observe_loop(losses, *, cfg=CFG, gs=None, gnorm=1.0, params=None):
+    """Eagerly observe a loss stream; returns the final GuardState."""
+    gs = guard.guard_init(cfg) if gs is None else gs
+    for L in losses:
+        gs = guard.guard_observe(
+            gs, cfg, loss=jnp.float32(L),
+            grad_norm=jnp.float32(gnorm),
+            params=params)
+    return gs
+
+
+class StubSource:
+    """Duck-typed cursor-bearing source for policy tests (the real
+    pipeline is exercised by scripts/chaos_audit.py)."""
+
+    def __init__(self, per_epoch=10):
+        self.per = per_epoch
+        self.e = self.b = 0
+
+    def __len__(self):
+        return self.per
+
+    def state(self):
+        return {"epoch": self.e, "batch": self.b}
+
+    def load_state(self, c):
+        self.e, self.b = int(c["epoch"]), int(c["batch"])
+
+    def cursor_index(self):
+        return self.e * self.per + self.b
+
+    def skip_batches(self, n):
+        for _ in range(int(n)):
+            self.b += 1
+            if self.b >= self.per:
+                self.e += 1
+                self.b = 0
+
+
+class TestDetect:
+    def test_clean_stream_stays_clean(self):
+        gs = _observe_loop([1.0 - 0.01 * i + 0.005 * (i % 3)
+                            for i in range(20)])
+        assert int(gs.anomaly) == 0
+        assert int(gs.skip_count) == 0
+        assert int(gs.count) == 20
+        assert float(gs.lr_scale) == 1.0
+
+    def test_spike_detected_window_not_polluted(self):
+        gs = _observe_loop([1.0, 0.99, 0.98, 0.97, 0.96])
+        count_before = int(gs.count)
+        gs = _observe_loop([50.0], gs=gs)
+        assert int(gs.anomaly) & guard.A_LOSS_SPIKE
+        assert int(gs.skip_count) == 1
+        assert float(gs.z) > 6.0
+        # the anomalous loss never entered the window
+        assert int(gs.count) == count_before
+        assert not np.any(np.asarray(gs.loss_window) == 50.0)
+
+    def test_below_threshold_is_not_a_spike(self):
+        # negative twin: a modest wobble within the z threshold
+        gs = _observe_loop([1.0, 0.99, 0.98, 0.97, 0.96, 1.05])
+        assert int(gs.anomaly) == 0
+        assert int(gs.skip_count) == 0
+
+    def test_unarmed_guard_never_fires(self):
+        # min_history=4: the same 50x jump at step 2 is not a spike
+        gs = _observe_loop([1.0, 50.0], cfg=CFG)
+        assert not (int(gs.anomaly) & guard.A_LOSS_SPIKE)
+
+    def test_grad_explosion_and_negative_twin(self):
+        gs = _observe_loop([1.0] * 6, gnorm=1.0)
+        g2 = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 grad_norm=jnp.float32(100.0))
+        assert int(g2.anomaly) & guard.A_GRAD_EXPLOSION
+        assert int(g2.skip_count) == int(gs.skip_count) + 1
+        g3 = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 grad_norm=jnp.float32(5.0))
+        assert int(g3.anomaly) == 0     # 5x < grad_factor=10
+
+    def test_nonfinite_grad_loss_and_commit_veto(self):
+        gs = _observe_loop([1.0] * 5)
+        old = {"w": jnp.ones((3,))}
+        new = {"w": jnp.zeros((3,))}
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(np.nan),
+                                 grads={"w": jnp.full((3,), np.nan)})
+        assert int(gs.anomaly) & guard.A_NONFINITE_LOSS
+        assert int(gs.anomaly) & guard.A_NONFINITE_GRAD
+        kept = guard.guard_commit(gs, new, old, CFG)
+        assert np.array_equal(np.asarray(kept["w"]), np.ones((3,)))
+
+    def test_nonfinite_param_flags_but_does_not_veto(self):
+        gs = _observe_loop([1.0] * 5)
+        bad = {"w": jnp.array([1.0, np.nan])}
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 grad_norm=jnp.float32(1.0),
+                                 params=bad)
+        assert int(gs.anomaly) == guard.A_NONFINITE_PARAM
+        assert bool(np.asarray(guard.guard_ok(gs, CFG)))
+        # rewind class does not skip: commit still selects the update
+        new = {"w": jnp.zeros((2,))}
+        got = guard.guard_commit(gs, new, bad, CFG)
+        assert np.array_equal(np.asarray(got["w"]), np.zeros((2,)))
+
+    def test_lr_backoff_and_recovery_schedule(self):
+        gs = _observe_loop([1.0] * 6)
+        gs = _observe_loop([50.0], gs=gs)            # spike -> 0.5
+        assert float(gs.lr_scale) == 0.5
+        gs = _observe_loop([50.0], gs=gs)            # again -> 0.25
+        assert float(gs.lr_scale) == 0.25
+        # lr_growth_interval=3 clean steps per x2 notch, capped at 1.0
+        gs = _observe_loop([1.0] * 3, gs=gs)
+        assert float(gs.lr_scale) == 0.5
+        gs = _observe_loop([1.0] * 3, gs=gs)
+        assert float(gs.lr_scale) == 1.0
+        gs = _observe_loop([1.0] * 3, gs=gs)
+        assert float(gs.lr_scale) == 1.0             # never above 1
+
+    def test_lr_does_not_recover_across_skipped_steps(self):
+        # a NaN storm after a backoff: nonfinite-grad steps are skipped
+        # (nothing commits), so the recovery tracker must HOLD — only
+        # clean steps buy the lr_scale back
+        gs = _observe_loop([1.0] * 6)
+        gs = _observe_loop([50.0], gs=gs)            # spike -> 0.5
+        for _ in range(2 * CFG.lr_growth_interval):
+            gs = guard.guard_observe(
+                gs, CFG, loss=jnp.float32(1.0),
+                grads={"w": jnp.full((2,), np.nan)})
+        assert float(gs.lr_scale) == 0.5             # storm bought nothing
+        gs = _observe_loop([1.0] * CFG.lr_growth_interval, gs=gs)
+        assert float(gs.lr_scale) == 1.0             # clean steps do
+
+    def test_nonfinite_grad_does_not_back_off_lr(self):
+        # amp owns the overflow response (loss scale); the guard must
+        # not double-penalize routine fp16 overflows with an LR cut
+        gs = _observe_loop([1.0] * 6)
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 grads={"w": jnp.full((2,), np.inf)})
+        assert int(gs.anomaly) & guard.A_NONFINITE_GRAD
+        assert float(gs.lr_scale) == 1.0
+
+    def test_skip_on_spike_off_observes_only(self):
+        cfg = CFG._replace(skip_on_spike=False)
+        gs = _observe_loop([1.0] * 6, cfg=cfg)
+        gs = _observe_loop([50.0], gs=gs, cfg=cfg)
+        assert int(gs.anomaly) & guard.A_LOSS_SPIKE
+        assert int(gs.skip_count) == 0
+        assert bool(np.asarray(guard.guard_ok(gs, cfg)))
+
+
+class TestPolicy:
+    def _trained_gs(self, n=6):
+        return _observe_loop([1.0 - 0.01 * i for i in range(n)])
+
+    def test_clean_run_emits_nothing(self):
+        events = []
+        pol = guard.GuardPolicy(event_sink=events.append)
+        gs = guard.guard_init(CFG)
+        for i in range(5):
+            gs = guard.guard_observe(gs, CFG,
+                                     loss=jnp.float32(1.0 - 0.01 * i))
+            assert pol.update(i, gs).kind == "none"
+        assert events == []
+
+    def test_skip_event_then_budget_rewind(self):
+        events = []
+        pol = guard.GuardPolicy(event_sink=events.append,
+                                skip_budget=2, skip_window=32)
+        gs = self._trained_gs()
+        kinds = []
+        for i in range(4):
+            gs = _observe_loop([80.0], gs=gs)
+            kinds.append(pol.update(10 + i, gs).kind)
+        assert kinds[:2] == ["skip", "skip"]
+        assert "rewind" in kinds[2:]
+        acts = [e["action"] for e in events
+                if e["kind"] == "guard_action"]
+        assert "skip" in acts and "rewind" in acts
+        anom = [e for e in events if e["kind"] == "guard_anomaly"][0]
+        assert anom["classes"] == ["loss_spike"]
+
+    def test_rewind_restores_and_fast_forwards(self, tmp_path):
+        events = []
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        pol = guard.GuardPolicy(manager=mgr, event_sink=events.append)
+        src = StubSource()
+        gs = self._trained_gs()
+        state = {"w": jnp.arange(4.0)}
+        src.skip_batches(3)
+        mgr.save(2, {"s": state, "gs": gs},
+                 extra={"cursor": src.state()})
+        mgr.wait()
+        src.skip_batches(2)                      # batches 3, 4 consumed
+        bad = {"w": state["w"].at[0].set(jnp.nan)}
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(np.nan),
+                                 params=bad)
+        act = pol.update(4, gs)
+        assert act.kind == "rewind"
+        assert "nonfinite_param" in act.classes
+        restored, mf = pol.rewind(4, {"s": bad, "gs": gs}, src,
+                                  reason=act.reason)
+        assert mf["step"] == 2
+        assert src.cursor_index() == 5           # past the window
+        assert np.array_equal(np.asarray(restored["s"]["w"]),
+                              np.arange(4.0))
+        rw = [e for e in events if e["kind"] == "guard_rewind"][0]
+        assert rw["skipped_batches"] == 2 and rw["fallbacks"] == 0
+        # restored guard state carries its history (windows/counters)
+        assert int(restored["gs"].count) == int(self._trained_gs().count)
+
+    def test_rewind_falls_back_past_bad_checkpoints(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=5)
+        pol = guard.GuardPolicy(manager=mgr)
+        src = StubSource()
+        gs = self._trained_gs()
+        good = {"s": {"w": jnp.ones((4,))}, "gs": gs}
+        mgr.save(1, good, extra={"cursor": {"epoch": 0, "batch": 1}})
+        mgr.wait()
+        # newer ckpt with NONFINITE params -> rejected by verification
+        mgr.save(3, {"s": {"w": jnp.full((4,), np.nan)}, "gs": gs},
+                 extra={"cursor": {"epoch": 0, "batch": 3}})
+        mgr.wait()
+        # newest ckpt TRUNCATED -> rejected by the manifest hash
+        mgr.save(5, good, extra={"cursor": {"epoch": 0, "batch": 5}})
+        mgr.wait()
+        assert guard.ChaosHarness.truncate_latest_checkpoint(mgr.root)
+        src.skip_batches(7)
+        restored, mf = pol.rewind(7, good, src)
+        assert mf["step"] == 1
+        assert src.cursor_index() == 7           # 1 -> +6 skipped
+
+    def test_budget_exhausted_escalates_via_policy(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        esc = ckpt.EscalationPolicy(mgr, mode="raise")
+        pol = guard.GuardPolicy(manager=mgr, escalation=esc,
+                                rewind_budget=0)
+        gs = self._trained_gs()
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 params={"w": jnp.float32(np.nan)})
+        act = pol.update(3, gs)
+        assert act.kind == "escalate"
+        with pytest.raises(ckpt.PreemptionError):
+            pol.escalate(act.reason)
+        assert esc.tripped.startswith("guard:")
+
+    def test_escalate_without_policy_raises_guard_escalation(self):
+        pol = guard.GuardPolicy()
+        with pytest.raises(guard.GuardEscalation):
+            pol.escalate("no ladder below me")
+
+    def test_observe_only_never_asks_for_action(self):
+        events = []
+        pol = guard.GuardPolicy(event_sink=events.append,
+                                observe_only=True, skip_budget=0)
+        gs = self._trained_gs()
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(np.nan),
+                                 params={"w": jnp.float32(np.nan)})
+        act = pol.update(7, gs)
+        assert act.kind == "none"
+        acts = {e["action"] for e in events
+                if e["kind"] == "guard_action"}
+        assert acts == {"observe"}
+
+    def test_cooldown_suppresses_budget_rewind(self):
+        pol = guard.GuardPolicy(skip_budget=0, cooldown_steps=50)
+        pol.cooldown_until = 100                 # as if just rewound
+        gs = self._trained_gs()
+        gs = _observe_loop([80.0], gs=gs)
+        assert pol.update(10, gs).kind == "skip"
+        # rewind-class corruption is exempt from the cooldown
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 params={"w": jnp.float32(np.nan)})
+        assert pol.update(11, gs).kind == "rewind"
+
+    def test_cooldown_skips_do_not_bank_toward_the_budget(self):
+        # skips observed DURING the cooldown must not accumulate and
+        # fire a chain-rewind the moment the cooldown expires
+        pol = guard.GuardPolicy(skip_budget=3, skip_window=100,
+                                cooldown_steps=10)
+        pol.cooldown_until = 15
+        gs = self._trained_gs()
+        for i in range(5, 10):                   # 5 skips in cooldown
+            gs = _observe_loop([80.0], gs=gs)
+            assert pol.update(i, gs).kind == "skip"
+        assert pol._skip_steps == []             # nothing banked
+        gs = _observe_loop([80.0], gs=gs)        # 1 skip after expiry
+        assert pol.update(20, gs).kind == "skip"  # 1 <= budget 3
+
+    def test_coarse_poll_counts_every_skip_toward_budget(self):
+        # poll_every=4 with a skip on every step: each poll's counter
+        # delta is 4, and all 4 must count toward the budget — one
+        # entry per poll would defer the rewind indefinitely
+        pol = guard.GuardPolicy(skip_budget=4, skip_window=32,
+                                poll_every=4)
+        gs = self._trained_gs()
+        pol.update(0, gs)                            # baseline
+        kinds = []
+        for i in range(1, 9):
+            gs = _observe_loop([80.0], gs=gs)
+            kinds.append(pol.update(i, gs).kind)
+        assert "rewind" in kinds, kinds              # 8 skips > budget 4
+
+    def test_rewind_resyncs_counter_baseline(self, tmp_path):
+        """The restored GuardState's cumulative counters sit BELOW the
+        policy's cached high-water mark; without a resync a post-rewind
+        anomaly whose counter has not yet re-crossed the stale baseline
+        would difference to <= 0 and be silently missed."""
+        events = []
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        pol = guard.GuardPolicy(manager=mgr, event_sink=events.append,
+                                cooldown_steps=0)
+        src = StubSource()
+        gs = self._trained_gs()
+        gs = _observe_loop([90.0, 90.0], gs=gs)  # skip_count -> 2
+        pol.update(5, gs)
+        src.skip_batches(6)
+        mgr.save(5, {"s": {"w": jnp.ones(3)}, "gs": gs},
+                 extra={"cursor": src.state()})
+        mgr.wait()
+        gs = _observe_loop([90.0, 90.0], gs=gs)  # baseline -> 4
+        pol.update(7, gs)
+        gs = guard.guard_observe(gs, CFG, loss=jnp.float32(1.0),
+                                 params={"w": jnp.float32(np.nan)})
+        src.skip_batches(3)
+        act = pol.update(8, gs)
+        assert act.kind == "rewind"
+        restored, _mf = pol.rewind(8, {"s": {"w": jnp.ones(3)},
+                                       "gs": gs}, src)
+        gs = restored["gs"]                      # counters back at 2
+        before = sum(1 for e in events
+                     if e["kind"] == "guard_anomaly")
+        gs = _observe_loop([90.0], gs=gs)        # counter 2 -> 3 (< 5)
+        pol.update(9, gs)
+        after = sum(1 for e in events if e["kind"] == "guard_anomaly")
+        assert after == before + 1, "post-rewind anomaly missed"
+
+    def test_coarse_poll_recovers_missed_events(self):
+        events = []
+        pol = guard.GuardPolicy(event_sink=events.append, poll_every=4)
+        gs = self._trained_gs()
+        assert pol.update(0, gs).kind == "none"  # baseline poll
+        gs = _observe_loop([80.0], gs=gs)        # anomaly at step 1 …
+        assert pol.update(1, gs).kind == "none"  # … inside the cadence
+        assert events == []                      # not fetched yet
+        gs = _observe_loop([1.0], gs=gs)
+        pol.update(4, gs)                        # 4 - 0 >= 4: polls
+        anom = [e for e in events if e["kind"] == "guard_anomaly"]
+        assert len(anom) == 1                    # counter delta saw it
+        assert anom[0]["classes"] == ["loss_spike"]
+
+
+class TestChaos:
+    def test_plan_determinism_and_json_roundtrip(self):
+        rates = {"grads:nan": 0.1, "batch:corrupt": 0.08,
+                 "params:bitflip": 0.02}
+        p1 = guard.FaultPlan.random(11, 200, rates=rates, ranks=2)
+        p2 = guard.FaultPlan.random(11, 200, rates=rates, ranks=2)
+        assert p1 == p2 and len(p1) > 0
+        assert guard.FaultPlan.from_json(p1.to_json()) == p1
+        p3 = guard.FaultPlan.random(12, 200, rates=rates, ranks=2)
+        assert p3 != p1
+
+    def test_plan_rejects_unknown_site_kind_and_dupes(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            guard.FaultPlan().add(0, "gpu", "nan")
+        with pytest.raises(ValueError, match="supports kinds"):
+            guard.FaultPlan().add(0, "params", "corrupt")
+        with pytest.raises(ValueError, match="duplicate"):
+            guard.FaultPlan().add(0, "grads", "nan").add(
+                0, "grads", "inf")
+        # random() must validate too — a typo'd rate key would make a
+        # chaos soak pass vacuously against a fault-free run
+        with pytest.raises(ValueError, match="unknown fault rate key"):
+            guard.FaultPlan.random(0, 10, rates={"gards:nan": 0.5})
+        with pytest.raises(ValueError, match="unknown fault rate key"):
+            guard.FaultPlan.random(0, 10, rates={"batch:bitflip": 0.5})
+        # two kinds on one site would silently lose collisions (plans
+        # are keyed by (step, rank, site)) — refused up front
+        with pytest.raises(ValueError, match="share the site"):
+            guard.FaultPlan.random(0, 10, rates={"grads:nan": 0.5,
+                                                 "grads:inf": 0.5})
+
+    def test_fault_code_and_injection(self):
+        plan = (guard.FaultPlan()
+                .add(2, "grads", "nan")
+                .add(3, "grads", "inf")
+                .add(4, "activations", "nan"))
+        assert plan.fault_code(0) == 0
+        assert plan.fault_code(2) == guard.chaos.C_GRAD_NAN
+        assert plan.fault_code(3) == guard.chaos.C_GRAD_INF
+        assert plan.fault_code(4) == guard.chaos.C_ACT_NAN
+        g = {"w": jnp.ones((4,)), "n": jnp.ones((2,), jnp.int32)}
+        out = guard.inject_grads(g, jnp.int32(plan.fault_code(2)))
+        assert np.isnan(np.asarray(out["w"])[0])
+        assert np.asarray(out["n"]).sum() == 2   # ints untouched
+        out = guard.inject_grads(g, jnp.int32(plan.fault_code(3)))
+        assert np.isinf(np.asarray(out["w"])[0])
+        act = guard.inject_activation(jnp.ones((2, 2)),
+                                      jnp.int32(plan.fault_code(4)))
+        assert np.isnan(np.asarray(act).reshape(-1)[0])
+        clean = guard.inject_grads(g, jnp.int32(0))
+        assert np.isfinite(np.asarray(clean["w"])).all()
+
+    def test_filter_batch_kinds_are_deterministic(self):
+        x = np.zeros((4, 3), np.float32)
+        y = np.arange(4, dtype=np.int32)
+        h = guard.ChaosHarness(
+            guard.FaultPlan(seed=5)
+            .add(0, "batch", "nan").add(1, "batch", "corrupt", arg=10.0)
+            .add(2, "batch", "overflow", arg=1e30))
+        xn, _ = h.filter_batch(0, (x, y))
+        assert np.isnan(xn.reshape(-1)[0]) and not np.isnan(x).any()
+        xc1, _ = h.filter_batch(1, (x, y))
+        xc2, _ = h.filter_batch(1, (x, y))
+        assert np.array_equal(xc1, xc2)          # seeded, replayable
+        assert np.abs(xc1).max() <= 10.0
+        xo, _ = h.filter_batch(2, (x + 1.0, y))
+        assert xo.max() >= 1e29                  # the overflow storm
+        assert h.filter_batch(3, (x, y))[0] is x  # no fault: untouched
+
+    def test_param_corruption_nan_and_bitflip(self):
+        state = {"a": jnp.ones((3,)), "b": jnp.ones((2,))}
+        h = guard.ChaosHarness(guard.FaultPlan()
+                               .add(0, "params", "nan")
+                               .add(1, "params", "bitflip", arg=30))
+        s1 = h.post_step(0, state)
+        leaves = jax.tree_util.tree_leaves(s1)
+        assert any(np.isnan(np.asarray(l)).any() for l in leaves)
+        s2 = h.post_step(1, state)
+        first = np.asarray(jax.tree_util.tree_leaves(s2)[0])
+        assert not np.isnan(first).any()
+        assert np.abs(first.reshape(-1)[0]) > 1e18   # exponent bit 30
+        assert h.injected == [(0, "params", "nan"),
+                              (1, "params", "bitflip")]
+
+    def test_truncate_latest_checkpoint_breaks_hash(self, tmp_path):
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"))
+        mgr.save(1, {"w": np.arange(4000, dtype=np.float32)},
+                 block=True)
+        mgr.wait()
+        path = guard.ChaosHarness.truncate_latest_checkpoint(mgr.root)
+        assert path and path.endswith(".npz")
+        with pytest.raises(ckpt.CheckpointError, match="hash mismatch"):
+            mgr.restore({"w": jnp.zeros((4000,), jnp.float32)})
+
+
+class TestAmpGuard:
+    def test_amp_step_guard_generalizes_overflow_skip(self):
+        from apex_tpu import amp
+        from apex_tpu.optim import FusedSGD
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 16).astype("float32"))
+        y = jnp.asarray(rng.randn(8, 4).astype("float32"))
+        params = {"w": jnp.asarray(
+            rng.randn(16, 4).astype("float32") * 0.1)}
+        amp_opt, state = amp.initialize(
+            params, FusedSGD(lr=0.05), "O2", half_dtype=jnp.float16,
+            verbosity=0)
+        cfg = CFG
+
+        @jax.jit
+        def step(state, gs, x, y):
+            def loss_fn(p):
+                return jnp.mean(jnp.square(x @ p["w"] - y))
+            state, loss, committed, gs = amp_opt.step(
+                state, loss_fn, guard=(gs, cfg))
+            return state, gs, loss
+
+        gs = guard.guard_init(cfg)
+        for i in range(6):
+            state, gs, loss = step(state, gs, x, y)
+        assert int(state.step) == 6 and int(gs.skip_count) == 0
+        # poisoned input -> nonfinite loss/grads -> guarded skip: the
+        # amp step count and params both hold still
+        xp = np.asarray(x).copy()
+        xp.reshape(-1)[0] = np.inf
+        w_before = np.asarray(state.params["w"]).copy()
+        state, gs, _loss = step(state, gs, jnp.asarray(xp), y)
+        assert int(state.step) == 6
+        assert int(gs.skip_count) == 1
+        assert np.array_equal(np.asarray(state.params["w"]), w_before)
+
+    def test_amp_guard_applies_lr_backoff_as_grad_scaling(self):
+        # the backoff rung must actually shrink the committed update:
+        # with lr_scale preset to 0.5 the SGD param delta halves
+        from apex_tpu import amp
+        from apex_tpu.optim import FusedSGD
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 16).astype("float32"))
+        y = jnp.asarray(rng.randn(8, 4).astype("float32"))
+        params = {"w": jnp.asarray(
+            rng.randn(16, 4).astype("float32") * 0.1)}
+        amp_opt, state0 = amp.initialize(
+            params, FusedSGD(lr=0.05), "O2", half_dtype=jnp.float16,
+            verbosity=0)
+
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] - y))
+
+        def delta(lr_scale):
+            gs = guard.guard_init(CFG)._replace(
+                lr_scale=jnp.float32(lr_scale))
+            state, _loss, _ok, _gs = jax.jit(
+                lambda s, g: amp_opt.step(s, loss_fn, guard=(g, CFG))
+            )(state0, gs)
+            return (np.asarray(state.params["w"])
+                    - np.asarray(state0.params["w"]))
+        np.testing.assert_allclose(delta(0.5), 0.5 * delta(1.0),
+                                   rtol=1e-5)
+
+
+class TestSchema:
+    def _check(self, lines):
+        from scripts.check_metrics_schema import check_guard_lines
+        return check_guard_lines(lines)
+
+    def test_valid_stream_passes(self):
+        lines = [
+            json.dumps({"kind": "guard_anomaly", "step": 3,
+                        "classes": ["loss_spike"], "z": 9.5,
+                        "rank": 0, "wall_time": 1.0}),
+            json.dumps({"kind": "guard_anomaly", "step": 4,
+                        "classes": ["nonfinite_loss"], "z": None,
+                        "rank": 0}),
+            json.dumps({"kind": "guard_action", "step": 4,
+                        "action": "rewind", "classes": ["nonfinite_param"],
+                        "reason": "nonfinite_param", "rank": 0}),
+            json.dumps({"kind": "guard_rewind", "step": 4,
+                        "from_step": 4, "to_step": 2, "path": "/ck",
+                        "skipped_batches": 2, "fallbacks": 1,
+                        "reason": None, "rank": 0}),
+        ]
+        assert self._check(lines) == []
+
+    def test_negative_twins_fail(self):
+        bad = [
+            # unknown kind
+            json.dumps({"kind": "guard_oops", "step": 1}),
+            # unknown action enum value
+            json.dumps({"kind": "guard_action", "step": 1,
+                        "action": "reboot"}),
+            # unknown anomaly class
+            json.dumps({"kind": "guard_anomaly", "step": 1,
+                        "classes": ["gremlins"]}),
+            # null path on a rewind
+            json.dumps({"kind": "guard_rewind", "step": 1,
+                        "from_step": 1, "to_step": 0, "path": None,
+                        "skipped_batches": 1}),
+            # rewind that goes forwards
+            json.dumps({"kind": "guard_rewind", "step": 1,
+                        "from_step": 1, "to_step": 5, "path": "/ck",
+                        "skipped_batches": 1}),
+            # negative skip count
+            json.dumps({"kind": "guard_rewind", "step": 1,
+                        "from_step": 1, "to_step": 0, "path": "/ck",
+                        "skipped_batches": -2}),
+            # missing required key (classes)
+            json.dumps({"kind": "guard_anomaly", "step": 1}),
+        ]
+        for line in bad:
+            assert self._check([line]), f"accepted bad line: {line}"
+
+    def test_logger_guard_channel_nulls_nonfinite(self, tmp_path):
+        out = tmp_path / "g.jsonl"
+        logger = monitor.MetricsLogger(
+            sinks=[], guard_sink=monitor.JSONLSink(str(out)))
+        logger.record_guard({"kind": "guard_anomaly", "step": 1,
+                             "classes": ["nonfinite_loss"],
+                             "z": float("nan"), "rank": 0})
+        logger.close()
+        rec = json.loads(out.read_text().strip())
+        assert rec["z"] is None
+        assert self._check([out.read_text().strip()]) == []
+
+
+class TestCompileCheck:
+    def test_guard_case_runs_green(self):
+        from apex_tpu.ops import compile_check as cc
+        assert cc.run(pattern="guard")
+
+
+class TestRecorderIntegration:
+    def test_crash_header_carries_guard_events(self, tmp_path):
+        from apex_tpu import trace
+        rec = trace.FlightRecorder(str(tmp_path / "crash.jsonl"))
+        pol = guard.GuardPolicy(recorder=rec, observe_only=True)
+        gs = _observe_loop([1.0] * 6)
+        gs = _observe_loop([80.0], gs=gs)
+        pol.update(6, gs)
+        hdr = rec.header("test")
+        assert "guard_events" in hdr
+        kinds = {e["kind"] for e in hdr["guard_events"]}
+        assert "guard_anomaly" in kinds
+        json.dumps(hdr)          # strict-JSON serializable (z nulled)
+
+
+@pytest.mark.slow
+class TestSoak:
+    def test_sigkill_fault_kills_the_process(self, tmp_path):
+        child = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "from apex_tpu import guard\n"
+            "h = guard.ChaosHarness(guard.FaultPlan()"
+            ".add(2, 'proc', 'sigkill'))\n"
+            "for step in range(5):\n"
+            "    h.post_step(step, {'w': 1.0})\n"
+            "print('UNREACHABLE')\n"
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", child],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))))
+        assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr)
+        assert "UNREACHABLE" not in p.stdout
+
+    def test_stalled_collective_trips_watchdog(self, tmp_path):
+        from apex_tpu import trace
+        fired = []
+        wd = trace.HangWatchdog(deadline_s=0.6, poll_s=0.1,
+                                path=str(tmp_path / "hang.jsonl"),
+                                on_fire=fired.append)
+        wd.start()
+        try:
+            h = guard.ChaosHarness(
+                guard.FaultPlan().add(1, "collective", "stall",
+                                      arg=2.0))
+            for step in range(3):
+                wd.notify_step(step)
+                h.post_step(step, {})
+        finally:
+            wd.stop()
+        assert fired, "stalled-collective fault did not trip the " \
+                      "watchdog"
+
+    def test_multi_fault_random_soak_recovers_or_skips(self, tmp_path):
+        """A longer randomized plan: every in-graph/batch/params fault
+        class mixed, the guard must end the run with finite params,
+        every injected fault accounted as a skip or a rewind, and the
+        event stream schema-clean."""
+        from scripts.check_metrics_schema import check_guard_lines
+
+        cfg = guard.GuardConfig(window=16, min_history=4,
+                                z_threshold=8.0, lr_growth_interval=4)
+        plan = (guard.FaultPlan(seed=9)
+                .add(6, "grads", "nan")
+                .add(11, "batch", "corrupt", arg=50.0)
+                .add(15, "params", "nan")
+                .add(22, "grads", "inf")
+                .add(27, "batch", "nan"))
+        events = []
+        mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=4)
+        pol = guard.GuardPolicy(manager=mgr, event_sink=events.append,
+                                rewind_budget=3, cooldown_steps=4)
+        src = StubSource(per_epoch=8)
+        harness = guard.ChaosHarness(plan)
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(16, 2).astype("float32")
+
+        @jax.jit
+        def step(params, gs, x, y, code):
+            def loss_fn(p):
+                return jnp.mean(jnp.square(x @ p["w"] - y))
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            grads = guard.inject_grads(grads, code)
+            gs = guard.guard_observe(gs, cfg, loss=loss, grads=grads,
+                                     params=params)
+            new_p = jax.tree_util.tree_map(
+                lambda p, g: p - 0.02 * gs.lr_scale * g, params, grads)
+            return guard.guard_commit(gs, new_p, params, cfg), gs, loss
+
+        params = {"w": jnp.asarray(
+            rng.randn(16, 2).astype("float32") * 0.1)}
+        gs = guard.guard_init(cfg)
+        n_rewinds = 0
+        for i in range(32):
+            xb = rng.randn(8, 16).astype("float32")
+            yb = xb @ w_true
+            xb, yb = harness.filter_batch(i, (xb, yb))
+            code = harness.fault_code(i)
+            params, gs, loss = step(params, gs, jnp.asarray(xb),
+                                    jnp.asarray(yb), jnp.int32(code))
+            if i % 3 == 0:
+                mgr.save(i, {"p": params, "gs": gs},
+                         extra={"cursor": src.state()})
+                mgr.wait()
+            params = harness.post_step(i, params)
+            src.skip_batches(1)
+            act = pol.update(i, gs)
+            if act.kind == "rewind":
+                restored, _mf = pol.rewind(i, {"p": params, "gs": gs},
+                                           src, reason=act.reason)
+                params, gs = restored["p"], restored["gs"]
+                n_rewinds += 1
+        assert n_rewinds >= 1                    # the params:nan fault
+        assert pol.rewinds_done == n_rewinds
+        for leaf in jax.tree_util.tree_leaves(params):
+            assert np.isfinite(np.asarray(leaf)).all()
+        # every fault either skipped in-graph or triggered the rewind
+        assert int(gs.skip_count) >= 3
+        errors = check_guard_lines(json.dumps(e) for e in events)
+        assert not errors, errors
