@@ -3,7 +3,7 @@
 The reference apex kept mixed-precision training correct *by
 construction* (cast lists, opt-level validation at initialize time);
 apexlint closes the remaining gap by auditing what was actually traced
-and compiled. Two passes, both strictly AOT (trace + compile only —
+and compiled. Three passes, all strictly AOT (trace + compile only —
 never a device dispatch; the ``lint/no-extra-dispatch`` compile-check
 case pins that an observed step stays bit-identical):
 
@@ -16,7 +16,16 @@ case pins that an observed step stays bit-identical):
   and the :mod:`apex_tpu.monitor` collective accounting): donation
   misses with wasted-HBM estimates, collectives outside any known
   named scope (implicit resharding) with wire-byte cost, host
-  transfers, and off-tile-grid matmul padding waste.
+  transfers, and off-tile-grid matmul padding waste;
+- the **SPMD pass** (:mod:`apex_tpu.lint.spmd_pass`) audits the
+  *cross-rank* properties no per-program rule sees: collective
+  schedule congruence across ranks (mismatched replica groups /
+  channel ids deadlock a pod — APX201), sharding propagation's
+  implicit full all-gathers (APX202), flat reductions crossing a DCN
+  boundary that wanted a hierarchical schedule (APX203, judged against
+  a declarative :mod:`mesh model <apex_tpu.lint.mesh_model>`), and
+  nondeterministic draws that break guard's bitwise-rewind oracle
+  (APX204 — this one needs no mesh and runs in every ``lint_step``).
 
 Typical use — lint the step exactly as you run it (pass your jitted
 function so its ``donate_argnums`` are what gets audited)::
@@ -27,12 +36,20 @@ function so its ``donate_argnums`` are what gets audited)::
     print(report.table())
     assert not report.errors
 
+Pod-scale pre-flight — add a mesh model and the cross-rank rules run
+over the same compile::
+
+    mm = lint.parse_mesh_spec("dp2x4")       # 2 slices (DCN) x 4 (ICI)
+    report = lint.lint_step(jstep, *args, mesh_model=mm)
+
 CLI: ``python scripts/apexlint.py --flagship both`` (the
-``run_tier1.sh --smoke`` CI gate), or ``--hlo dump.txt`` for a
-pre-dumped module. Findings stream to JSONL via
+``run_tier1.sh --smoke`` CI gate; add ``--mesh dp2x4`` for the
+cross-rank congruence audit), or ``--hlo dump.txt`` for a pre-dumped
+module. Findings stream to JSONL via
 ``MetricsLogger(lint_sink=...)`` and validate with
 ``scripts/check_metrics_schema.py --kind lint``. Rule catalog,
-severities and the baseline-file workflow: docs/linting.md.
+severities, the mesh-model schema and the baseline-file workflow:
+docs/linting.md.
 """
 
 from __future__ import annotations
@@ -44,40 +61,76 @@ from apex_tpu.lint.findings import (Finding, Report, Rule, RULES,
                                     save_baseline)
 from apex_tpu.lint.hlo_pass import lint_hlo_text
 from apex_tpu.lint.jaxpr_pass import lint_jaxpr
+from apex_tpu.lint.mesh_model import (MeshAxis, MeshModel,
+                                      parse_mesh_spec)
+from apex_tpu.lint.spmd_pass import (congruence_findings,
+                                     extract_collective_schedule,
+                                     lint_spmd_text,
+                                     nondeterminism_jaxpr_findings)
 
 __all__ = ["Finding", "Report", "Rule", "RULES", "SEVERITIES",
            "lint_step", "lint_jaxpr", "lint_hlo_text", "lint_hlo_file",
-           "load_baseline", "save_baseline"]
+           "load_baseline", "save_baseline",
+           "MeshAxis", "MeshModel", "parse_mesh_spec",
+           "lint_spmd_text", "congruence_findings",
+           "extract_collective_schedule",
+           "nondeterminism_jaxpr_findings"]
+
+#: jaxpr-pass rule slugs (trace-only); nondeterminism's jaxpr-side
+#: detectors ride the same single trace
+_JAXPR_RULES = frozenset({"rng-key-reuse", "f64-creep",
+                          "fp32-matmul-in-amp", "host-callback-in-step",
+                          "nondeterminism"})
+_HLO_RULES = frozenset({"donation-miss", "implicit-resharding",
+                        "host-transfer", "tile-padding"})
+_SPMD_HLO_RULES = frozenset({"spmd-divergence", "implicit-full-gather",
+                             "dcn-flat-collective"})
 
 
 def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
               known_scopes: Sequence[str] = (),
               min_donation_bytes: int = 4096,
               rules: Optional[Sequence[str]] = None,
+              mesh_model: Optional[MeshModel] = None,
+              per_rank_hlo=None,
               fn_name: Optional[str] = None, **kwargs) -> Report:
-    """Lint one training step with both passes. Strictly AOT.
+    """Lint one training step with all passes. Strictly AOT.
 
     ``fn`` may be a plain callable or a jitted function — pass the
     jitted one so the HLO pass sees your real ``donate_argnums``
     (donation is part of what is being audited). The jaxpr pass traces
-    ``fn`` with ``jax.make_jaxpr``; the HLO pass compiles it (or reuses
+    ``fn`` with ``jax.make_jaxpr`` (once — the APX204 nondeterminism
+    detectors read the same trace); the HLO pass compiles it (or reuses
     ``compiled=`` / ``hlo_text=`` when the caller already has the
     executable, avoiding a second compile). ``policy`` activates the
     fp32-matmul-in-amp rule; ``known_scopes`` extends the
     implicit-resharding allowlist (regex fragments).
+
+    ``mesh_model`` (a :class:`MeshModel`, e.g.
+    ``parse_mesh_spec("dp2x4")``) activates the cross-rank SPMD rules
+    over the compiled module: congruence/deadlock (APX201), implicit
+    full gathers (APX202 — subsumes APX102's generic warning for
+    all-gather ops, which is dropped to avoid double reports), and
+    DCN-crossing flat collectives (APX203). ``per_rank_hlo`` (a
+    ``{rank: hlo_text}`` dict) feeds per-rank-compiled programs to the
+    congruence walk instead of the single SPMD module.
     """
-    jaxpr_rules = {"rng-key-reuse", "f64-creep", "fp32-matmul-in-amp",
-                   "host-callback-in-step"}
+    import jax
+
     findings = []
-    if fn is not None and (rules is None
-                           or jaxpr_rules & set(rules)):
+    jaxpr = None
+    if fn is not None and (rules is None or _JAXPR_RULES & set(rules)):
         # skip the (potentially expensive) trace entirely when the
         # caller selected HLO-pass rules only — with compiled= that
         # makes lint_step compile-free AND trace-free
-        findings += lint_jaxpr(fn, *args, policy=policy, **kwargs)
-    hlo_rules = {"donation-miss", "implicit-resharding",
-                 "host-transfer", "tile-padding"}
-    if hlo_text is None and (rules is None or hlo_rules & set(rules)):
+        jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+        findings += lint_jaxpr(jaxpr, policy=policy)
+        if rules is None or "nondeterminism" in set(rules):
+            findings += nondeterminism_jaxpr_findings(jaxpr)
+    want_spmd = (mesh_model is not None or per_rank_hlo is not None
+                 ) and (rules is None or _SPMD_HLO_RULES & set(rules))
+    if hlo_text is None and (rules is None or _HLO_RULES & set(rules)
+                             or want_spmd):
         # same economy as the trace skip above: no XLA compile when the
         # caller selected jaxpr-pass rules only
         if compiled is not None:
@@ -89,6 +142,11 @@ def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
         findings += lint_hlo_text(
             hlo_text, known_scopes=known_scopes,
             min_donation_bytes=min_donation_bytes, rules=rules)
+    if want_spmd and (hlo_text or per_rank_hlo):
+        findings = _merge_spmd(findings, lint_spmd_text(
+            per_rank_hlo if per_rank_hlo is not None else hlo_text,
+            mesh_model=mesh_model, known_scopes=known_scopes,
+            rules=rules))
     if rules is not None:
         findings = [f for f in findings if f.rule in set(rules)]
     if fn_name is None and fn is not None:
@@ -96,14 +154,29 @@ def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
     return Report(findings, fn_name=fn_name)
 
 
+def _merge_spmd(findings, spmd):
+    """Merge SPMD-pass findings into a finding list: APX202 carries the
+    byte/axis/hop evidence for an unplanned all-gather, so the generic
+    APX102 warning on the same op is redundant noise and dropped."""
+    if any(f.rule == "implicit-full-gather" for f in spmd):
+        findings = [f for f in findings
+                    if not (f.rule == "implicit-resharding"
+                            and f.op == "all-gather")]
+    return findings + spmd
+
+
 def lint_hlo_file(path: str, *, known_scopes: Sequence[str] = (),
-                  min_donation_bytes: int = 4096) -> Report:
+                  min_donation_bytes: int = 4096,
+                  mesh_model: Optional[MeshModel] = None) -> Report:
     """HLO-pass-only lint of a dumped optimized-HLO text file
-    (``scripts/dump_hlo.py`` output or an XLA dump)."""
+    (``scripts/dump_hlo.py`` output or an XLA dump); a ``mesh_model``
+    adds the cross-rank SPMD rules."""
     with open(path) as f:
         text = f.read()
     import os
-    return Report(
-        lint_hlo_text(text, known_scopes=known_scopes,
-                      min_donation_bytes=min_donation_bytes),
-        fn_name=os.path.basename(path))
+    findings = lint_hlo_text(text, known_scopes=known_scopes,
+                             min_donation_bytes=min_donation_bytes)
+    if mesh_model is not None:
+        findings = _merge_spmd(findings, lint_spmd_text(
+            text, mesh_model=mesh_model, known_scopes=known_scopes))
+    return Report(findings, fn_name=os.path.basename(path))

@@ -86,6 +86,34 @@ RULES: Dict[str, Rule] = {r.slug: r for r in (
          "(sublane,128) tiles and the padding is wasted HBM/MXU work",
          "size matmul dims to multiples of (8,128) for f32 / (16,128) "
          "for bf16 where the model allows"),
+    # SPMD pass (cross-rank congruence + topology)
+    Rule("APX201", "spmd-divergence", "error",
+         "ranks disagree on a collective's order, channel id, replica "
+         "groups or dtype — every rank in the group deadlocks at the "
+         "first diverging op",
+         "compile one SPMD program for all ranks (identical code + "
+         "mesh on every process); for per-rank programs, make the "
+         "collective schedule a pure function of shared config"),
+    Rule("APX202", "implicit-full-gather", "warning",
+         "sharding propagation inserted an all-gather outside every "
+         "registered collective scope — a replicated operand the "
+         "program never asked for, paid in wire bytes and HBM",
+         "pin the operand's sharding (in_shardings / with_sharding_"
+         "constraint) or register the gather as a planned scope"),
+    Rule("APX203", "dcn-flat-collective", "warning",
+         "a flat one-hop reduction crosses a DCN (slice) boundary with "
+         "whole-slice replica groups — the slow link carries the full "
+         "payload",
+         "reduce hierarchically: reduce-scatter within-slice over ICI, "
+         "reduce across slices over DCN, all-gather back "
+         "(parallel.hierarchical_data_mesh factors the axis)"),
+    Rule("APX204", "nondeterminism", "error",
+         "a nondeterministic or non-replayable draw is compiled into "
+         "the step — it breaks guard's bitwise rewind-and-replay "
+         "oracle (docs/resilience.md)",
+         "thread PRNG state through the carried step state; keep host "
+         "callback results off the commit path; scatter with "
+         "unique_indices=True where the indices allow"),
 )}
 
 _RULES_BY_ID = {r.id: r for r in RULES.values()}
@@ -103,6 +131,12 @@ class Finding:
     bytes: Optional[int] = None    # wasted / moved bytes, when estimable
     count: int = 1                 # occurrences folded into this finding
     fix: Optional[str] = None      # specialized fix-it (default: rule's)
+    # cross-rank / topology evidence (the APX2xx SPMD pass; None for
+    # single-program findings — excluded from fingerprints so a
+    # baselined finding survives a mesh-shape change)
+    axes: Optional[List[str]] = None   # mesh axes the groups span
+    ranks: Optional[List[int]] = None  # the diverging rank pair
+    hop: Optional[str] = None          # link class: "ici" | "dcn"
 
     def __post_init__(self):
         if self.rule not in RULES:
@@ -113,6 +147,12 @@ class Finding:
             raise ValueError(f"unknown severity {self.severity!r}")
         if self.fix is None:
             self.fix = RULES[self.rule].fix
+        if self.hop is not None and self.hop not in ("ici", "dcn"):
+            raise ValueError(f"unknown hop class {self.hop!r}")
+        if self.axes is not None:
+            self.axes = [str(a) for a in self.axes]
+        if self.ranks is not None:
+            self.ranks = [int(r) for r in self.ranks]
 
     @property
     def id(self) -> str:
@@ -131,7 +171,8 @@ class Finding:
                 "severity": self.severity, "message": self.message,
                 "fix": self.fix, "op": self.op, "scope": self.scope,
                 "bytes": self.bytes, "count": self.count, "fn": fn,
-                "step": step}
+                "step": step, "axes": self.axes, "ranks": self.ranks,
+                "hop": self.hop}
 
 
 def _fmt_bytes(n: Optional[float]) -> str:

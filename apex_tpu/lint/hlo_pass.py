@@ -43,9 +43,11 @@ __all__ = ["lint_hlo_text", "parse_input_output_alias",
 _CARRIED_CLASSES = ("params", "optimizer_state")
 
 #: minimal fallback when apex_tpu.parallel cannot be imported — the ONE
-#: canonical allowlist is parallel.distributed.KNOWN_COLLECTIVE_SCOPES
-#: (kept there, next to the code that emits the collectives, so a new
-#: planned collective scope is registered in exactly one place)
+#: canonical allowlist is the declarative per-axis registry
+#: :mod:`apex_tpu.parallel.registry` (kept next to the code that emits
+#: the collectives, so a new planned collective scope is registered in
+#: exactly one place; the SPMD pass and the mesh model consume the
+#: same rows)
 _FALLBACK_KNOWN_SCOPES = (r"ddp/sync_gradients",)
 
 _COMMENT_RE = re.compile(r"/\*.*?\*/")
@@ -54,8 +56,8 @@ _LAYOUT_RE = re.compile(r"\{[^{}]*\}")
 
 def _known_scope_patterns(extra: Sequence[str] = ()) -> List[re.Pattern]:
     try:
-        from apex_tpu.parallel.distributed import KNOWN_COLLECTIVE_SCOPES
-        pats = list(KNOWN_COLLECTIVE_SCOPES)
+        from apex_tpu.parallel.registry import known_patterns
+        pats = list(known_patterns())
     except Exception:
         pats = list(_FALLBACK_KNOWN_SCOPES)
     pats += list(extra)

@@ -1,0 +1,258 @@
+"""Declarative mesh/topology model for pod-scale static analysis.
+
+A TPU pod is not a flat set of devices: within a slice, chips talk over
+ICI (hundreds of GB/s per chip); across slices — and across pods — the
+hop is DCN, more than an order of magnitude slower. The cross-rank lint
+rules (:mod:`apex_tpu.lint.spmd_pass`) need exactly one fact per
+collective to judge it: *which link class do these replica groups
+span?* This module is that fact, stated declaratively:
+
+- a :class:`MeshAxis` per mesh dimension, major-to-minor (the same
+  row-major device layout ``jax.sharding.Mesh`` uses), each tagged with
+  the link class collectives over it ride (``"ici"`` or ``"dcn"``);
+- per-link byte budgets (bytes/s, and optionally bytes/step) so a
+  finding can carry a time estimate next to its wire bytes;
+- device-id → axis-coordinate arithmetic, slice identity (the
+  coordinate tuple over the DCN axes), and replica-group hop
+  classification.
+
+This is the ``MeshPlan``-shaped table ROADMAP item 1 will consume: the
+(dp, tp, pp, sp, zero) axes each become one :class:`MeshAxis` row, the
+per-axis collective-scope registry
+(:mod:`apex_tpu.parallel.registry`) names which subsystem communicates
+over which row, and the topology rules stay unchanged.
+
+Specs (the ``scripts/apexlint.py --mesh`` grammar):
+
+- ``dp2x4`` — data parallelism factored (2 slices over DCN) x (4 chips
+  over ICI): axes ``[("data_inter", 2, dcn), ("data_intra", 4, ici)]``.
+  Generally ``dpAxB``.
+- ``2slice`` — N slices over DCN, the local axis absorbing the
+  remaining devices (size resolved against ``n_devices``). Generally
+  ``Nslice``.
+- ``ici8`` / ``iciN`` — one flat ICI axis (single-slice pod view).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["MeshAxis", "MeshModel", "parse_mesh_spec", "LINK_CLASSES",
+           "DEFAULT_LINK_BYTES_PER_S"]
+
+#: link classes, fastest first; a group's hop class is the SLOWEST
+#: link it spans
+LINK_CLASSES = ("ici", "dcn")
+
+#: default per-link bandwidth budgets (bytes/s): ICI is the v5e
+#: per-chip class pod_comm_budget pins (~450 GB/s); DCN the
+#: per-host-NIC class (~25 GB/s) — override per deployment.
+DEFAULT_LINK_BYTES_PER_S = {"ici": 4.5e11, "dcn": 2.5e10}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxis:
+    """One mesh dimension: name, size, and the link its hops ride."""
+
+    name: str
+    size: int
+    link: str = "ici"
+
+    def __post_init__(self):
+        if self.link not in LINK_CLASSES:
+            raise ValueError(f"axis {self.name!r}: link must be one of "
+                             f"{LINK_CLASSES}, got {self.link!r}")
+        if self.size < 1:
+            raise ValueError(f"axis {self.name!r}: size must be >= 1, "
+                             f"got {self.size}")
+
+
+class MeshModel:
+    """Axes + link budgets + the coordinate arithmetic the rules use.
+
+    Device ids are laid out row-major over the axes, major-to-minor —
+    identical to ``jax.sharding.Mesh(np.arange(n).reshape(sizes),
+    names)``, so the flattened ids in compiled ``replica_groups=``
+    (with ``use_global_device_ids=true``) index straight into this
+    model.
+    """
+
+    def __init__(self, axes: Sequence[MeshAxis],
+                 link_bytes_per_s: Optional[Dict[str, float]] = None,
+                 budget_bytes_per_step: Optional[Dict[str, int]] = None,
+                 name: Optional[str] = None):
+        axes = tuple(axes)
+        if not axes:
+            raise ValueError("a mesh model needs at least one axis")
+        if len({a.name for a in axes}) != len(axes):
+            raise ValueError("duplicate axis names")
+        self.axes = axes
+        self.link_bytes_per_s = dict(DEFAULT_LINK_BYTES_PER_S)
+        self.link_bytes_per_s.update(link_bytes_per_s or {})
+        #: optional per-link wire budget one step may spend (a lint
+        #: consumer can gate on it; None = unbudgeted)
+        self.budget_bytes_per_step = dict(budget_bytes_per_step or {})
+        self.name = name
+
+    # -- geometry -------------------------------------------------------------
+
+    @property
+    def n_devices(self) -> int:
+        n = 1
+        for a in self.axes:
+            n *= a.size
+        return n
+
+    @property
+    def axis_names(self) -> Tuple[str, ...]:
+        return tuple(a.name for a in self.axes)
+
+    def axis(self, name: str) -> MeshAxis:
+        for a in self.axes:
+            if a.name == name:
+                return a
+        raise KeyError(name)
+
+    def coords(self, device_id: int) -> Dict[str, int]:
+        """Axis coordinates of a flattened device id (row-major,
+        major-to-minor)."""
+        if not 0 <= device_id < self.n_devices:
+            raise ValueError(f"device id {device_id} outside mesh of "
+                             f"{self.n_devices}")
+        out: Dict[str, int] = {}
+        rem = device_id
+        for a in reversed(self.axes):
+            out[a.name] = rem % a.size
+            rem //= a.size
+        return out
+
+    def slice_id(self, device_id: int) -> Tuple[int, ...]:
+        """Coordinate tuple over the DCN axes — devices sharing it live
+        in the same slice (all-ICI reachable)."""
+        c = self.coords(device_id)
+        return tuple(c[a.name] for a in self.axes if a.link == "dcn")
+
+    # -- replica-group classification -----------------------------------------
+
+    def group_axes(self, group: Iterable[int]) -> List[str]:
+        """Axis names along which a replica group's members vary."""
+        members = list(group)
+        if len(members) < 2:
+            return []
+        coords = [self.coords(m) for m in members]
+        return [a.name for a in self.axes
+                if len({c[a.name] for c in coords}) > 1]
+
+    def group_hop(self, group: Iterable[int]) -> str:
+        """The slowest link class a replica group spans: ``"dcn"`` when
+        its members live in more than one slice, else ``"ici"``."""
+        slices = {self.slice_id(m) for m in group}
+        return "dcn" if len(slices) > 1 else "ici"
+
+    def is_flat_dcn_group(self, group: Iterable[int]) -> bool:
+        """True for a DCN-crossing group that ALSO has >1 member inside
+        some slice — the flat one-hop shape. A hierarchical schedule
+        reduces within-slice first, so its DCN-crossing group holds
+        exactly one member per slice."""
+        members = list(group)
+        per_slice: Dict[Tuple[int, ...], int] = {}
+        for m in members:
+            s = self.slice_id(m)
+            per_slice[s] = per_slice.get(s, 0) + 1
+        return len(per_slice) > 1 and max(per_slice.values()) > 1
+
+    def hop_seconds(self, nbytes: int, hop: str) -> float:
+        """Wire time estimate for ``nbytes`` over a link class."""
+        return nbytes / self.link_bytes_per_s[hop]
+
+    # -- (de)serialization ----------------------------------------------------
+
+    def to_json(self) -> Dict:
+        """The declarative table: JSON round-trips so a deployment can
+        commit its topology next to its bench baselines."""
+        return {
+            "version": 1,
+            "name": self.name,
+            "axes": [dataclasses.asdict(a) for a in self.axes],
+            "link_bytes_per_s": self.link_bytes_per_s,
+            "budget_bytes_per_step": self.budget_bytes_per_step,
+        }
+
+    @classmethod
+    def from_json(cls, data) -> "MeshModel":
+        if isinstance(data, str):
+            data = json.loads(data)
+        if not isinstance(data, dict) or "axes" not in data:
+            raise ValueError("not a mesh model "
+                             '(expected {"version": 1, "axes": [...]})')
+        axes = [MeshAxis(**a) for a in data["axes"]]
+        return cls(axes,
+                   link_bytes_per_s=data.get("link_bytes_per_s"),
+                   budget_bytes_per_step=data.get(
+                       "budget_bytes_per_step"),
+                   name=data.get("name"))
+
+    def __repr__(self) -> str:
+        axes = " x ".join(f"{a.name}={a.size}({a.link})"
+                          for a in self.axes)
+        return f"MeshModel({axes})"
+
+
+_DP_RE = re.compile(r"^dp(\d+)x(\d+)$")
+_SLICE_RE = re.compile(r"^(\d+)slice$")
+_ICI_RE = re.compile(r"^ici(\d+)$")
+
+
+def parse_mesh_spec(spec: str,
+                    n_devices: Optional[int] = None) -> MeshModel:
+    """Build a :class:`MeshModel` from a compact spec string.
+
+    ``dpAxB``: A slices over DCN x B chips over ICI (A*B devices) —
+    ``dp2x4`` is the 8-device two-slice CPU-mesh audit topology.
+    ``Nslice``: N slices over DCN, local ICI size =
+    ``n_devices / N`` (requires ``n_devices``).
+    ``iciN``: one flat N-chip ICI axis (single slice).
+    A path to a ``.json`` file (or a raw JSON object string) loads the
+    declarative table instead.
+    """
+    spec = spec.strip()
+    if spec.startswith("{") or spec.endswith(".json"):
+        if spec.endswith(".json"):
+            with open(spec) as f:
+                return MeshModel.from_json(json.load(f))
+        return MeshModel.from_json(spec)
+    m = _DP_RE.match(spec)
+    if m:
+        inter, intra = int(m.group(1)), int(m.group(2))
+        if n_devices is not None and inter * intra != n_devices:
+            raise ValueError(f"spec {spec!r} wants {inter * intra} "
+                             f"devices, have {n_devices}")
+        return MeshModel(
+            (MeshAxis("data_inter", inter, "dcn"),
+             MeshAxis("data_intra", intra, "ici")), name=spec)
+    m = _SLICE_RE.match(spec)
+    if m:
+        n_slices = int(m.group(1))
+        if n_devices is None:
+            raise ValueError(f"spec {spec!r} needs n_devices to size "
+                             "the local axis")
+        if n_devices % n_slices:
+            raise ValueError(f"{n_devices} devices not divisible into "
+                             f"{n_slices} slices")
+        return MeshModel(
+            (MeshAxis("slice", n_slices, "dcn"),
+             MeshAxis("data", n_devices // n_slices, "ici")),
+            name=spec)
+    m = _ICI_RE.match(spec)
+    if m:
+        n = int(m.group(1))
+        if n_devices is not None and n != n_devices:
+            raise ValueError(f"spec {spec!r} wants {n} devices, have "
+                             f"{n_devices}")
+        return MeshModel((MeshAxis("data", n, "ici"),), name=spec)
+    raise ValueError(
+        f"unknown mesh spec {spec!r} (want dpAxB | Nslice | iciN | "
+        "a mesh-model .json)")

@@ -22,6 +22,10 @@ from apex_tpu.parallel.distributed import (
     replicate,
 )
 from apex_tpu.parallel.larc import LARC, larc_rewrite_grads
+from apex_tpu.parallel.registry import (
+    CollectiveScope, COLLECTIVE_SCOPES, known_patterns, scope_axis,
+    scope_entry,
+)
 from apex_tpu.parallel.launch import (
     distributed_init, elastic_run, enable_crash_dumps, is_distributed,
     process_index, process_count, maybe_print, shrink_schedule,
@@ -41,6 +45,8 @@ __all__ = [
     "bucket_plan", "bucket_table", "bucketed_all_reduce",
     "init_residual", "wire_bytes",
     "LARC", "larc_rewrite_grads",
+    "CollectiveScope", "COLLECTIVE_SCOPES", "known_patterns",
+    "scope_axis", "scope_entry",
     "distributed_init", "elastic_run", "enable_crash_dumps",
     "is_distributed", "process_index", "process_count", "maybe_print",
     "shrink_schedule",

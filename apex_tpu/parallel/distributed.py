@@ -55,19 +55,15 @@ from apex_tpu.parallel.mesh import DATA_AXIS
 
 #: named-scope patterns (regex fragments) under which this package
 #: deliberately emits collectives — the allowlist apexlint's
-#: implicit-resharding rule (APX102) checks compiled collectives
-#: against. Every planned collective in the stack runs under one of
-#: these spans: DDP sync (+ per-bucket sub-spans), SyncBatchNorm's
-#: stats psums (flax module scope), ZeRO grad scatter / param gather
-#: (apex_tpu.optim.distributed). A collective matching none of them in
-#: optimized HLO is a reshard nobody asked for.
-KNOWN_COLLECTIVE_SCOPES = (
-    r"ddp/sync_gradients",
-    r"(^|/)bucket\d+",
-    r"(?i)sync_?batch_?norm",
-    r"zero/(grad_scatter|param_gather)",
-    r"(^|/)ring_",
-)
+#: implicit-resharding rules (APX102/APX202) check compiled collectives
+#: against. The canonical table now lives in
+#: :mod:`apex_tpu.parallel.registry` — one declarative row per planned
+#: collective family, carrying the mesh axis it communicates over (the
+#: mesh model / topology rules consume the same rows); this name is the
+#: backward-compatible flat view.
+from apex_tpu.parallel.registry import known_patterns as _known_patterns
+
+KNOWN_COLLECTIVE_SCOPES = _known_patterns()
 
 
 def _is_float(x):

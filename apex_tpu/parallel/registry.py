@@ -1,0 +1,106 @@
+"""Declarative registry of every collective scope this stack emits.
+
+The reference builds its process sub-groups imperatively and scatters
+the knowledge of "who communicates over what" across modules
+(`apex/parallel/__init__.py:21-95` SyncBN groups,
+`apex/parallel/distributed.py:604-624` allreduce groups,
+`apex/contrib/optimizers/distributed_fused_adam.py:250-290`
+hierarchical groups). Here the same knowledge is ONE table: each
+:class:`CollectiveScope` entry names the named-scope pattern a planned
+collective runs under, the canonical mesh axis it communicates over,
+and the subsystem that owns it.
+
+Two consumers read the table (keep them in lockstep by construction —
+they import this module, nothing is duplicated):
+
+- **apexlint APX102/APX202** (:mod:`apex_tpu.lint.hlo_pass`,
+  :mod:`apex_tpu.lint.spmd_pass`): a compiled collective whose stripped
+  scope matches no entry is a reshard nobody planned;
+- **the mesh model** (:mod:`apex_tpu.lint.mesh_model`): a matched scope
+  resolves to its mesh axis, so topology rules (APX203) can say *which*
+  axis a DCN-crossing collective was reduced over and which link class
+  its bytes ride.
+
+This table is the seed of ROADMAP item 1's ``MeshPlan``: when the
+(dp, tp, pp, sp, zero) axes land, each new subsystem registers its
+collective scopes here — one row per planned collective family, next
+to nothing else.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional, Sequence, Tuple
+
+from apex_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+
+__all__ = ["CollectiveScope", "COLLECTIVE_SCOPES", "known_patterns",
+           "scope_axis", "scope_entry"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveScope:
+    """One planned collective family: where it runs, over which axis."""
+
+    pattern: str      # regex fragment matched against the STRIPPED
+                      # scope path (prof.xplane.strip_scope output)
+    axis: str         # canonical mesh-axis name it communicates over
+    subsystem: str    # owning subsystem (ddp, zero, sync_batchnorm, ...)
+    description: str  # one-line: what the collective does
+
+
+#: the one canonical allowlist — every collective this package
+#: deliberately emits runs under a named scope matching exactly one row.
+#: A compiled collective matching none of them is a reshard nobody
+#: asked for (apexlint APX102/APX202).
+COLLECTIVE_SCOPES: Tuple[CollectiveScope, ...] = (
+    CollectiveScope(r"ddp/sync_gradients", DATA_AXIS, "ddp",
+                    "gradient all-reduce across the data axis"),
+    CollectiveScope(r"(^|/)bucket\d+", DATA_AXIS, "ddp",
+                    "per-bucket overlapped all-reduce sub-spans"),
+    CollectiveScope(r"ddp/loss_pmean", DATA_AXIS, "ddp",
+                    "cross-replica loss averaging for the logged "
+                    "metric"),
+    CollectiveScope(r"(?i)sync_?batch_?norm", DATA_AXIS,
+                    "sync_batchnorm",
+                    "cross-replica batch-norm statistics psums"),
+    CollectiveScope(r"zero/(grad_scatter|param_gather)", DATA_AXIS,
+                    "zero",
+                    "ZeRO gradient reduce-scatter / parameter "
+                    "all-gather"),
+    CollectiveScope(r"(^|/)ring_", SEQ_AXIS, "ring_attention",
+                    "ring/Ulysses sequence-parallel attention "
+                    "permutes and all-to-alls"),
+)
+
+
+def known_patterns() -> Tuple[str, ...]:
+    """The regex fragments, in registry order — the APX102 allowlist
+    (re-exported as ``parallel.distributed.KNOWN_COLLECTIVE_SCOPES``
+    for backward compatibility)."""
+    return tuple(s.pattern for s in COLLECTIVE_SCOPES)
+
+
+def scope_entry(scope: str,
+                extra: Sequence[str] = ()) -> Optional[CollectiveScope]:
+    """The registry row a stripped scope path matches, or None.
+
+    ``extra`` patterns (per-call allowlist extensions, the
+    ``known_scopes=`` lint argument) match as anonymous rows with no
+    axis attribution."""
+    for entry in COLLECTIVE_SCOPES:
+        if re.search(entry.pattern, scope):
+            return entry
+    for pat in extra:
+        if re.search(pat, scope):
+            return CollectiveScope(pat, "", "user", "caller-supplied "
+                                   "known_scopes= pattern")
+    return None
+
+
+def scope_axis(scope: str) -> Optional[str]:
+    """Canonical mesh axis a planned collective scope communicates
+    over, or None for an unknown scope."""
+    entry = scope_entry(scope)
+    return entry.axis if entry is not None else None

@@ -334,14 +334,19 @@ def _bench_dcgan(batch, iters):
     return batch * K / dt, dt / K, flops_step * K / dt
 
 
-def _bert_step_builder(batch, seq, encoder=None, vocab=30000):
+def _bert_step_builder(batch, seq, encoder=None, vocab=30000,
+                       ddp=None):
     """ONE construction of the BERT-LAMB MLM step (amp O1 + FusedLAMB,
     auto_cast forward) shared by the bench row, the apexlint flagship
     (`scripts/apexlint.py --flagship bert` — the program the smoke gate
     lints must be the program the bench measures), and
     `scripts/prof_bert.py`. ``encoder=None`` builds the full BertLarge;
     pass a scaled `models.BertEncoder` for CPU structural variants.
-    Returns ``(step, state, (toks, labels), policy, enc, variables)``.
+    ``ddp`` (a `parallel.DistributedDataParallel`) syncs the gradients
+    between backward and apply — the per-shard step the apexlint
+    `--mesh` cross-rank audit wraps in `shard_map`; the batch is then
+    the GLOBAL batch. Returns
+    ``(step, state, (toks, labels), policy, enc, variables)``.
     """
     from apex_tpu import amp, models
     from apex_tpu.optim import FusedLAMB
@@ -360,6 +365,11 @@ def _bert_step_builder(batch, seq, encoder=None, vocab=30000):
             with amp.auto_cast(policy):
                 return models.mlm_loss(enc, {"params": mp}, toks, labels)
         loss, grads, state, finite = amp_opt.backward(state, loss_fn)
+        if ddp is not None:
+            from apex_tpu.trace.spans import span
+            grads = ddp.sync(grads)
+            with span("ddp/loss_pmean", kind="collective"):
+                loss = jax.lax.pmean(loss, ddp.axis_name)
         return amp_opt.apply_gradients(state, grads, finite), loss
 
     return step, state, (toks, labels), policy, enc, variables
@@ -765,6 +775,13 @@ def _memory_row(batch: int, size: int):
         step, state, batch_stats, x, y,
         policy=amp.Policy.from_opt_level("O2"), compiled=compiled,
         fn_name="resnet50_o2_step")
+    # cross-rank congruence off the SAME executable (apexlint SPMD
+    # pass): trivially 0 collectives on the single-chip headline, the
+    # live deadlock canary once the measured step spans a mesh
+    schedule = lint.extract_collective_schedule(compiled.as_text())
+    spmd_errors = sum(
+        1 for f in lint.congruence_findings(schedule)
+        if f.severity == "error") if schedule else 0
     return {
         "peak_hbm_bytes": int(peak) if peak else int(rep.peak_live_bytes),
         "source": "device" if peak else "report",
@@ -773,6 +790,8 @@ def _memory_row(batch: int, size: int):
         "classes_mib": {k: round(v / 2 ** 20, 2)
                         for k, v in rep.classes.items()},
         "lint": lint_rep.summary(),
+        "lint_spmd": {"n_collectives": len(schedule),
+                      "congruence_errors": spmd_errors},
     }
 
 
@@ -865,6 +884,11 @@ def main():
                   "lint_findings": mem.get("lint", {}).get("n_findings"),
                   "lint_errors": mem.get("lint", {}).get(
                       "by_severity", {}).get("error"),
+                  # cross-rank SPMD congruence on the same executable
+                  # (collective schedule length + APX201 error count;
+                  # see docs/linting.md#apx2xx)
+                  "lint_spmd_errors": mem.get("lint_spmd", {}).get(
+                      "congruence_errors"),
                   "n_compiles": n_compiles,
                   # async checkpoint overhead on the step path (median
                   # per-step capture stall vs a synchronous

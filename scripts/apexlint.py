@@ -3,14 +3,18 @@
 
 Three ways to name the step:
 
-``--flagship resnet|bert|both`` (default: both)
+``--flagship resnet|bert|both|guarded|ckpt|all`` (default: both)
     The BASELINE.md flagship steps, built exactly as ``bench.py`` runs
     them (ResNet-50 amp O2 + FusedSGD; BERT LAMB amp O1), jitted WITH
     their donation so the donation rule audits the real program. On an
     accelerator the full-size configs are used; on CPU the structural
     downscalings (the same convention as ``pod_comm_budget --cpu8`` /
     ``memory_budget --cpu8``: ResNet at 64px/b8, a 4-layer BERT at
-    seq 128) — same step structure, CPU-compilable.
+    seq 128) — same step structure, CPU-compilable. ``guarded`` and
+    ``ckpt`` are the self-audit targets: the guard-instrumented
+    flagship step (``Amp.step(guard=)``) and the checkpoint snapshot
+    copy program — instrumentation that landed after the linter did
+    and must stay clean; ``all`` = all four.
 
 ``--import pkg.mod:builder``
     ``builder()`` must return ``(step_fn, args)`` or
@@ -20,6 +24,20 @@ Three ways to name the step:
 ``--hlo FILE``
     HLO-pass-only lint of a dumped optimized-HLO text file
     (``scripts/dump_hlo.py`` output or an XLA dump).
+
+``--mesh dp2x4|2slice|iciN|model.json`` switches on the cross-rank
+SPMD pass (APX201 congruence/deadlock, APX202 implicit full gather,
+APX203 DCN-crossing flat collective — docs/linting.md#apx2xx): the
+flagship targets become their DDP shard_map variants compiled over a
+matching device mesh (on CPU: 8 virtual devices, structural
+downscalings per the ``pod_comm_budget --cpu8`` convention), with the
+topology judged against the declarative mesh model
+(``apex_tpu.lint.mesh_model``). With ``--hlo``/``--import`` the mesh
+model applies to those modules instead. ``run_tier1.sh --smoke`` runs
+``--mesh dp2x4 --fail-on error`` as the cpu8 cross-rank congruence
+audit: the clean flagships must report zero errors (the expected
+APX203 warnings on the flat DDP sync over the 2-slice model are the
+ROADMAP item-2 feeder, not failures).
 
 Output: the finding table on stdout; ``--jsonl FILE`` streams
 ``lint_report``/``lint_finding`` events through the
@@ -37,8 +55,11 @@ import json
 import os
 import sys
 
+import numpy as np
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 
 def _build_flagship_resnet():
@@ -77,8 +98,167 @@ def _build_flagship_bert():
     return jstep, (state, toks, labels), policy, "bert_lamb_step"
 
 
+def _build_flagship_guarded():
+    """The guard-instrumented flagship step (self-audit: ``guard/``
+    landed after the linter did — ``Amp.step(guard=)`` threads the
+    anomaly detectors through the same resnet O2 program). Structural
+    downscale on CPU, like the other flagships."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp, guard, models, ops
+    from apex_tpu.optim import FusedSGD
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model = models.ResNet(stage_sizes=[3, 4, 6, 3],
+                              num_classes=1000, dtype=jnp.bfloat16)
+        batch, size = 256, 224
+    else:
+        model = models.ResNet(stage_sizes=[1, 1], num_classes=10,
+                              width=16, dtype=jnp.bfloat16)
+        batch, size = 8, 32
+    policy = amp.Policy.from_opt_level("O2")
+    amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9))
+    x = jnp.zeros((batch, size, size, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+    state = amp_opt.init(variables["params"])
+    batch_stats = variables["batch_stats"]
+    cfg = guard.GuardConfig()
+    gs = guard.guard_init(cfg)
+
+    def step(state, gs, batch_stats, x, y):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": batch_stats}, x,
+                train=True, mutable=["batch_stats"])
+            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, y))
+            return loss, mut["batch_stats"]
+
+        state, (loss, new_bs), committed, gs = amp_opt.step(
+            state, loss_fn, has_aux=True, guard=(gs, cfg))
+        return state, gs, new_bs, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+    return (jstep, (state, gs, batch_stats, x, y), policy,
+            "guarded_resnet_o2_step")
+
+
+def _build_flagship_ckpt():
+    """The checkpoint snapshot's batched copy program over the flagship
+    carried state (self-audit: ``ckpt/`` landed after the linter did).
+    The copy program must NOT donate — fresh buffers are its donation
+    safety — and must compile zero host traffic; this target pins
+    both."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp, models
+    from apex_tpu.ckpt.snapshot import _copy_leaves
+    from apex_tpu.optim import FusedSGD
+
+    on_tpu = jax.default_backend() == "tpu"
+    model = (models.ResNet(stage_sizes=[3, 4, 6, 3], num_classes=1000,
+                           dtype=jnp.bfloat16) if on_tpu else
+             models.ResNet(stage_sizes=[1, 1], num_classes=10,
+                           width=16, dtype=jnp.bfloat16))
+    size = 224 if on_tpu else 32
+    amp_opt = amp.Amp(amp.Policy.from_opt_level("O2"),
+                      FusedSGD(lr=0.1, momentum=0.9))
+    x = jnp.zeros((1, size, size, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=True)
+    state = amp_opt.init(variables["params"])
+    leaves = [l for l in jax.tree_util.tree_leaves(
+        (state, variables["batch_stats"]))
+        if isinstance(l, jax.Array)]
+    return (_copy_leaves, (leaves,), None, "ckpt_copy_leaves")
+
+
 FLAGSHIPS = {"resnet": _build_flagship_resnet,
-             "bert": _build_flagship_bert}
+             "bert": _build_flagship_bert,
+             "guarded": _build_flagship_guarded,
+             "ckpt": _build_flagship_ckpt}
+#: --flagship group aliases ("both" predates guarded/ckpt and keeps
+#: its original meaning)
+FLAGSHIP_GROUPS = {"both": ("resnet", "bert"),
+                   "all": ("resnet", "bert", "guarded", "ckpt")}
+
+
+def _build_mesh_flagship_resnet(mesh):
+    """The flagship O2+DDP step over a device mesh — the exact
+    ``pod_comm_budget.build_step`` program (shared definition), at the
+    ``--cpu8`` structural scale off-TPU, jitted with donated carried
+    state. Linted with a mesh model this is the cross-rank congruence
+    audit target."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import pod_comm_budget as pcb
+    from apex_tpu import amp, models, parallel
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = int(np.prod(mesh.devices.shape))
+    if on_tpu:
+        model, size, per_chip = None, 224, 256
+    else:
+        model = models.ResNet(stage_sizes=[1, 1], num_classes=10,
+                              width=16, dtype=jnp.bfloat16)
+        size, per_chip = 32, 4
+    step, model, amp_opt = pcb.build_step(mesh, False, model=model)
+    x1 = jnp.ones((2, size, size, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x1, train=True))
+    state_s = jax.eval_shape(
+        lambda: amp_opt.init(jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, a.dtype),
+            variables["params"])))
+    x_s = jax.ShapeDtypeStruct((per_chip * n, size, size, 3),
+                               jnp.float32)
+    y_s = jax.ShapeDtypeStruct((per_chip * n,), jnp.int32)
+    stepped = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(parallel.DATA_AXIS),
+                  P(parallel.DATA_AXIS)),
+        out_specs=(P(), P(), P()), check_vma=False),
+        donate_argnums=(0, 1))
+    return (stepped,
+            (state_s, variables["batch_stats"], x_s, y_s),
+            amp.Policy.from_opt_level("O2"), "resnet50_o2_ddp_step")
+
+
+def _build_mesh_flagship_bert(mesh):
+    """The BERT-LAMB step DDP-wrapped over a device mesh (grad
+    all-reduce under the ``ddp/sync_gradients`` span), donated."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import bench
+    from apex_tpu import models, parallel
+
+    on_tpu = jax.default_backend() == "tpu"
+    n = int(np.prod(mesh.devices.shape))
+    if on_tpu:
+        enc, per_chip, seq = None, 16, 512
+    else:
+        enc = models.BertEncoder(30000, hidden=128, layers=2, heads=2,
+                                 max_len=64)
+        per_chip, seq = 1, 64
+    ddp = parallel.DistributedDataParallel(mesh)
+    step, state, (toks, labels), policy, _enc, _vars = \
+        bench._bert_step_builder(per_chip * n, seq, encoder=enc,
+                                 ddp=ddp)
+    stepped = jax.jit(jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(parallel.DATA_AXIS), P(parallel.DATA_AXIS)),
+        out_specs=(P(), P()), check_vma=False), donate_argnums=(0,))
+    return (stepped, (state, toks, labels), policy,
+            "bert_lamb_ddp_step")
+
+
+MESH_FLAGSHIPS = {"resnet": _build_mesh_flagship_resnet,
+                  "bert": _build_mesh_flagship_bert}
 
 
 def _import_builder(spec):
@@ -95,11 +275,29 @@ def _import_builder(spec):
     return fn, args, policy, spec
 
 
+def _mesh_for_model(mm):
+    """A flat-data-axis device mesh matching the mesh model's device
+    count — the program's LOGICAL axis; the model describes the
+    physical topology its flattened device ids map onto (the flat DDP
+    sync over a multi-slice model is exactly what APX203 exists to
+    call out)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from apex_tpu import parallel
+
+    devs = jax.devices()
+    if len(devs) < mm.n_devices:
+        raise SystemExit(f"mesh model {mm!r} needs {mm.n_devices} "
+                         f"devices, have {len(devs)}")
+    return Mesh(np.array(devs[:mm.n_devices]), (parallel.DATA_AXIS,))
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     flagship = None
     imports, hlo_files = [], []
-    baseline_path = write_baseline = jsonl_path = None
+    baseline_path = write_baseline = jsonl_path = mesh_spec = None
     fail_on = "error"
     as_json = False
     it = iter(argv)
@@ -111,7 +309,8 @@ def main(argv=None) -> int:
             as_json = True
             continue
         elif a not in ("--flagship", "--import", "--hlo", "--baseline",
-                       "--write-baseline", "--jsonl", "--fail-on"):
+                       "--write-baseline", "--jsonl", "--fail-on",
+                       "--mesh"):
             print(f"unknown arg {a!r}\n{__doc__}", file=sys.stderr)
             return 2
         val = next(it, None)
@@ -132,19 +331,59 @@ def main(argv=None) -> int:
             jsonl_path = val
         elif a == "--fail-on":
             fail_on = val
+        elif a == "--mesh":
+            mesh_spec = val
     if fail_on not in ("error", "warning", "never"):
         print(f"--fail-on must be error|warning|never, got {fail_on!r}",
               file=sys.stderr)
         return 2
     if flagship is None and not imports and not hlo_files:
         flagship = "both"
+
+    mesh_model = None
+    if mesh_spec is not None:
+        from apex_tpu import _compat
+        from apex_tpu.lint.mesh_model import parse_mesh_spec
+        try:
+            try:
+                mesh_model = parse_mesh_spec(mesh_spec)
+                # CPU runs need the virtual devices BEFORE the backend
+                # initializes (a no-op on real accelerators)
+                _compat.request_cpu_devices(mesh_model.n_devices)
+            except ValueError:
+                # specs that infer their local size (Nslice) need a
+                # device count — ask for the 8-device CPU audit mesh up
+                # front so the count exists before the backend pins it
+                # (real accelerators report their own count regardless)
+                import jax
+                _compat.request_cpu_devices(8)
+                mesh_model = parse_mesh_spec(
+                    mesh_spec, n_devices=len(jax.devices()))
+        except (ValueError, OSError) as e:
+            print(f"--mesh: {e}", file=sys.stderr)
+            return 2
+
     targets = []
     if flagship:
-        names = list(FLAGSHIPS) if flagship == "both" else [flagship]
+        names = list(FLAGSHIP_GROUPS.get(flagship, (flagship,)))
+        table = FLAGSHIPS
+        if mesh_model is not None:
+            # only the DDP-capable flagships have mesh variants; the
+            # group aliases narrow to them (the guarded/ckpt self-audit
+            # targets are single-program by nature)
+            table = MESH_FLAGSHIPS
+            if flagship in FLAGSHIP_GROUPS:
+                names = [n for n in names if n in MESH_FLAGSHIPS]
         for n in names:
-            if n not in FLAGSHIPS:
+            if n not in table:
+                extra = (" (no --mesh variant; drop --mesh or use "
+                         f"{'|'.join(MESH_FLAGSHIPS)}|both)"
+                         if mesh_model is not None and n in FLAGSHIPS
+                         else "")
                 print(f"unknown flagship {n!r} (choices: "
-                      f"{', '.join(FLAGSHIPS)}, both)", file=sys.stderr)
+                      f"{', '.join(table)}, "
+                      f"{', '.join(FLAGSHIP_GROUPS)}){extra}",
+                      file=sys.stderr)
                 return 2
             targets.append(("flagship", n))
     targets += [("import", s) for s in imports]
@@ -162,13 +401,18 @@ def main(argv=None) -> int:
     reports, raw_findings = [], []
     for kind, what in targets:
         if kind == "hlo":
-            report = lint.lint_hlo_file(what)
+            report = lint.lint_hlo_file(what, mesh_model=mesh_model)
         else:
-            fn, args, policy, name = (FLAGSHIPS[what]()
-                                      if kind == "flagship"
-                                      else _import_builder(what))
+            if kind == "flagship" and mesh_model is not None:
+                mesh = _mesh_for_model(mesh_model)
+                fn, args, policy, name = MESH_FLAGSHIPS[what](mesh)
+            else:
+                fn, args, policy, name = (FLAGSHIPS[what]()
+                                          if kind == "flagship"
+                                          else _import_builder(what))
             report = lint.lint_step(fn, *args, policy=policy,
-                                    fn_name=name)
+                                    fn_name=name,
+                                    mesh_model=mesh_model)
         # the written baseline must cover EVERYTHING that fired —
         # including findings the read baseline suppresses, or a
         # --baseline X --write-baseline X refresh would drop still-live

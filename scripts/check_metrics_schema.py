@@ -141,15 +141,20 @@ MEMORY_BYTE_FIELDS = ("total_bytes", "attributed_bytes",
 
 LINT_KINDS = ("lint_report", "lint_finding")
 LINT_SEVERITIES = ("error", "warning", "info")
+#: link classes a cross-rank (APX2xx) finding's bytes ride
+LINT_HOPS = ("ici", "dcn")
 #: required keys per lint-event kind (beyond "kind" itself)
 LINT_REQUIRED = {
     "lint_report": ("n_findings", "by_severity"),
     "lint_finding": ("rule", "id", "severity", "message"),
 }
-#: keys that may be null per kind (everything else non-null when present)
+#: keys that may be null per kind (everything else non-null when
+#: present; axes/ranks/hop are the SPMD-pass evidence — null on
+#: single-program findings)
 LINT_NULLABLE = {
     "lint_report": ("step", "fn"),
-    "lint_finding": ("step", "fn", "op", "scope", "bytes", "fix"),
+    "lint_finding": ("step", "fn", "op", "scope", "bytes", "fix",
+                     "axes", "ranks", "hop"),
 }
 
 
@@ -577,6 +582,24 @@ def check_lint_lines(lines) -> List[str]:
             if sev is not None and sev not in LINT_SEVERITIES:
                 errors.append(f"line {i}: 'severity' must be one of "
                               f"{LINT_SEVERITIES}, got {sev!r}")
+            axes = rec.get("axes")
+            if axes is not None and not (
+                    isinstance(axes, list)
+                    and all(isinstance(a, str) for a in axes)):
+                errors.append(f"line {i}: 'axes' must be a list of "
+                              "mesh-axis names")
+            ranks = rec.get("ranks")
+            if ranks is not None and not (
+                    isinstance(ranks, list) and len(ranks) == 2
+                    and all(isinstance(r, int)
+                            and not isinstance(r, bool)
+                            and r >= 0 for r in ranks)):
+                errors.append(f"line {i}: 'ranks' must be a pair of "
+                              "non-negative rank ids")
+            hop = rec.get("hop")
+            if hop is not None and hop not in LINT_HOPS:
+                errors.append(f"line {i}: 'hop' must be one of "
+                              f"{LINT_HOPS}, got {hop!r}")
     if n_records == 0:
         errors.append("no records found")
     return errors

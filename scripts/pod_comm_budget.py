@@ -133,12 +133,16 @@ def build_step(mesh, delay_allreduce, model=None, *,
 
     def step(state, batch_stats, xb, yb):
         def loss_fn(mp):
+            from apex_tpu.trace.spans import span
             logits, mut = model.apply(
                 {"params": mp, "batch_stats": batch_stats}, xb,
                 train=True, mutable=["batch_stats"])
             loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
-            return jax.lax.pmean(loss, parallel.DATA_AXIS), \
-                mut["batch_stats"]
+            # registered scope (parallel.registry "ddp/loss_pmean") —
+            # a bare pmean here is an APX102 finding in the --mesh audit
+            with span("ddp/loss_pmean", kind="collective"):
+                loss = jax.lax.pmean(loss, parallel.DATA_AXIS)
+            return loss, mut["batch_stats"]
 
         (loss, new_bs), grads, state, finite = amp_opt.backward(
             state, loss_fn, has_aux=True)

@@ -14,8 +14,13 @@
 #                                   #   NaN-spike rewind bitwise vs a
 #                                   #   fault-free oracle, skip-class
 #                                   #   convergence, guard schema
-#                                   # + apexlint on both flagship steps
-#                                   #   (asserts zero error findings)
+#                                   # + apexlint on the flagship steps
+#                                   #   incl. the guarded/ckpt
+#                                   #   self-audit targets (asserts
+#                                   #   zero error findings)
+#                                   # + the cross-rank SPMD congruence
+#                                   #   audit (--mesh dp2x4 on the
+#                                   #   cpu8 mesh, --fail-on error)
 #
 # Exit status is pytest's (or the first failing smoke step). The full
 # run prints DOTS_PASSED=<n> — the count of passing-test dots the driver
@@ -95,15 +100,32 @@ EOF
 
     echo "== smoke: apexlint flagship steps (--fail-on error)"
     # lints the flagship ResNet-O2 and BERT-LAMB steps (CPU structural
-    # downscalings) against the committed baseline — which starts
-    # EMPTY, so any new error-severity finding (donation miss, host
-    # transfer, f64 creep, RNG reuse) breaks this gate
-    JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship both \
+    # downscalings) PLUS the guard-instrumented step and the ckpt
+    # snapshot copy program (the self-audit targets) against the
+    # committed baseline — which starts EMPTY, so any new
+    # error-severity finding (donation miss, host transfer, f64 creep,
+    # RNG reuse, non-replayable randomness) breaks this gate
+    JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship all \
         --baseline scripts/apexlint_baseline.json --fail-on error \
         --jsonl "$tmp/lint.jsonl"
 
     echo "== smoke: lint schema validator on the apexlint event stream"
     python scripts/check_metrics_schema.py --kind lint "$tmp/lint.jsonl"
+
+    echo "== smoke: apexlint cross-rank congruence audit (cpu8, dp2x4)"
+    # the SPMD pass over the DDP flagship steps compiled on the
+    # 8-device CPU mesh, judged against the 2-slice x 4-chip topology
+    # model: asserts zero APX201 deadlock/divergence and zero
+    # error-severity findings. The APX203 warnings it prints (the flat
+    # ddp/sync_gradients all-reduce crossing the modeled DCN boundary)
+    # are the ROADMAP item-2 hierarchical-collective feeder, by design.
+    JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship both \
+        --mesh dp2x4 --baseline scripts/apexlint_baseline.json \
+        --fail-on error --jsonl "$tmp/lint_mesh.jsonl"
+
+    echo "== smoke: lint schema validator on the cross-rank stream"
+    python scripts/check_metrics_schema.py --kind lint \
+        "$tmp/lint_mesh.jsonl"
 
     echo "smoke ok"
     exit 0

@@ -363,7 +363,8 @@ class TestReportPlumbing:
     def test_rule_catalog_is_stable(self):
         assert {r.id for r in F.RULES.values()} == {
             "APX001", "APX002", "APX003", "APX004",
-            "APX101", "APX102", "APX103", "APX104"}
+            "APX101", "APX102", "APX103", "APX104",
+            "APX201", "APX202", "APX203", "APX204"}
         for r in F.RULES.values():
             assert r.severity in F.SEVERITIES and r.fix and r.title
 
