@@ -176,12 +176,8 @@ class Finding:
 
 
 def _fmt_bytes(n: Optional[float]) -> str:
-    if n is None:
-        return ""
-    for unit, div in (("GiB", 2 ** 30), ("MiB", 2 ** 20), ("KiB", 2 ** 10)):
-        if abs(n) >= div:
-            return f"{n / div:.2f} {unit}"
-    return f"{int(n)} B"
+    from apex_tpu.utils.format import fmt_bytes
+    return fmt_bytes(n, none="")
 
 
 class Report:

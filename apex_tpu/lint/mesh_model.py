@@ -83,7 +83,8 @@ class MeshModel:
     def __init__(self, axes: Sequence[MeshAxis],
                  link_bytes_per_s: Optional[Dict[str, float]] = None,
                  budget_bytes_per_step: Optional[Dict[str, int]] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 calibration: Optional[Dict[str, Dict]] = None):
         axes = tuple(axes)
         if not axes:
             raise ValueError("a mesh model needs at least one axis")
@@ -96,6 +97,17 @@ class MeshModel:
         #: consumer can gate on it; None = unbudgeted)
         self.budget_bytes_per_step = dict(budget_bytes_per_step or {})
         self.name = name
+        #: measurement provenance when the byte budgets came from
+        #: :mod:`apex_tpu.monitor.linkbench` rather than the defaults:
+        #: ``{link: {"alpha_us", "bytes_per_s", "residual",
+        #: "n_samples", "axis"}}`` — round-trips through JSON so a
+        #: committed model states where its numbers came from
+        self.calibration = dict(calibration or {})
+
+    @property
+    def measured(self) -> bool:
+        """True when the link budgets carry calibration provenance."""
+        return bool(self.calibration)
 
     # -- geometry -------------------------------------------------------------
 
@@ -173,13 +185,16 @@ class MeshModel:
     def to_json(self) -> Dict:
         """The declarative table: JSON round-trips so a deployment can
         commit its topology next to its bench baselines."""
-        return {
+        out = {
             "version": 1,
             "name": self.name,
             "axes": [dataclasses.asdict(a) for a in self.axes],
             "link_bytes_per_s": self.link_bytes_per_s,
             "budget_bytes_per_step": self.budget_bytes_per_step,
         }
+        if self.calibration:
+            out["calibration"] = self.calibration
+        return out
 
     @classmethod
     def from_json(cls, data) -> "MeshModel":
@@ -193,7 +208,8 @@ class MeshModel:
                    link_bytes_per_s=data.get("link_bytes_per_s"),
                    budget_bytes_per_step=data.get(
                        "budget_bytes_per_step"),
-                   name=data.get("name"))
+                   name=data.get("name"),
+                   calibration=data.get("calibration"))
 
     def __repr__(self) -> str:
         axes = " x ".join(f"{a.name}={a.size}({a.link})"

@@ -13,6 +13,18 @@ Two halves (see docs/monitoring.md):
   MFU estimator reusing :mod:`apex_tpu.prof`, and amortized device→host
   flushes; :mod:`~apex_tpu.monitor.collectives` accounts per-step
   collective bytes from the compiled HLO.
+
+Plus the runtime performance observatory (docs/monitoring.md#goodput):
+
+- :mod:`~apex_tpu.monitor.goodput` — :class:`GoodputLedger` decomposes
+  each step's wall clock into compute / exposed-comm / input-wait /
+  host-callback / ckpt-stall / recompile / guard-rewind buckets off
+  the :class:`apex_tpu.trace.Tracer` timeline, with an asserted
+  attribution closure and a rolling goodput fraction;
+- :mod:`~apex_tpu.monitor.linkbench` — α–β link calibration sweeping
+  collectives per mesh axis into a MEASURED
+  :class:`apex_tpu.lint.mesh_model.MeshModel`
+  (``scripts/link_probe.py``).
 """
 
 from apex_tpu.monitor.check import module_count_and_host_ops
@@ -21,6 +33,11 @@ from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
                                           collective_bytes_by_dtype,
                                           collective_bytes_from_text,
                                           wire_report)
+from apex_tpu.monitor.goodput import (BUCKETS, GoodputLedger, StepLedger,
+                                      classify_span)
+from apex_tpu.monitor.linkbench import (LinkFit, LinkSample, calibrate,
+                                        fit_alpha_beta, linkfit_events,
+                                        sweep_axis)
 from apex_tpu.monitor.logger import MetricsLogger
 from apex_tpu.monitor.metrics import (METRIC_FIELDS, Metrics, metrics_init,
                                       metrics_snapshot, metrics_to_dict)
@@ -34,4 +51,7 @@ __all__ = [
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
     "collective_bytes_by_dtype", "wire_report",
     "module_count_and_host_ops",
+    "GoodputLedger", "StepLedger", "BUCKETS", "classify_span",
+    "LinkFit", "LinkSample", "calibrate", "fit_alpha_beta",
+    "linkfit_events", "sweep_axis",
 ]

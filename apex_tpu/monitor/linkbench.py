@@ -1,0 +1,292 @@
+"""Measured link calibration: sweep collectives, fit α–β, emit a MeshModel.
+
+The mesh model (:mod:`apex_tpu.lint.mesh_model`) judges every
+cross-rank finding against per-link byte budgets — but until something
+*measures* them, ``link_bytes_per_s`` is an assumed constant
+(``DEFAULT_LINK_BYTES_PER_S``), and ROADMAP item 2's per-hop dtype
+choices (DynamiQ-style routing, EQuARX-class quantized all-reduce)
+would be scheduled against a guess. This module closes that loop:
+
+1. **sweep** (:func:`sweep_axis`): time all-reduce / reduce-scatter /
+   all-gather over ONE mesh axis across a ladder of message sizes —
+   each point is a jitted ``shard_map`` collective, warmed once, then
+   best-of-``iters`` wall time at a host sync (best-of, like the bench
+   harness: the floor is the hardware, the jitter is the host);
+2. **fit** (:func:`fit_alpha_beta`): least-squares ``t = α + β·bytes``
+   over the ring-model wire bytes per chip (all-reduce moves
+   ``2(N−1)/N`` of the buffer, reduce-scatter / all-gather
+   ``(N−1)/N`` — the same factors ``scripts/pod_comm_budget.py``
+   budgets with), yielding the latency intercept α, the measured
+   bandwidth ``1/β``, and the relative fit residual;
+3. **emit** (:func:`calibrate`): one fit per mesh axis, folded per
+   link class (the slowest axis of a class bounds it), into a
+   :class:`~apex_tpu.lint.mesh_model.MeshModel` whose
+   ``link_bytes_per_s`` is **measured** and whose ``calibration``
+   block records the provenance (α, bytes/s, residual, sample count,
+   source axis) — JSON-committable, and ingested unchanged by
+   ``apexlint --mesh model.json`` and ``pod_comm_budget --mesh``, so
+   APX203's flat-vs-hierarchical DCN milliseconds rest on
+   measurements.
+
+``kind="linkfit"`` events for the goodput channel come from
+:func:`linkfit_events` (``check_metrics_schema.py --kind goodput``
+validates). The CLI is ``scripts/link_probe.py --cpu8|--tpu``.
+
+Caveat stated up front: on a shared-memory CPU "mesh" the numbers
+characterize XLA:CPU's collective emulation, not a fabric — the
+``--cpu8`` path exists so the *pipeline* (sweep → fit → MeshModel →
+apexlint) is CI-proven end to end; on-chip runs produce the numbers
+that matter.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.lint.mesh_model import MeshAxis, MeshModel
+
+__all__ = ["LinkSample", "LinkFit", "sweep_axis", "fit_alpha_beta",
+           "calibrate", "linkfit_events", "fit_table",
+           "DEFAULT_SIZES", "OPS"]
+
+#: message-size ladder (bytes of the logical buffer) — spans the
+#: latency-dominated and bandwidth-dominated regimes
+DEFAULT_SIZES = (1 << 14, 1 << 17, 1 << 20)
+
+#: collective families swept per axis
+OPS = ("all_reduce", "reduce_scatter", "all_gather")
+
+#: ring-model wire-byte factor per chip, as a function of axis size N
+_RING_FACTOR = {
+    "all_reduce": lambda n: 2.0 * (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_gather": lambda n: (n - 1) / n,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkSample:
+    """One timed point: op, axis, logical bytes, ring wire bytes/chip,
+    best-of seconds."""
+
+    op: str
+    axis: str
+    size_bytes: int
+    wire_bytes: float
+    seconds: float
+
+
+@dataclasses.dataclass(frozen=True)
+class LinkFit:
+    """α–β fit of one axis (or link class): ``t = alpha_s +
+    wire_bytes / bytes_per_s``."""
+
+    axis: str
+    alpha_s: float
+    bytes_per_s: float
+    residual: float               # relative RMS of the fit
+    n_samples: int
+
+    def seconds(self, wire_bytes: float) -> float:
+        return self.alpha_s + wire_bytes / self.bytes_per_s
+
+    def to_json(self) -> Dict:
+        return {"axis": self.axis,
+                "alpha_us": round(self.alpha_s * 1e6, 3),
+                "bytes_per_s": float(self.bytes_per_s),
+                "residual": round(float(self.residual), 6),
+                "n_samples": self.n_samples}
+
+
+def _collective(op: str, mesh, axis: str):
+    """The jitted shard_map collective for one (op, axis) pair. Input
+    is the replicated logical buffer (each chip holds it whole), so
+    the swept ``size_bytes`` is exactly the collective's payload."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if op == "all_reduce":
+        body, out_spec = (lambda x: jax.lax.psum(x, axis)), P()
+    elif op == "reduce_scatter":
+        body, out_spec = (lambda x: jax.lax.psum_scatter(
+            x, axis, tiled=True)), P(axis)
+    elif op == "all_gather":
+        # gather back a buffer sharded over the axis: the GLOBAL input
+        # is the full logical buffer (in_specs=P(axis) hands each chip
+        # its 1/N shard), the gathered output is the whole buffer again
+        # — so the swept size is exactly the bytes the gather rebuilds
+        def body(x):
+            return jax.lax.all_gather(x, axis, tiled=True)
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=P(axis), out_specs=P(),
+            check_vma=False))
+    else:
+        raise ValueError(f"unknown op {op!r} (want one of {OPS})")
+    return jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=P(), out_specs=out_spec,
+        check_vma=False))
+
+
+def sweep_axis(mesh, axis: str, *, sizes: Sequence[int] = DEFAULT_SIZES,
+               ops: Sequence[str] = OPS, iters: int = 3,
+               ) -> List[LinkSample]:
+    """Time each (op, size) point over ``axis`` of ``mesh``; returns
+    one :class:`LinkSample` per point. Buffers are f32; sizes are
+    rounded up so every op's sharding divides evenly."""
+    import jax
+    import jax.numpy as jnp
+
+    n = int(mesh.shape[axis])
+    if n < 2:
+        raise ValueError(f"axis {axis!r} has size {n}; calibrating a "
+                         "link needs >= 2 participants")
+    out: List[LinkSample] = []
+    for op in ops:
+        fn = _collective(op, mesh, axis)
+        for size in sizes:
+            elems = max(int(size) // 4, n)
+            elems += (-elems) % n            # divisible by the axis
+            # every op takes the full logical buffer as its GLOBAL
+            # input (shard_map's in_specs do any sharding), so
+            # size_bytes below is the payload each op logically moves
+            x = jnp.arange(elems, dtype=jnp.float32)
+            jax.block_until_ready(fn(x))     # warm: compile + first run
+            best = float("inf")
+            for _ in range(max(int(iters), 1)):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(x))
+                best = min(best, time.perf_counter() - t0)
+            nbytes = elems * 4
+            out.append(LinkSample(
+                op=op, axis=axis, size_bytes=nbytes,
+                wire_bytes=_RING_FACTOR[op](n) * nbytes,
+                seconds=best))
+    return out
+
+
+def fit_alpha_beta(samples: Sequence[LinkSample],
+                   axis: Optional[str] = None) -> LinkFit:
+    """Least-squares ``t = α + β·wire_bytes`` over the samples.
+
+    α is clamped non-negative and β strictly positive: a noisy sweep
+    (CPU emulation, tiny messages) can fit a negative slope, and a
+    negative "bandwidth" would poison every downstream ``hop_seconds``
+    — the fallback slope is the largest sample's aggregate rate, the
+    conservative measurable bound."""
+    if not samples:
+        raise ValueError("no samples to fit")
+    b = np.array([s.wire_bytes for s in samples], np.float64)
+    t = np.array([s.seconds for s in samples], np.float64)
+    a_mat = np.stack([np.ones_like(b), b], axis=1)
+    (alpha, beta), *_ = np.linalg.lstsq(a_mat, t, rcond=None)
+    alpha = max(float(alpha), 0.0)
+    if beta <= 0:
+        i = int(np.argmax(b))
+        beta = max(float(t[i] / b[i]), 1e-15)
+        alpha = 0.0
+    pred = alpha + beta * b
+    residual = float(np.sqrt(np.mean((pred - t) ** 2)) / max(
+        float(np.mean(t)), 1e-12))
+    return LinkFit(axis=axis or samples[0].axis, alpha_s=alpha,
+                   bytes_per_s=1.0 / float(beta), residual=residual,
+                   n_samples=len(samples))
+
+
+def calibrate(mesh, template: MeshModel, *,
+              sizes: Sequence[int] = DEFAULT_SIZES,
+              ops: Sequence[str] = OPS, iters: int = 3,
+              name: Optional[str] = None,
+              ) -> Tuple[MeshModel, Dict[str, LinkFit],
+                         List[LinkSample]]:
+    """Sweep every size->1 axis of ``template`` over the matching
+    ``mesh`` axis and emit ``(measured_model, per_axis_fits,
+    samples)``.
+
+    ``template`` declares the topology (axis names/sizes/link classes
+    — e.g. ``parse_mesh_spec("dp2x4")``); ``mesh`` must carry the same
+    axis names with the same sizes. Each link class's
+    ``link_bytes_per_s`` becomes the MINIMUM fitted bandwidth over its
+    axes (the slowest member bounds the class), and the model's
+    ``calibration`` block records each class's winning fit."""
+    for ax in template.axes:
+        if ax.size > 1 and ax.name not in mesh.shape:
+            raise ValueError(f"template axis {ax.name!r} missing from "
+                             f"mesh axes {tuple(mesh.shape)}")
+        if ax.size > 1 and int(mesh.shape[ax.name]) != ax.size:
+            raise ValueError(
+                f"axis {ax.name!r}: template size {ax.size} != mesh "
+                f"size {int(mesh.shape[ax.name])}")
+    fits: Dict[str, LinkFit] = {}
+    samples: List[LinkSample] = []
+    for ax in template.axes:
+        if ax.size < 2:
+            continue
+        ss = sweep_axis(mesh, ax.name, sizes=sizes, ops=ops,
+                        iters=iters)
+        samples.extend(ss)
+        fits[ax.name] = fit_alpha_beta(ss, axis=ax.name)
+    if not fits:
+        raise ValueError("template has no axis of size >= 2 to "
+                         "calibrate")
+    link_bps: Dict[str, float] = {}
+    calibration: Dict[str, Dict] = {}
+    for ax in template.axes:
+        fit = fits.get(ax.name)
+        if fit is None:
+            continue
+        cur = link_bps.get(ax.link)
+        if cur is None or fit.bytes_per_s < cur:
+            link_bps[ax.link] = fit.bytes_per_s
+            calibration[ax.link] = fit.to_json()
+    model = MeshModel(
+        [MeshAxis(a.name, a.size, a.link) for a in template.axes],
+        link_bytes_per_s=link_bps,
+        budget_bytes_per_step=template.budget_bytes_per_step,
+        name=name or (f"{template.name}-measured" if template.name
+                      else "measured"),
+        calibration=calibration)
+    return model, fits, samples
+
+
+def linkfit_events(model: MeshModel,
+                   rank: Optional[int] = None) -> List[Dict]:
+    """``kind="linkfit"`` events (goodput channel) for a calibrated
+    model — one per measured link class."""
+    if rank is None:
+        try:
+            import jax
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+    out: List[Dict] = []
+    for link, cal in sorted(model.calibration.items()):
+        out.append({"kind": "linkfit", "link": link,
+                    "axis": cal.get("axis"),
+                    "alpha_us": cal.get("alpha_us"),
+                    "bytes_per_s": cal.get("bytes_per_s"),
+                    "residual": cal.get("residual"),
+                    "n_samples": cal.get("n_samples"),
+                    "rank": rank, "wall_time": time.time()})
+    return out
+
+
+def fit_table(fits: Dict[str, LinkFit],
+              samples: Sequence[LinkSample] = ()) -> str:
+    """Aligned per-axis fit summary (plus the sample count per op)."""
+    lines = [f"{'axis':<14} {'alpha_us':>10} {'GB/s':>10} "
+             f"{'residual':>9} {'samples':>8}"]
+    for axis, fit in sorted(fits.items()):
+        lines.append(f"{axis:<14} {fit.alpha_s * 1e6:>10.1f} "
+                     f"{fit.bytes_per_s / 1e9:>10.3f} "
+                     f"{fit.residual:>9.4f} {fit.n_samples:>8}")
+    if samples:
+        by_op: Dict[str, int] = {}
+        for s in samples:
+            by_op[s.op] = by_op.get(s.op, 0) + 1
+        lines.append("  swept: " + ", ".join(
+            f"{op} x{n}" for op, n in sorted(by_op.items())))
+    return "\n".join(lines)

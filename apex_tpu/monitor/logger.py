@@ -70,6 +70,8 @@ class MetricsLogger:
                  lint_sink: Optional[Sink] = None,
                  ckpt_sink: Optional[Sink] = None,
                  guard_sink: Optional[Sink] = None,
+                 goodput_sink: Optional[Sink] = None,
+                 logical_collective_bytes: Optional[int] = None,
                  donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
@@ -97,6 +99,21 @@ class MetricsLogger:
         #: validate with ``check_metrics_schema.py --kind guard``). Wire
         #: a GuardPolicy with ``event_sink=logger.record_guard``.
         self.guard_sink = guard_sink
+        #: the ``goodput`` event channel (kind="goodput"/"straggler"/
+        #: "linkfit" events from apex_tpu.monitor.goodput /
+        #: trace.straggler / monitor.linkbench — validate with
+        #: ``check_metrics_schema.py --kind goodput``). Wire a
+        #: GoodputLedger with ``ledger.subscribe(logger.record_goodput)``.
+        self.goodput_sink = goodput_sink
+        #: the uncompressed payload one step SEMANTICALLY moves (e.g.
+        #: ``4 * n_params`` for an fp32 grad sync) — enables the
+        #: per-record ``wire_to_logical`` ratio, same contract as
+        #: :func:`apex_tpu.monitor.wire_report`
+        self.logical_collective_bytes = logical_collective_bytes
+        #: per-dtype wire breakdown from the compiled step (set by
+        #: :meth:`attach`): ``{dtype: bytes}`` — the stdout table's
+        #: logical-vs-wire columns read it
+        self.collective_bytes_by_dtype: Optional[Dict[str, int]] = None
         #: snapshot each recorded metrics pytree into fresh device
         #: buffers (async scalar copies). REQUIRED when the step is
         #: jitted with donate_argnums over the state carrying the
@@ -128,18 +145,34 @@ class MetricsLogger:
         setup, never per step. Statics the caller already set explicitly
         (constructor kwargs) are kept, and nothing compiles when both
         are preset."""
-        from apex_tpu.monitor.collectives import collective_bytes_from_text
+        from apex_tpu.monitor.collectives import (
+            collective_bytes_by_dtype, collective_bytes_from_text)
         from apex_tpu.prof import hlo as _hlo
         if (self.flops_per_step is not None
                 and self.collective_bytes_per_step is not None):
+            # the preset path stays compile-free (its whole point); the
+            # per-dtype wire split then simply stays unset (the stdout
+            # table shows n/a) unless the caller sets
+            # collective_bytes_by_dtype directly
             return self
         compiled = _hlo._compile(step_fn, *args, **kwargs)
+        hlo_text = compiled.as_text()
         if self.flops_per_step is None:
             flops = float(_hlo.cost_analysis_of(compiled).get("flops", 0.0))
             self.flops_per_step = flops if flops > 0 else None
+        if self.collective_bytes_by_dtype is None:
+            # one {dtype: bytes} rollup over the opcodes — the
+            # wire_report breakdown that makes compressed sync auditable
+            # from the live table (a bf16 DDP step shows bf16 wire
+            # bytes at half its fp32 logical payload)
+            per: Dict[str, int] = {}
+            for per_op in collective_bytes_by_dtype(hlo_text).values():
+                for dt, nbytes in per_op.items():
+                    per[dt] = per.get(dt, 0) + nbytes
+            self.collective_bytes_by_dtype = per
         if self.collective_bytes_per_step is None:
             self.collective_bytes_per_step = collective_bytes_from_text(
-                compiled.as_text()).get("total", 0)
+                hlo_text).get("total", 0)
         return self
 
     # -- per-step path (cheap, never syncs) ----------------------------------
@@ -202,6 +235,18 @@ class MetricsLogger:
             else:
                 rec["mfu"] = None
             rec["collective_bytes"] = self.collective_bytes_per_step
+            # the per-dtype logical-vs-wire split (wire_report's
+            # accounting, attached per record so compressed-sync runs
+            # show their ratio without a separate script)
+            rec["wire_by_dtype"] = self.collective_bytes_by_dtype
+            if (self.logical_collective_bytes
+                    and self.collective_bytes_per_step is not None):
+                rec["logical_bytes"] = self.logical_collective_bytes
+                rec["wire_to_logical"] = (self.collective_bytes_per_step
+                                          / self.logical_collective_bytes)
+            else:
+                rec["logical_bytes"] = self.logical_collective_bytes
+                rec["wire_to_logical"] = None
             rec["wall_time"] = time.time()
             if extra:
                 rec.update(extra)
@@ -343,6 +388,31 @@ class MetricsLogger:
                 rec[k] = None
         self.guard_sink.emit(rec)
 
+    # -- goodput channel -----------------------------------------------------
+
+    def record_goodput(self, event: Dict) -> None:
+        """Emit one goodput-channel event (``kind="goodput"|"straggler"
+        |"linkfit"``) — plain-dict pass-through like
+        :meth:`record_guard` (per-step attribution and straggler
+        warnings are forensic; nothing is buffered). Non-finite
+        numbers are nulled to keep the strict-JSON contract (a
+        zero-wall warmup step has no finite goodput fraction). Wire a
+        :class:`apex_tpu.monitor.GoodputLedger` with
+        ``ledger.subscribe(logger.record_goodput)`` and a
+        :class:`apex_tpu.trace.StragglerWatch` with
+        ``event_sink=logger.record_goodput``."""
+        if self.goodput_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+            elif isinstance(v, dict):
+                rec[k] = {kk: (None if isinstance(vv, float)
+                               and not math.isfinite(vv) else vv)
+                          for kk, vv in v.items()}
+        self.goodput_sink.emit(rec)
+
     def close(self) -> None:
         if self._closed:
             return
@@ -359,6 +429,8 @@ class MetricsLogger:
             self.ckpt_sink.close()
         if self.guard_sink is not None:
             self.guard_sink.close()
+        if self.goodput_sink is not None:
+            self.goodput_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

@@ -1101,6 +1101,72 @@ def _():
         "observe-only guard observation changed the compiled program"
 
 
+@case("goodput/no-extra-dispatch")
+def _():
+    """The goodput observatory is pure host-side observation: a step
+    driven under a Tracer with per-phase spans, a GoodputLedger folding
+    every step, a heartbeat writer beating the shared-fs straggler
+    files, and a compile watcher feeding recompile spans must compile
+    BIT-IDENTICAL HLO to the unobserved twin (same guarantee the
+    monitor/trace/memory/guard cases pin), with no host traffic — and
+    the ledger's bucket sum must close over each step's measured wall
+    time (the attribution-closure contract
+    ``scripts/goodput_audit.py --cpu8`` pins at 5%)."""
+    import io
+    import tempfile
+
+    from apex_tpu import monitor, prof, trace
+    from apex_tpu.monitor.check import module_count_and_host_ops
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def train_step(p, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    plain = jax.jit(train_step)
+    hlo_plain = plain.lower(params, x, y).compile().as_text()
+
+    watcher = prof.CompileWatcher()
+    logger = monitor.MetricsLogger(
+        sinks=[], goodput_sink=monitor.JSONLSink(io.StringIO()))
+    tracer = trace.Tracer()
+    ledger = monitor.GoodputLedger(tracer, tolerance=0.05)
+    ledger.subscribe(logger.record_goodput)
+    watched = watcher.watch(train_step, name="train_step")
+    p = params
+    with tempfile.TemporaryDirectory() as tmp:
+        hb = trace.HeartbeatWriter(tmp, rank=0)
+        tracer.subscribe(hb.on_step)
+        with tracer:
+            for i in range(4):
+                with trace.step(i):
+                    with trace.span("dispatch"):
+                        p = watched(p, x, y)
+                    with trace.span("fetch"):
+                        jax.block_until_ready(p)
+        assert hb.n_written == 4 and hb.n_dropped == 0
+    logger.close()
+
+    hlo_obs = watched.jitted.lower(params, x, y).compile().as_text()
+    assert hlo_obs == hlo_plain, \
+        "goodput observation changed the compiled program"
+    _n, host = module_count_and_host_ops(watched.jitted, params, x, y)
+    assert not host, f"observed step compiled host traffic: {host}"
+    assert len(ledger.steps) == 4
+    ok, worst = ledger.check_closure()
+    assert ok, f"attribution closure broke: worst error {worst:.4f}"
+    # step 0 folded the trace+compile: its back-dated compile span must
+    # land in the recompile bucket, and steady state must not
+    assert ledger.steps[0].buckets["recompile"] > 0
+    assert ledger.steps[-1].buckets["recompile"] == 0
+
+
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)
     regardless of cwd — the module lives next to the package root."""

@@ -310,12 +310,8 @@ def _liveness(instrs, batch_size: Optional[int]):
 # --- the report --------------------------------------------------------------
 
 def _fmt_bytes(n: Optional[float]) -> str:
-    if n is None:
-        return "n/a"
-    for unit, div in (("GiB", 2 ** 30), ("MiB", 2 ** 20), ("KiB", 2 ** 10)):
-        if abs(n) >= div:
-            return f"{n / div:.2f} {unit}"
-    return f"{int(n)} B"
+    from apex_tpu.utils.format import fmt_bytes
+    return fmt_bytes(n)
 
 
 @dataclasses.dataclass

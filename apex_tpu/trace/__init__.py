@@ -24,7 +24,13 @@ artifacts. See docs/tracing.md. Four pieces:
 - **NaN provenance** (:mod:`~apex_tpu.trace.debug_nans`): opt-in
   ``debug_nans`` mode adding ``jax.debug.callback`` finiteness probes
   per span; the off path is bit-identical compiled HLO (the
-  ``trace/no-extra-dispatch`` compile-check case).
+  ``trace/no-extra-dispatch`` compile-check case);
+- **straggler detection** (:mod:`~apex_tpu.trace.straggler`): per-rank
+  shared-fs step heartbeats + a lockstep reader flagging persistent
+  laggards (median-lag z-score with hysteresis) with the slowest span
+  class on the lagging rank — the early-warning tier below the
+  watchdog's hard stall deadline
+  (:meth:`HangWatchdog.early_warning`).
 """
 
 from apex_tpu.trace.debug_nans import (debug_nans, debug_nans_enabled,
@@ -33,6 +39,9 @@ from apex_tpu.trace.debug_nans import (debug_nans, debug_nans_enabled,
 from apex_tpu.trace.recorder import FlightRecorder, StepRecord, rank_path
 from apex_tpu.trace.spans import (SpanEvent, StepTimeline, StepTrace,
                                   Tracer, current_tracer, span, step)
+from apex_tpu.trace.straggler import (HeartbeatWriter, StragglerDetector,
+                                      StragglerReport, StragglerWatch,
+                                      read_heartbeats)
 from apex_tpu.trace.watchdog import HangWatchdog
 
 __all__ = [
@@ -40,6 +49,8 @@ __all__ = [
     "current_tracer",
     "FlightRecorder", "StepRecord", "rank_path",
     "HangWatchdog",
+    "HeartbeatWriter", "StragglerDetector", "StragglerReport",
+    "StragglerWatch", "read_heartbeats",
     "debug_nans", "debug_nans_enabled", "nan_probe", "first_nan",
     "reset_nan_state",
 ]

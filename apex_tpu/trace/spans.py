@@ -325,10 +325,20 @@ class Tracer:
 
         One complete-duration ("ph": "X") event per span plus one per
         step; pid is the process rank so multi-host dumps merge into one
-        per-rank-track view.
+        per-rank-track view, and ``process_name``/``thread_name``
+        metadata events ("ph": "M") label each rank's track ("rank N")
+        — without them a multi-rank Perfetto merge shows N anonymous
+        pid tracks whose spans visually collide.
         """
         r = _rank() if rank is None else rank
-        events: List[Dict] = []
+        events: List[Dict] = [
+            {"name": "process_name", "ph": "M", "pid": r, "tid": 0,
+             "args": {"name": f"rank {r}"}},
+            {"name": "process_sort_index", "ph": "M", "pid": r, "tid": 0,
+             "args": {"sort_index": r}},
+            {"name": "thread_name", "ph": "M", "pid": r, "tid": 0,
+             "args": {"name": f"rank {r} steps"}},
+        ]
         with self._lock:
             for st in self.steps:
                 if st.dur_ms is not None:

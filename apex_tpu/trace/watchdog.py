@@ -104,6 +104,10 @@ class HangWatchdog:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fire_count = 0
+        #: early-warning tier (straggler detection): the last warning
+        #: event received and how many arrived — see :meth:`early_warning`
+        self.last_warning: Optional[Dict] = None
+        self.warning_count = 0
 
     # -- heartbeat -----------------------------------------------------------
 
@@ -111,6 +115,22 @@ class HangWatchdog:
         """Mark a completed step — re-arms the deadline (thread-safe)."""
         self._last_step = step
         self._beat = time.monotonic()
+
+    def early_warning(self, event: Dict) -> None:
+        """The tier BELOW the hard deadline: a peer subsystem (the
+        straggler detector, :class:`apex_tpu.trace.StragglerWatch`)
+        reports degraded-but-alive progress. Records the event and
+        invokes the ``on_fire`` alerting hook (tagged
+        ``reason="early-warning"``) — never ``on_stall``: steps are
+        still landing, so escalation (checkpoint + exit) would turn a
+        slow run into a dead one. Thread-safe, never raises."""
+        self.last_warning = dict(event)
+        self.warning_count += 1
+        if self.on_fire is not None:
+            try:
+                self.on_fire(dict(event, reason="early-warning"))
+            except Exception:
+                pass
 
     # -- lifecycle -----------------------------------------------------------
 

@@ -15,7 +15,13 @@ silently retracing per call explodes this column),
 compiled headline step — see apex_tpu.lint / docs/linting.md), and
 ``ckpt_save_stall_ms`` (per-step stall of an async apex_tpu.ckpt
 snapshot vs a synchronous save — the checkpoint-overhead claim of
-docs/checkpointing.md as a measured column).
+docs/checkpointing.md as a measured column), ``goodput_frac`` (the
+steady-state useful-time fraction of the instrumented headline step
+with its wall-time bucket breakdown — apex_tpu.monitor.GoodputLedger,
+closure asserted by ``scripts/goodput_audit.py --cpu8``), and
+``link_fit`` (measured alpha-beta link calibration of the local device
+mesh — apex_tpu.monitor.linkbench / ``scripts/link_probe.py``;
+single-device hosts skip).
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
@@ -498,6 +504,26 @@ def run_all():
     except Exception as e:
         loader_note = (f"- Input pipeline headroom: loader row failed "
                        f"({type(e).__name__}).")
+    try:
+        gp = _goodput_row(batches[-1], size)
+        lf = _link_fit_row()
+        lf_txt = (f"{lf['bytes_per_s'] / 1e9:.3f} GB/s measured over "
+                  f"{lf['n_devices']} local devices (alpha "
+                  f"{lf['alpha_us']:.0f} us, residual "
+                  f"{lf['residual']:.3f})" if "bytes_per_s" in lf
+                  else lf.get("skipped", lf.get("failed", "n/a")))
+        goodput_note = (
+            f"- Goodput + link calibration ({host}): steady-state "
+            f"`goodput_frac` {gp['goodput_frac']:.1%} on the "
+            f"instrumented headline step (attribution closure "
+            f"{'OK' if gp['closure_ok'] else 'BROKEN'}, worst step "
+            f"{gp['worst_closure_err']:.2%}; buckets in default bench "
+            f"JSON); `link_fit`: {lf_txt}. Per-step decomposition: "
+            f"apex_tpu.monitor.GoodputLedger; measured MeshModel: "
+            f"scripts/link_probe.py (docs/monitoring.md#goodput).")
+    except Exception as e:
+        goodput_note = (f"- Goodput + link calibration: row failed "
+                        f"({type(e).__name__}).")
 
     dev = getattr(jax.devices()[0], "device_kind", "?")
     lines = [
@@ -538,6 +564,7 @@ def run_all():
         "the documented operating point).",
         ckpt_note,
         loader_note,
+        goodput_note,
     ]
     open("BENCH_TABLE.md", "w").write("\n".join(lines) + "\n")
     print("\n".join(lines))
@@ -752,6 +779,66 @@ def _loader_row(workers=(1, 2, 4, 8, 16), batch: int = 32,
     return curve
 
 
+def _goodput_row(batch: int, size: int, steps: int = 4):
+    """The ``goodput_frac`` column: drive the headline step a few
+    instrumented steps under a Tracer + GoodputLedger (the same
+    host-span pattern as ``--trace``) and report the steady-state
+    goodput fraction with its bucket breakdown and the attribution-
+    closure check (docs/monitoring.md#goodput). Step 0 is excluded
+    from the fraction — it folds the trace+compile into the
+    ``recompile`` bucket by design."""
+    from apex_tpu import monitor, trace
+
+    step, (state, batch_stats), (x, y) = _resnet_step_builder(batch, size)
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    tracer = trace.Tracer()
+    ledger = monitor.GoodputLedger(tracer)
+    with tracer:
+        for i in range(steps):
+            with trace.step(i):
+                with trace.span("dispatch"):
+                    state, batch_stats, loss = jstep(state, batch_stats,
+                                                     x, y)
+                with trace.span("fetch"):
+                    float(np.asarray(loss))
+    ok, worst = ledger.check_closure()
+    tail = ledger.steps[1:] or ledger.steps
+    fracs = [r.goodput_frac for r in tail if r.goodput_frac is not None]
+    frac = sum(fracs) / len(fracs) if fracs else None
+    return {"goodput_frac": round(frac, 4) if frac is not None else None,
+            "closure_ok": bool(ok),
+            "worst_closure_err": round(worst, 6),
+            "steps": len(ledger.steps),
+            "buckets_ms": {k: round(v, 3)
+                           for k, v in ledger.steps[-1].buckets.items()}}
+
+
+def _link_fit_row():
+    """The ``link_fit`` column: a quick alpha-beta calibration of the
+    local device mesh (apex_tpu.monitor.linkbench — the same sweep
+    `scripts/link_probe.py` runs, one flat ICI axis over the local
+    devices). Single-device hosts report the skip: a link needs two
+    ends."""
+    from jax.sharding import Mesh
+
+    from apex_tpu import monitor
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return {"skipped": f"single {getattr(devs[0], 'platform', '?')} "
+                           "device — link calibration needs >= 2"}
+    template = parse_mesh_spec(f"ici{len(devs)}")
+    mesh = Mesh(np.array(devs), ("data",))
+    model, fits, _ = monitor.calibrate(mesh, template, iters=3)
+    cal = model.calibration.get("ici", {})
+    return {"link": "ici", "n_devices": len(devs),
+            "bytes_per_s": cal.get("bytes_per_s"),
+            "alpha_us": cal.get("alpha_us"),
+            "residual": cal.get("residual"),
+            "n_samples": cal.get("n_samples")}
+
+
 def _memory_row(batch: int, size: int):
     """The `peak_hbm_bytes` + `lint_findings` columns: AOT-compile the
     headline step (one compile, ZERO dispatches — the measured path is
@@ -850,6 +937,14 @@ def main():
         ckpt_row = _ckpt_row(8 if not on_tpu else 64, size)
     except Exception as e:
         ckpt_row = {"failed": type(e).__name__}
+    try:
+        goodput = _goodput_row(best_batch, size)
+    except Exception as e:
+        goodput = {"failed": type(e).__name__}
+    try:
+        link_fit = _link_fit_row()
+    except Exception as e:
+        link_fit = {"failed": type(e).__name__}
     # every trace/lowering/backend-compile the bench performed — a
     # steady-state regression (a step silently retracing per call)
     # shows up here as n_compiles exploding
@@ -894,6 +989,16 @@ def main():
                   # per-step capture stall vs a synchronous
                   # save-and-wait; apex_tpu.ckpt, docs/checkpointing.md)
                   "ckpt_save_stall_ms": ckpt_row,
+                  # steady-state goodput fraction of the instrumented
+                  # headline step + its wall-time bucket breakdown
+                  # (apex_tpu.monitor.goodput; closure asserted by
+                  # scripts/goodput_audit.py --cpu8)
+                  "goodput_frac": goodput.get("goodput_frac"),
+                  "goodput": goodput,
+                  # measured alpha-beta link calibration of the local
+                  # device mesh (apex_tpu.monitor.linkbench /
+                  # scripts/link_probe.py; single-device hosts skip)
+                  "link_fit": link_fit,
                   "bert_large_lamb": bert,
                   "ddp_comm_modes": ddp_comm},
     }))

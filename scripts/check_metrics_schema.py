@@ -55,6 +55,18 @@ observe}); a ``guard_rewind`` records a restore-and-fast-forward
 (from_step/to_step, checkpoint root, how many batches the data
 cursor skipped, how many corrupt/nonfinite candidates were rejected).
 
+``--kind goodput`` — the runtime performance-observatory channel
+(``MetricsLogger(goodput_sink=...)``; keep in lockstep with
+``apex_tpu/monitor/goodput.py``, ``trace/straggler.py`` and
+``monitor/linkbench.py``): ``kind`` in {goodput, straggler, linkfit}.
+A ``goodput`` event is one step's wall-time decomposition (wall_ms +
+the per-bucket breakdown, the goodput fraction, and the attribution
+closure error); a ``straggler`` names a persistent laggard rank (lag
+vs the median rank, robust z, consecutive flagged steps, and the
+slowest span class on the lagging rank); a ``linkfit`` records one
+link class's measured α–β calibration (latency, bytes/s, fit
+residual).
+
 ``--kind ckpt`` — the checkpoint event channel
 (``MetricsLogger(ckpt_sink=...)``; keep in lockstep with
 ``apex_tpu/ckpt/manager.py`` and ``escalate.py``): ``kind`` in
@@ -71,7 +83,7 @@ jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
-           [--kind metrics|trace|memory|lint|ckpt|guard] FILE
+           [--kind metrics|trace|memory|lint|ckpt|guard|goodput] FILE
 """
 
 from __future__ import annotations
@@ -89,7 +101,8 @@ REQUIRED = (
 COUNTERS = ("step", "overflow_count", "skip_count", "growth_count",
             "backoff_count")
 NULLABLE = ("step_time_ms", "throughput_steps_per_s", "mfu",
-            "collective_bytes", "loss", "grad_norm", "param_norm")
+            "collective_bytes", "loss", "grad_norm", "param_norm",
+            "wire_by_dtype", "logical_bytes", "wire_to_logical")
 
 # --- trace-event / crash-dump schema -----------------------------------------
 
@@ -174,6 +187,109 @@ CKPT_NULLABLE = {
     "ckpt_restore": (),
     "ckpt_escalation": ("path", "step", "exit_code"),
 }
+
+
+# --- goodput / straggler / linkfit channel schema -----------------------------
+
+GOODPUT_KINDS = ("goodput", "straggler", "linkfit")
+#: the ledger's bucket names (keep in lockstep with
+#: apex_tpu/monitor/goodput.py BUCKETS)
+GOODPUT_BUCKETS = ("compute", "exposed_comm", "input_wait",
+                   "host_callback", "ckpt_stall", "recompile",
+                   "guard_rewind", "other")
+#: link classes a linkfit may calibrate (mesh-model LINK_CLASSES)
+GOODPUT_LINKS = ("ici", "dcn")
+#: required keys per goodput-event kind (beyond "kind" itself)
+GOODPUT_REQUIRED = {
+    "goodput": ("rank", "wall_ms", "buckets_ms", "closure_err"),
+    "straggler": ("step", "rank", "lag_ms", "z", "consecutive",
+                  "n_ranks"),
+    "linkfit": ("link", "bytes_per_s", "residual", "n_samples"),
+}
+#: keys that may be null per kind (everything else non-null when present)
+GOODPUT_NULLABLE = {
+    "goodput": ("step", "goodput_frac"),
+    "straggler": ("slowest_span", "span_class", "slowest_span_ms"),
+    "linkfit": ("axis", "alpha_us"),
+}
+
+
+def check_goodput_lines(lines) -> List[str]:
+    """All goodput-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates per-step wall-time decompositions,
+    straggler warnings, and link-calibration fits."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in GOODPUT_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{GOODPUT_KINDS}, got {kind!r}")
+            continue
+        for key in GOODPUT_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = GOODPUT_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        for key in ("step", "consecutive", "n_ranks", "n_samples"):
+            _check_counter(i, rec, key, errors, what="field")
+        for dk in ("wall_ms", "closure_err", "slowest_span_ms",
+                   "wall_time", "residual", "alpha_us"):
+            v = rec.get(dk)
+            if dk not in rec or v is None:
+                continue
+            if not _is_number(v) or v < 0:
+                errors.append(f"line {i}: {dk!r} must be a non-negative "
+                              f"number, got {v!r}")
+        if kind == "goodput":
+            buckets = rec.get("buckets_ms")
+            if not isinstance(buckets, dict):
+                errors.append(f"line {i}: 'buckets_ms' must be an object")
+            else:
+                for bk, bv in buckets.items():
+                    if bk not in GOODPUT_BUCKETS:
+                        errors.append(f"line {i}: buckets_ms key {bk!r} "
+                                      f"not in {GOODPUT_BUCKETS}")
+                    if not _is_number(bv) or bv < 0:
+                        errors.append(
+                            f"line {i}: buckets_ms[{bk!r}] must be a "
+                            f"non-negative number, got {bv!r}")
+            gf = rec.get("goodput_frac")
+            if gf is not None and "goodput_frac" in rec and (
+                    not _is_number(gf) or gf < 0):
+                errors.append(f"line {i}: 'goodput_frac' must be a "
+                              f"non-negative number, got {gf!r}")
+        if kind == "straggler":
+            for dk in ("lag_ms", "z"):
+                v = rec.get(dk)
+                if v is not None and dk in rec and not _is_number(v):
+                    errors.append(f"line {i}: {dk!r} must be a number, "
+                                  f"got {v!r}")
+            for sk in ("slowest_span", "span_class"):
+                v = rec.get(sk)
+                if v is not None and sk in rec and not isinstance(v, str):
+                    errors.append(f"line {i}: {sk!r} must be a string "
+                                  f"or null, got {v!r}")
+        if kind == "linkfit":
+            link = rec.get("link")
+            if link is not None and link not in GOODPUT_LINKS:
+                errors.append(f"line {i}: 'link' must be one of "
+                              f"{GOODPUT_LINKS}, got {link!r}")
+            bps = rec.get("bytes_per_s")
+            if bps is not None and "bytes_per_s" in rec and (
+                    not _is_number(bps) or bps <= 0):
+                errors.append(f"line {i}: 'bytes_per_s' must be a "
+                              f"positive number, got {bps!r}")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
 
 
 # --- guard channel schema -----------------------------------------------------
@@ -607,7 +723,8 @@ def check_lint_lines(lines) -> List[str]:
 
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
-            "ckpt": check_ckpt_lines, "guard": check_guard_lines}
+            "ckpt": check_ckpt_lines, "guard": check_guard_lines,
+            "goodput": check_goodput_lines}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""goodput_audit — the asserting CI audit of the runtime performance
+observatory (run by ``run_tier1.sh --smoke``; exit status is the
+verdict).
+
+Four asserted legs on the 8-device CPU mesh:
+
+(a) **attribution closure**: an instrumented train loop (per-phase
+    spans, a compile watcher, a synthetic input-wait, a joined ckpt
+    capture stall) must decompose every step's measured wall time into
+    the goodput buckets with the bucket sum closing within 5% —
+    memory_budget-style, but over *time* instead of bytes. Step 0's
+    back-dated compile span must land in ``recompile`` and vanish in
+    steady state; the injected input wait and ckpt stall must land in
+    their buckets.
+
+(b) **straggler forensics**: 4 synthetic ranks heartbeat in lockstep,
+    rank 2 seeded 60 ms late with the time parked in a ``data/load``
+    span — the lockstep reader must flag EXACTLY rank 2 (hysteresis:
+    only after 3 consecutive lagging steps), name ``data/load`` as its
+    slowest span with class ``input_wait``, and feed the watchdog's
+    early-warning tier (``on_fire`` sees it, ``on_stall`` must NOT —
+    degraded progress is not a stall). The clean half of the window
+    must flag nobody.
+
+(c) **measured link calibration round-trip**: ``link_probe --cpu8``
+    emits a MeshModel JSON whose ``link_bytes_per_s`` is measured
+    (provenance in ``calibration``); ``apexlint --mesh <that file>``
+    must ingest it and report APX203's flat-DCN hop milliseconds
+    computed from the MEASURED bytes/s — not the default constant.
+
+(d) every emitted stream validates under
+    ``check_metrics_schema.py --kind goodput``.
+
+Usage: JAX_PLATFORMS=cpu python scripts/goodput_audit.py --cpu8
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_schema(path: str, kind: str = "goodput") -> None:
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "check_metrics_schema.py"),
+         "--kind", kind, path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, (
+        f"schema validation failed for {path}:\n{r.stdout}{r.stderr}")
+
+
+def audit_goodput_closure(tmp: str) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import monitor, prof, trace
+
+    print("== goodput attribution closure (8-device CPU host)")
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(key, (32, 64), jnp.float32)
+    y = jax.random.normal(jax.random.PRNGKey(1), (32, 16), jnp.float32)
+    params = {"w1": jax.random.normal(jax.random.PRNGKey(2),
+                                      (64, 64)) * 0.1,
+              "w2": jax.random.normal(jax.random.PRNGKey(3),
+                                      (64, 16)) * 0.1}
+
+    def train_step(p, x, y):
+        def loss_fn(p):
+            h = jnp.tanh(x @ p["w1"])
+            return jnp.mean(jnp.square(h @ p["w2"] - y))
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    watcher = prof.CompileWatcher()
+    watched = watcher.watch(train_step, name="train_step")
+    events_path = os.path.join(tmp, "goodput.jsonl")
+    logger = monitor.MetricsLogger(
+        sinks=[], goodput_sink=monitor.JSONLSink(events_path))
+    tracer = trace.Tracer()
+    ledger = monitor.GoodputLedger(tracer, tolerance=0.05)
+    ledger.subscribe(logger.record_goodput)
+    hb_dir = os.path.join(tmp, "hb")
+    hb = trace.HeartbeatWriter(hb_dir, rank=0)
+    tracer.subscribe(hb.on_step)
+
+    n_steps = 6
+    p = params
+    with tracer:
+        for i in range(n_steps):
+            with trace.step(i):
+                with trace.span("data/load"):
+                    time.sleep(0.004)       # synthetic input wait
+                with trace.span("dispatch"):
+                    p = watched(p, x, y)
+                with trace.span("fetch"):
+                    jax.block_until_ready(p)
+                if i == 3:
+                    # a checkpoint capture stall: real step-path time
+                    # (spent OUTSIDE any span, like Snapshotter's
+                    # capture) reported through the ckpt event
+                    # channel's record shape — noted before the step
+                    # folds so the join moves it out of the residual
+                    # into ckpt_stall (a join can only MOVE measured
+                    # time; it never invents wall clock, so closure
+                    # still holds)
+                    t0 = time.perf_counter()
+                    time.sleep(0.003)
+                    stall_ms = (time.perf_counter() - t0) * 1e3
+                    ledger.note_ckpt({"kind": "ckpt_save", "step": 3,
+                                      "stall_ms": stall_ms, "path": tmp,
+                                      "bytes": 0, "dur_ms": stall_ms})
+    logger.close()
+
+    assert len(ledger.steps) == n_steps, len(ledger.steps)
+    ok, worst = ledger.check_closure(tolerance=0.05)
+    assert ok, f"bucket sum does not close over wall time: worst " \
+               f"relative error {worst:.4f} > 0.05"
+    s0, tail = ledger.steps[0], ledger.steps[-1]
+    assert s0.buckets["recompile"] > 0, \
+        "step 0's compile span missing from the recompile bucket"
+    assert tail.buckets["recompile"] == 0, \
+        "steady-state step attributed compile time"
+    for rec in ledger.steps:
+        assert rec.buckets["input_wait"] >= 3.0, (
+            rec.step, rec.buckets)
+    joined = ledger.steps[3].buckets["ckpt_stall"]
+    assert joined >= 2.0, f"ckpt stall join missing: {joined}"
+    assert all(r.buckets["ckpt_stall"] == 0 for r in ledger.steps
+               if r.step != 3), "ckpt stall leaked into other steps"
+    gf = ledger.rolling_goodput()
+    assert gf is not None and 0.0 < gf <= 1.0, gf
+    # steady-state goodput must see through the injected waits: the
+    # compute bucket exists and the overhead buckets are nonzero
+    assert tail.buckets["compute"] > 0, tail.buckets
+    print(ledger.table())
+    print(f"  closure worst-step error {worst:.2%} (<= 5%), rolling "
+          f"goodput {gf:.1%}")
+    _run_schema(events_path)
+    print(f"  events validate (--kind goodput): {events_path}")
+
+
+def audit_straggler(tmp: str) -> None:
+    from apex_tpu import trace
+
+    print("== cross-rank straggler detection (synthetic 4-rank mesh)")
+    hb_dir = os.path.join(tmp, "straggler")
+    n_ranks, n_steps, lag_s = 4, 10, 0.060
+    t0 = 1_000_000.0
+    writers = [trace.HeartbeatWriter(hb_dir, rank=r)
+               for r in range(n_ranks)]
+    for step in range(n_steps):
+        for r, w in enumerate(writers):
+            lag = lag_s if (r == 2 and step >= 5) else 0.0
+            spans = {"dispatch": 40.0, "fetch": 3.0,
+                     "data/load": 5.0 + (lag * 1e3 if lag else 0.0)}
+            w.beat(step, dur_ms=50.0 + lag * 1e3, spans=spans,
+                   wall_time=t0 + step * 0.1 + r * 1e-4 + lag)
+
+    det = trace.StragglerDetector(hb_dir, window=10, z_threshold=4.0,
+                                  hysteresis=3, lag_floor_ms=1.0)
+    reports = det.check()
+    assert len(reports) == 1, f"want exactly rank 2 flagged, got " \
+        f"{[(r.rank, r.z) for r in reports]}"
+    rep = reports[0]
+    assert rep.rank == 2, rep
+    assert rep.consecutive >= 3, rep
+    assert rep.lag_ms > 40.0, rep
+    assert rep.slowest_span == "data/load", rep
+    assert rep.span_class == "input_wait", rep
+    print(f"  flagged rank {rep.rank}: lag {rep.lag_ms:.1f} ms "
+          f"(z={rep.z:.1f}, {rep.consecutive} consecutive), slowest "
+          f"span {rep.slowest_span!r} [{rep.span_class}]")
+
+    # hysteresis negative twin: a window ending BEFORE the injected lag
+    # has 3-in-a-row must flag nobody
+    clean_dir = os.path.join(tmp, "straggler_clean")
+    writers = [trace.HeartbeatWriter(clean_dir, rank=r)
+               for r in range(n_ranks)]
+    for step in range(n_steps):
+        for r, w in enumerate(writers):
+            lag = lag_s if (r == 2 and step == n_steps - 1) else 0.0
+            w.beat(step, dur_ms=50.0 + lag * 1e3,
+                   spans={"dispatch": 40.0},
+                   wall_time=t0 + step * 0.1 + r * 1e-4 + lag)
+    det2 = trace.StragglerDetector(clean_dir, window=10,
+                                   z_threshold=4.0, hysteresis=3,
+                                   lag_floor_ms=1.0)
+    assert det2.check() == [], "one-step blip flagged without hysteresis"
+    print("  one-step blip NOT flagged (hysteresis holds)")
+
+    # early-warning tier: the watchdog's alerting hook sees the report,
+    # its escalation hook does not
+    fired, stalled = [], []
+    wd = trace.HangWatchdog(deadline_s=3600.0, on_fire=fired.append,
+                            on_stall=stalled.append)
+    events_path = os.path.join(tmp, "straggler.jsonl")
+    from apex_tpu import monitor
+    logger = monitor.MetricsLogger(
+        sinks=[], goodput_sink=monitor.JSONLSink(events_path))
+    watch = trace.StragglerWatch(det, watchdog=wd,
+                                 event_sink=logger.record_goodput)
+    reports = watch.poll_once()
+    logger.close()
+    assert len(reports) == 1 and wd.warning_count == 1
+    assert wd.last_warning["rank"] == 2
+    assert len(fired) == 1 and fired[0]["reason"] == "early-warning"
+    assert not stalled, "early warning must never reach on_stall"
+    print("  watchdog early-warning tier fed (on_fire saw it, "
+          "on_stall did not)")
+    _run_schema(events_path)
+    print(f"  events validate (--kind goodput): {events_path}")
+
+
+def audit_link_calibration(tmp: str) -> None:
+    print("== measured link calibration -> apexlint round-trip")
+    model_path = os.path.join(tmp, "mesh_measured.json")
+    fit_events = os.path.join(tmp, "linkfit.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "link_probe.py"),
+         "--cpu8", "--out", model_path, "--jsonl", fit_events],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert r.returncode == 0, f"link_probe failed:\n{r.stdout}{r.stderr}"
+    print("  " + r.stdout.strip().splitlines()[-1])
+    _run_schema(fit_events)
+
+    model = json.load(open(model_path))
+    from apex_tpu.lint.mesh_model import DEFAULT_LINK_BYTES_PER_S
+    dcn_bps = model["link_bytes_per_s"]["dcn"]
+    assert model.get("calibration", {}).get("dcn"), \
+        "measured model carries no dcn calibration provenance"
+    assert dcn_bps > 0 and dcn_bps != DEFAULT_LINK_BYTES_PER_S["dcn"], \
+        "measured dcn bytes/s indistinguishable from the default"
+
+    lint_jsonl = os.path.join(tmp, "lint_measured.jsonl")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "apexlint.py"),
+         "--flagship", "resnet", "--mesh", model_path,
+         "--fail-on", "error", "--jsonl", lint_jsonl],
+        capture_output=True, text=True, env=env, cwd=_REPO)
+    assert r.returncode == 0, \
+        f"apexlint --mesh (measured) failed:\n{r.stdout}\n{r.stderr}"
+
+    findings = [json.loads(l) for l in open(lint_jsonl)]
+    apx203 = [f for f in findings
+              if f.get("rule") == "dcn-flat-collective"
+              and (f.get("bytes") or 0) > 1000]
+    assert apx203, "no APX203 finding with real wire bytes — the " \
+        "flat ddp sync should have fired against the 2-slice model"
+    f = max(apx203, key=lambda f: f["bytes"])
+    measured_ms = f["bytes"] / dcn_bps * 1e3
+    default_ms = f["bytes"] / DEFAULT_LINK_BYTES_PER_S["dcn"] * 1e3
+    assert f"{measured_ms:.2f} ms" in f["message"], (
+        f"APX203 hop evidence not computed from the measured bytes/s: "
+        f"wanted ~{measured_ms:.2f} ms in: {f['message']}")
+    assert f"{default_ms:.2f}" != f"{measured_ms:.2f}", (
+        "measured and default hop times coincide — the audit proves "
+        "nothing; re-run")
+    print(f"  APX203 on {f['scope']}: {f['bytes']} B -> "
+          f"{measured_ms:.2f} ms at the MEASURED {dcn_bps / 1e9:.3f} "
+          f"GB/s (default model would claim {default_ms:.2f} ms)")
+    _run_schema(lint_jsonl, kind="lint")
+
+
+def main_cpu8() -> None:
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from apex_tpu import _compat
+    _compat.request_cpu_devices(8)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        audit_goodput_closure(tmp)
+        audit_straggler(tmp)
+        audit_link_calibration(tmp)
+    print("\ngoodput audit ok")
+
+
+if __name__ == "__main__":
+    if "--cpu8" in sys.argv:
+        main_cpu8()
+    else:
+        print(__doc__)
+        sys.exit(2)

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""link_probe — measure link α–β budgets and emit a MeshModel JSON.
+
+Sweeps all-reduce / reduce-scatter / all-gather across message sizes
+per mesh axis (:mod:`apex_tpu.monitor.linkbench`), fits latency +
+inverse-bandwidth per axis, and writes a
+:class:`apex_tpu.lint.mesh_model.MeshModel` JSON whose
+``link_bytes_per_s`` is MEASURED (with the fit provenance in its
+``calibration`` block). The output is directly consumable by:
+
+- ``scripts/apexlint.py --mesh <out.json>`` — APX203's
+  flat-vs-hierarchical DCN milliseconds are then computed from the
+  measured budgets, not the ``DEFAULT_LINK_BYTES_PER_S`` constants;
+- ``scripts/pod_comm_budget.py --mesh <out.json>`` — the weak-scaling
+  ICI budget uses the measured bytes/s.
+
+Usage:
+  python scripts/link_probe.py --cpu8 [--out FILE] [--jsonl FILE]
+      # 8 virtual CPU devices factored dp2x4 (2 modeled "slices" over
+      # DCN x 4 chips over ICI) — the CI pipeline proof
+      # (run_tier1.sh --smoke); measures XLA:CPU's collective
+      # emulation, structurally identical to the on-chip run
+  python scripts/link_probe.py --tpu [--spec dpAxB|iciN] [--out FILE]
+      # the local accelerator mesh; default spec ici<N> over all
+      # local devices
+Options:
+  --out FILE     MeshModel JSON path (default MESH_MEASURED.json)
+  --jsonl FILE   also stream kind="linkfit" events (goodput channel;
+                 validate with check_metrics_schema.py --kind goodput)
+  --sizes a,b,c  message-size ladder in bytes
+  --iters N      best-of iterations per point (default 3)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    mode = None
+    out_path, jsonl_path, spec = "MESH_MEASURED.json", None, None
+    sizes, iters = None, 3
+    it = iter(argv)
+    for a in it:
+        if a in ("-h", "--help"):
+            print(__doc__)
+            return 2
+        if a == "--cpu8":
+            mode = "cpu8"
+        elif a == "--tpu":
+            mode = "tpu"
+        elif a in ("--out", "--jsonl", "--sizes", "--iters", "--spec"):
+            val = next(it, None)
+            if val is None:
+                print(f"{a} requires a value", file=sys.stderr)
+                return 2
+            if a == "--out":
+                out_path = val
+            elif a == "--jsonl":
+                jsonl_path = val
+            elif a == "--spec":
+                spec = val
+            elif a == "--sizes":
+                sizes = tuple(int(s) for s in val.split(","))
+            else:
+                iters = int(val)
+        else:
+            print(f"unknown arg {a!r}\n{__doc__}", file=sys.stderr)
+            return 2
+    if mode is None:
+        print(__doc__, file=sys.stderr)
+        return 2
+
+    if mode == "cpu8":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from apex_tpu import _compat
+        _compat.request_cpu_devices(8)
+        spec = spec or "dp2x4"
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    from apex_tpu.monitor import linkbench
+
+    devs = jax.devices()
+    if spec is None:
+        spec = f"ici{len(devs)}"
+    template = parse_mesh_spec(spec, n_devices=len(devs))
+    if template.n_devices > len(devs):
+        print(f"spec {spec!r} wants {template.n_devices} devices, "
+              f"have {len(devs)}", file=sys.stderr)
+        return 2
+    shape = tuple(a.size for a in template.axes)
+    mesh = Mesh(np.array(devs[:template.n_devices]).reshape(shape),
+                tuple(a.name for a in template.axes))
+    print(f"link_probe: {jax.default_backend()} mesh "
+          f"{dict(zip(mesh.axis_names, shape))} (spec {spec})")
+
+    kwargs = dict(iters=iters)
+    if sizes:
+        kwargs["sizes"] = sizes
+    model, fits, samples = linkbench.calibrate(mesh, template, **kwargs)
+    print(linkbench.fit_table(fits, samples))
+    for link, bps in sorted(model.link_bytes_per_s.items()):
+        cal = model.calibration.get(link)
+        src = (f"measured on axis {cal['axis']}" if cal
+               else "default (no axis of this class swept)")
+        print(f"  link {link}: {bps / 1e9:.3f} GB/s ({src})")
+
+    with open(out_path, "w") as f:
+        json.dump(model.to_json(), f, indent=2)
+        f.write("\n")
+    print(f"wrote {out_path} (consume: scripts/apexlint.py --mesh "
+          f"{out_path} | scripts/pod_comm_budget.py --mesh {out_path})")
+
+    if jsonl_path:
+        from apex_tpu import monitor
+        logger = monitor.MetricsLogger(
+            sinks=[], goodput_sink=monitor.JSONLSink(jsonl_path))
+        for ev in linkbench.linkfit_events(model):
+            logger.record_goodput(ev)
+        logger.close()
+        print(f"wrote {jsonl_path} (validate: python "
+              f"scripts/check_metrics_schema.py --kind goodput "
+              f"{jsonl_path})")
+
+    # sanity the emitted artifact: the written file must round-trip as
+    # a MeshModel (whatever the --out name — the spec grammar only
+    # file-loads *.json paths) with measured provenance on every
+    # fitted link
+    from apex_tpu.lint.mesh_model import MeshModel
+    with open(out_path) as f:
+        rt = MeshModel.from_json(json.load(f))
+    assert rt.measured and rt.calibration == model.calibration
+    for link in model.calibration:
+        assert rt.link_bytes_per_s[link] > 0
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,11 @@ Usage: python scripts/pod_comm_budget.py [--topology v5e:8x8]
            # structural variant (run_tier1.sh --smoke): asserts the
            # per-bucket all-reduce structure + bf16 wire halving without
            # TPU hardware; exit 1 on violation
+       python scripts/pod_comm_budget.py --mesh model.json
+           # budget against a (measured) MeshModel's link_bytes_per_s
+           # instead of the default constant — feed it the calibrated
+           # model `scripts/link_probe.py` emits and the weak-scaling
+           # milliseconds rest on measurements (combines with --cpu8)
 """
 
 import os
@@ -39,9 +44,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from apex_tpu.lint.mesh_model import DEFAULT_LINK_BYTES_PER_S
+
 # measured round-4/5 single-chip numbers (BENCH_TABLE.md)
 RESNET_STEP_MS = 97.9       # b=256 device-time isolated step
-ICI_BYTES_PER_S = 4.5e11    # v5e per-chip ICI bandwidth class (~450GB/s)
+#: v5e per-chip ICI bandwidth class (~450GB/s) — the ONE source of
+#: truth is the mesh model's default table (a pin test keeps this
+#: import from regressing into a re-declared copy); --mesh model.json
+#: overrides it with a link_probe-measured value
+ICI_BYTES_PER_S = DEFAULT_LINK_BYTES_PER_S["ici"]
 
 _DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "s32": 4, "u32": 4,
                 "pred": 1, "f64": 8, "s64": 8, "u64": 8, "s16": 2,
@@ -192,7 +203,22 @@ def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=256,
     return stepped.lower(state_s, bs_s, x_s, y_s), params_s
 
 
-def report(hlo, params_s, n):
+def _mesh_override(argv):
+    """(ici_bytes_per_s, model|None) from an optional ``--mesh
+    model.json`` arg — the link_probe-measured ingestion path."""
+    if "--mesh" not in argv:
+        return ICI_BYTES_PER_S, None
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    mm = parse_mesh_spec(argv[argv.index("--mesh") + 1])
+    src = "measured" if mm.measured else "declared"
+    print(f"link budget from {src} mesh model {mm!r}: "
+          f"ici {mm.link_bytes_per_s['ici'] / 1e9:.3f} GB/s")
+    return mm.link_bytes_per_s["ici"], mm
+
+
+def report(hlo, params_s, n, ici_bytes_per_s=None):
+    if ici_bytes_per_s is None:
+        ici_bytes_per_s = ICI_BYTES_PER_S
     colls = collectives(hlo)
     n_params = sum(int(np.prod(l.shape))
                    for l in jax.tree_util.tree_leaves(params_s))
@@ -212,12 +238,12 @@ def report(hlo, params_s, n):
             ici += 2 * (n - 1) / n * nbytes
         elif op in ("reduce-scatter", "all-gather"):
             ici += (n - 1) / n * nbytes
-    t_ms = ici / ICI_BYTES_PER_S * 1e3
+    t_ms = ici / ici_bytes_per_s * 1e3
     eff = RESNET_STEP_MS / (RESNET_STEP_MS + t_ms)
     print(f"  param bytes (fp32 grads): {grad_bytes / 2 ** 20:.1f} MiB; "
           f"reduced bytes: {total_red / 2 ** 20:.1f} MiB")
     print(f"  ring ICI traffic/chip/step: {ici / 2 ** 20:.1f} MiB "
-          f"-> {t_ms:.2f} ms at {ICI_BYTES_PER_S / 1e9:.0f} GB/s")
+          f"-> {t_ms:.2f} ms at {ici_bytes_per_s / 1e9:.1f} GB/s")
     print(f"  unoverlapped weak-scaling efficiency vs "
           f"{RESNET_STEP_MS} ms step: {eff * 100:.1f}%")
 
@@ -335,12 +361,13 @@ def main():
     n = len(topo.devices)
     mesh = Mesh(np.array(topo.devices), (parallel.DATA_AXIS,))
     print(f"AOT target: {topology} ({n} chips)")
+    ici_bps, _ = _mesh_override(sys.argv)
 
     for label, kw in _flagship_modes():
         print(f"\nDDP {label}:")
         lowered, params_s = lower_flagship(mesh, n, **kw)
         hlo = lowered.compile().as_text()
-        report(hlo, params_s, n)
+        report(hlo, params_s, n, ici_bytes_per_s=ici_bps)
         if kw.get("bucket_allreduce"):
             leaves = jax.tree_util.tree_leaves(params_s)
             print_overlap(hlo, leaves, kw["message_size"])
@@ -365,6 +392,7 @@ def main_cpu8():
     model = models.ResNet(stage_sizes=[1, 1], num_classes=10, width=16,
                           dtype=jnp.bfloat16)
     message_size = 30_000
+    _mesh_override(sys.argv)      # prints the measured budget if given
 
     print("overlap audit, 8-device CPU mesh (structural variant)")
     for label, kw in (

@@ -21,6 +21,20 @@
 #                                   # + the cross-rank SPMD congruence
 #                                   #   audit (--mesh dp2x4 on the
 #                                   #   cpu8 mesh, --fail-on error)
+#                                   # + the link probe (--cpu8): sweep
+#                                   #   collectives per mesh axis, fit
+#                                   #   alpha-beta, emit a MEASURED
+#                                   #   MeshModel JSON
+#                                   # + the goodput audit (--cpu8):
+#                                   #   per-step bucket attribution
+#                                   #   closes over wall time within
+#                                   #   5%, a seeded synthetic slow
+#                                   #   rank is named with its slowest
+#                                   #   span class, and the measured
+#                                   #   model round-trips through
+#                                   #   apexlint --mesh with APX203 hop
+#                                   #   evidence from the measured
+#                                   #   bytes/s
 #
 # Exit status is pytest's (or the first failing smoke step). The full
 # run prints DOTS_PASSED=<n> — the count of passing-test dots the driver
@@ -126,6 +140,29 @@ EOF
     echo "== smoke: lint schema validator on the cross-rank stream"
     python scripts/check_metrics_schema.py --kind lint \
         "$tmp/lint_mesh.jsonl"
+
+    echo "== smoke: link probe (8-device CPU mesh, measured MeshModel)"
+    # sweeps all-reduce/reduce-scatter/all-gather per mesh axis, fits
+    # alpha-beta, and emits a MeshModel JSON with MEASURED
+    # link_bytes_per_s + calibration provenance; the emitted stream
+    # validates under --kind goodput and the artifact self-checks its
+    # round-trip through parse_mesh_spec
+    JAX_PLATFORMS=cpu python scripts/link_probe.py --cpu8 \
+        --out "$tmp/mesh_measured.json" --jsonl "$tmp/linkfit.jsonl"
+    python scripts/check_metrics_schema.py --kind goodput \
+        "$tmp/linkfit.jsonl"
+
+    echo "== smoke: goodput attribution + straggler + calibration audit"
+    # asserts: (a) the goodput ledger's bucket sum closes over each
+    # step's measured wall time within 5% (recompile bucket present on
+    # step 0 only; injected input-wait and joined ckpt stall land in
+    # their buckets), (b) a seeded synthetic slow rank is flagged with
+    # hysteresis and named with its slowest span class, feeding the
+    # watchdog's early-warning tier, (c) link_probe's measured
+    # MeshModel round-trips through apexlint --mesh with APX203 hop
+    # milliseconds computed from the MEASURED bytes/s, (d) every
+    # stream passes --kind goodput
+    JAX_PLATFORMS=cpu python scripts/goodput_audit.py --cpu8
 
     echo "smoke ok"
     exit 0

@@ -1,0 +1,574 @@
+"""Goodput ledger + cross-rank straggler detection + link calibration.
+
+The ISSUE-9 contract: the ledger's bucket sum closes over measured
+wall time (nested/overlapping spans never double-count; joins MOVE
+time, never invent it), back-dated compile spans land in ``recompile``,
+a seeded persistent laggard is flagged with hysteresis and named with
+its slowest span class (negative twin: a one-step blip is not), the
+α–β fit recovers synthetic link parameters and survives noisy negative
+slopes, the calibrated MeshModel round-trips through JSON with its
+measurement provenance, the goodput/straggler/linkfit event schema
+validates with negative twins, the link constant is single-sourced
+(``pod_comm_budget`` imports it from ``mesh_model``), and the stdout
+table shows the per-dtype logical-vs-wire split.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import monitor, trace
+from apex_tpu.monitor.goodput import BUCKETS, GoodputLedger, classify_span
+from apex_tpu.trace.spans import SpanEvent, StepTrace
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _schema():
+    from scripts.check_metrics_schema import check_goodput_lines
+    return check_goodput_lines
+
+
+def _mk_step(step, wall_ms, spans):
+    """StepTrace with (name, kind, t_start_s, dur_ms, depth) spans."""
+    st = StepTrace(step, 0.0)
+    st.dur_ms = wall_ms
+    for name, kind, t0, dur, depth in spans:
+        st.spans.append(SpanEvent(name, kind, t0, dur, depth))
+    return st
+
+
+# --- bucket classification ---------------------------------------------------
+
+def test_classify_span():
+    assert classify_span("anything", "collective") == "exposed_comm"
+    assert classify_span("compile/train_step", "compile") == "recompile"
+    assert classify_span("data/load") == "input_wait"
+    assert classify_span("input/decode") == "input_wait"
+    assert classify_span("loader") == "input_wait"
+    assert classify_span("fetch") == "host_callback"
+    assert classify_span("host/sync") == "host_callback"
+    assert classify_span("ckpt/capture") == "ckpt_stall"
+    assert classify_span("guard/rewind") == "guard_rewind"
+    assert classify_span("dispatch") == "compute"
+    assert classify_span("fwd") == "compute"
+
+
+# --- attribution sweep -------------------------------------------------------
+
+class TestAttribution:
+    def test_nested_spans_never_double_count(self):
+        """A 4ms data/load nested inside a 10ms dispatch: the sweep
+        gives the child its 4ms and the parent only its 6ms of self
+        time — the sum closes exactly."""
+        ledger = GoodputLedger(rank=0)
+        st = _mk_step(0, 12.0, [
+            ("data/load", "span", 0.002, 4.0, 1),   # child (ends first)
+            ("dispatch", "span", 0.000, 10.0, 0),
+        ])
+        ledger.on_step(st)
+        rec = ledger.steps[0]
+        assert rec.buckets["input_wait"] == pytest.approx(4.0)
+        assert rec.buckets["compute"] == pytest.approx(6.0)
+        assert rec.buckets["other"] == pytest.approx(2.0)
+        assert sum(rec.buckets.values()) == pytest.approx(12.0)
+        assert rec.closure_error() < 1e-9
+
+    def test_overlapping_backdated_span(self):
+        """A back-dated compile span overlapping the dispatch span:
+        deepest/latest wins per instant, no instant counted twice."""
+        ledger = GoodputLedger(rank=0)
+        st = _mk_step(0, 10.0, [
+            ("dispatch", "span", 0.0, 10.0, 0),
+            # back-dated over [2ms, 8ms), deeper orderless overlap
+            ("compile/step", "compile", 0.002, 6.0, 1),
+        ])
+        ledger.on_step(st)
+        rec = ledger.steps[0]
+        assert rec.buckets["recompile"] == pytest.approx(6.0)
+        assert rec.buckets["compute"] == pytest.approx(4.0)
+        assert sum(rec.buckets.values()) == pytest.approx(10.0)
+
+    def test_collective_span_is_exposed_comm(self):
+        ledger = GoodputLedger(rank=0)
+        st = _mk_step(1, 5.0, [
+            ("ddp/sync_gradients", "collective", 0.0, 3.0, 0)])
+        ledger.on_step(st)
+        assert ledger.steps[0].buckets["exposed_comm"] == \
+            pytest.approx(3.0)
+        assert ledger.steps[0].buckets["other"] == pytest.approx(2.0)
+
+    def test_uncovered_wall_is_other(self):
+        ledger = GoodputLedger(rank=0)
+        ledger.on_step(_mk_step(0, 8.0, []))
+        rec = ledger.steps[0]
+        assert rec.buckets["other"] == pytest.approx(8.0)
+        assert rec.goodput_frac == 0.0
+
+    def test_overattribution_breaks_closure(self):
+        """Spans claiming more time than the step's wall (a clock bug)
+        must FAIL the closure check, not silently normalize — the 5%
+        audit exists to catch exactly this."""
+        ledger = GoodputLedger(rank=0, tolerance=0.05)
+        ledger.on_step(_mk_step(0, 5.0, [
+            ("dispatch", "span", 0.0, 9.0, 0)]))
+        ok, worst = ledger.check_closure()
+        assert not ok and worst > 0.5
+
+
+# --- event-channel joins -----------------------------------------------------
+
+class TestJoins:
+    def test_ckpt_stall_moves_time(self):
+        """A joined stall comes OUT of the residual/compute — the sum
+        still closes over the measured wall."""
+        ledger = GoodputLedger(rank=0)
+        ledger.note_ckpt({"kind": "ckpt_save", "step": 0,
+                          "stall_ms": 3.0})
+        ledger.on_step(_mk_step(0, 10.0, [
+            ("dispatch", "span", 0.0, 2.0, 0)]))
+        rec = ledger.steps[0]
+        assert rec.buckets["ckpt_stall"] == pytest.approx(3.0)
+        assert rec.buckets["other"] == pytest.approx(5.0)
+        assert sum(rec.buckets.values()) == pytest.approx(10.0)
+
+    def test_join_drains_residual_before_compute(self):
+        """A stall spent OUTSIDE every span sits in the residual — the
+        join must take it from `other` and leave compute's measured
+        span time untouched (draining compute first would under-report
+        goodput while the stall silently stayed in the residual)."""
+        ledger = GoodputLedger(rank=0)
+        ledger.note_ckpt({"kind": "ckpt_save", "step": 0,
+                          "stall_ms": 5.0})
+        ledger.on_step(_mk_step(0, 100.0, [
+            ("dispatch", "span", 0.0, 90.0, 0)]))
+        rec = ledger.steps[0]
+        assert rec.buckets["compute"] == pytest.approx(90.0)
+        assert rec.buckets["ckpt_stall"] == pytest.approx(5.0)
+        assert rec.buckets["other"] == pytest.approx(5.0)
+        assert rec.goodput_frac == pytest.approx(0.9)
+
+    def test_join_never_exceeds_wall(self):
+        """An oversized stall claim is clamped to the measured time —
+        the ledger never invents wall clock."""
+        ledger = GoodputLedger(rank=0)
+        ledger.note_ckpt({"kind": "ckpt_save", "step": 0,
+                          "stall_ms": 100.0})
+        ledger.on_step(_mk_step(0, 4.0, []))
+        rec = ledger.steps[0]
+        assert rec.buckets["ckpt_stall"] == pytest.approx(4.0)
+        assert sum(rec.buckets.values()) == pytest.approx(4.0)
+
+    def test_post_fold_event_attaches_to_next_step(self):
+        ledger = GoodputLedger(rank=0)
+        ledger.on_step(_mk_step(0, 5.0, []))
+        ledger.note_ckpt({"kind": "ckpt_save", "step": 0,
+                          "stall_ms": 2.0})
+        ledger.on_step(_mk_step(1, 5.0, []))
+        assert ledger.steps[0].buckets["ckpt_stall"] == 0.0
+        assert ledger.steps[1].buckets["ckpt_stall"] == \
+            pytest.approx(2.0)
+
+    def test_guard_join_and_non_events_ignored(self):
+        ledger = GoodputLedger(rank=0)
+        ledger.note_guard({"kind": "guard_rewind", "step": 0,
+                           "dur_ms": 1.5})
+        ledger.note_guard({"kind": "guard_anomaly", "step": 0, "z": 9.0})
+        ledger.note_ckpt({"kind": "ckpt_restore", "step": 0,
+                          "dur_ms": 50.0})          # not a save: ignored
+        ledger.on_step(_mk_step(0, 6.0, []))
+        rec = ledger.steps[0]
+        assert rec.buckets["guard_rewind"] == pytest.approx(1.5)
+        assert rec.buckets["ckpt_stall"] == 0.0
+
+
+# --- live tracer integration -------------------------------------------------
+
+def test_tracer_integration_and_rolling_goodput():
+    tracer = trace.Tracer()
+    ledger = GoodputLedger(tracer, window=8, rank=0)
+    seen = []
+    ledger.subscribe(seen.append)
+    with tracer:
+        for i in range(3):
+            with trace.step(i):
+                with trace.span("dispatch"):
+                    time.sleep(0.003)
+                with trace.span("fetch"):
+                    time.sleep(0.001)
+    assert len(ledger.steps) == 3 and len(seen) == 3
+    ok, worst = ledger.check_closure()
+    assert ok, worst
+    gf = ledger.rolling_goodput()
+    assert gf is not None and 0.3 < gf <= 1.0
+    for rec in ledger.steps:
+        assert rec.buckets["compute"] >= 2.5
+        assert rec.buckets["host_callback"] >= 0.8
+    table = ledger.table()
+    assert "goodput" in table and "total" in table
+    ev = seen[-1]
+    assert ev["kind"] == "goodput" and ev["step"] == 2
+    assert set(ev["buckets_ms"]) == set(BUCKETS)
+
+
+def test_backdated_compile_span_lands_in_recompile():
+    tracer = trace.Tracer()
+    ledger = GoodputLedger(tracer, rank=0)
+    with tracer:
+        with trace.step(0):
+            with trace.span("dispatch"):
+                time.sleep(0.004)
+                # what compile_watch does after a traced dispatch
+                tracer.add_span_event("compile/train_step", "compile",
+                                      3.0)
+    rec = ledger.steps[0]
+    assert rec.buckets["recompile"] >= 2.5
+    assert rec.closure_error() < 0.05
+
+
+# --- goodput event schema ----------------------------------------------------
+
+class TestGoodputSchema:
+    def test_valid_stream(self):
+        check = _schema()
+        ledger = GoodputLedger(rank=0)
+        ledger.on_step(_mk_step(0, 5.0, [
+            ("dispatch", "span", 0.0, 4.0, 0)]))
+        lines = [json.dumps(e) for e in ledger.to_events()]
+        lines.append(json.dumps(
+            {"kind": "straggler", "step": 4, "rank": 2, "lag_ms": 61.0,
+             "z": 12.0, "consecutive": 3, "slowest_span": "data/load",
+             "span_class": "input_wait", "slowest_span_ms": 60.0,
+             "n_ranks": 4, "wall_time": time.time()}))
+        lines.append(json.dumps(
+            {"kind": "linkfit", "link": "dcn", "axis": "data_inter",
+             "alpha_us": 1500.0, "bytes_per_s": 1.4e8,
+             "residual": 0.2, "n_samples": 9, "rank": 0,
+             "wall_time": time.time()}))
+        assert check(lines) == []
+
+    def test_negative_twins(self):
+        check = _schema()
+        base_g = {"kind": "goodput", "step": 0, "rank": 0,
+                  "wall_ms": 5.0, "closure_err": 0.0,
+                  "buckets_ms": {"compute": 5.0}, "goodput_frac": 1.0}
+        assert check([json.dumps(base_g)]) == []
+        # unknown kind
+        assert check([json.dumps(dict(base_g, kind="speed"))])
+        # unknown bucket name
+        bad = dict(base_g, buckets_ms={"gpu_time": 5.0})
+        assert check([json.dumps(bad)])
+        # negative wall
+        assert check([json.dumps(dict(base_g, wall_ms=-1.0))])
+        # missing required buckets_ms
+        m = dict(base_g)
+        del m["buckets_ms"]
+        assert check([json.dumps(m)])
+        # straggler: negative consecutive, bad link class, zero bandwidth
+        s = {"kind": "straggler", "step": 1, "rank": 0, "lag_ms": 5.0,
+             "z": 9.0, "consecutive": -1, "n_ranks": 4}
+        assert check([json.dumps(s)])
+        lf = {"kind": "linkfit", "link": "nvlink", "bytes_per_s": 1.0,
+              "residual": 0.1, "n_samples": 3}
+        assert check([json.dumps(lf)])
+        lf2 = {"kind": "linkfit", "link": "ici", "bytes_per_s": 0,
+               "residual": 0.1, "n_samples": 3}
+        assert check([json.dumps(lf2)])
+        # null where not allowed
+        assert check([json.dumps(dict(base_g, wall_ms=None))])
+
+    def test_logger_channel_nulls_nonfinite(self, tmp_path):
+        p = tmp_path / "gp.jsonl"
+        logger = monitor.MetricsLogger(
+            sinks=[], goodput_sink=monitor.JSONLSink(str(p)))
+        logger.record_goodput(
+            {"kind": "goodput", "step": 0, "rank": 0, "wall_ms": 1.0,
+             "closure_err": 0.0, "goodput_frac": float("nan"),
+             "buckets_ms": {b: (float("inf") if b == "other" else 0.0)
+                            for b in BUCKETS}})
+        logger.close()
+        rec = json.loads(p.read_text())
+        assert rec["goodput_frac"] is None
+        assert rec["buckets_ms"]["other"] is None
+
+
+# --- straggler detection -----------------------------------------------------
+
+def _write_beats(d, n_ranks=4, n_steps=10, slow_rank=None,
+                 slow_from=5, lag_s=0.06):
+    writers = [trace.HeartbeatWriter(str(d), rank=r)
+               for r in range(n_ranks)]
+    t0 = 1_000.0
+    for step in range(n_steps):
+        for r, w in enumerate(writers):
+            lag = lag_s if (slow_rank == r and step >= slow_from) else 0.0
+            spans = {"dispatch": 40.0,
+                     "data/load": 5.0 + lag * 1e3}
+            w.beat(step, dur_ms=50.0 + lag * 1e3, spans=spans,
+                   wall_time=t0 + step * 0.1 + r * 1e-4 + lag)
+    return writers
+
+
+class TestStraggler:
+    def test_heartbeat_roundtrip_skips_torn_tail(self, tmp_path):
+        w = trace.HeartbeatWriter(str(tmp_path), rank=3)
+        w.beat(0, dur_ms=10.0, spans={"fwd": 8.0})
+        # a live writer's torn partial line must not break the reader
+        with open(w.path, "a") as f:
+            f.write('{"step": 1, "rank": 3, "wall_')
+        beats = trace.read_heartbeats(str(tmp_path))
+        assert set(beats) == {3} and set(beats[3]) == {0}
+        assert beats[3][0]["spans"]["fwd"] == 8.0
+        assert w.n_written == 1 and w.n_dropped == 0
+
+    def test_persistent_laggard_named_with_span_class(self, tmp_path):
+        _write_beats(tmp_path, slow_rank=2)
+        det = trace.StragglerDetector(str(tmp_path), window=10,
+                                      z_threshold=4.0, hysteresis=3,
+                                      lag_floor_ms=1.0)
+        reports = det.check()
+        assert [r.rank for r in reports] == [2]
+        rep = reports[0]
+        assert rep.consecutive >= 3 and rep.lag_ms > 40.0
+        assert rep.slowest_span == "data/load"
+        assert rep.span_class == "input_wait"
+        assert rep.n_ranks == 4
+        ev = rep.to_event()
+        assert _schema()([json.dumps(ev)]) == []
+
+    def test_blip_not_flagged_hysteresis(self, tmp_path):
+        # only the single newest step lags: below hysteresis=3
+        _write_beats(tmp_path, slow_rank=2, slow_from=9)
+        det = trace.StragglerDetector(str(tmp_path), window=10,
+                                      hysteresis=3)
+        assert det.check() == []
+
+    def test_clock_skew_not_flagged(self, tmp_path):
+        """A rank whose host wall clock runs 50 ms ahead writes late
+        arrival times every step while making identical progress — the
+        duration-based lag must NOT flag it (arrival comparison is
+        only the fallback for duration-less beats)."""
+        writers = [trace.HeartbeatWriter(str(tmp_path), rank=r)
+                   for r in range(4)]
+        for step in range(10):
+            for r, w in enumerate(writers):
+                skew = 0.050 if r == 2 else 0.0   # constant clock offset
+                w.beat(step, dur_ms=50.0, spans={"dispatch": 40.0},
+                       wall_time=1000.0 + step * 0.1 + skew)
+        det = trace.StragglerDetector(str(tmp_path), window=10,
+                                      z_threshold=4.0, hysteresis=3)
+        assert det.check() == [], "constant clock offset misread as lag"
+
+    def test_healthy_mesh_and_single_rank_quiet(self, tmp_path):
+        _write_beats(tmp_path / "healthy", slow_rank=None)
+        assert trace.StragglerDetector(
+            str(tmp_path / "healthy")).check() == []
+        solo = tmp_path / "solo"
+        trace.HeartbeatWriter(str(solo), rank=0).beat(0)
+        assert trace.StragglerDetector(str(solo)).check() == []
+
+    def test_watch_feeds_watchdog_early_warning(self, tmp_path):
+        _write_beats(tmp_path, slow_rank=1)
+        det = trace.StragglerDetector(str(tmp_path), hysteresis=3)
+        fired, stalled, events = [], [], []
+        wd = trace.HangWatchdog(deadline_s=3600.0,
+                                on_fire=fired.append,
+                                on_stall=stalled.append)
+        watch = trace.StragglerWatch(det, watchdog=wd,
+                                     event_sink=events.append,
+                                     renotify_s=60.0)
+        assert [r.rank for r in watch.poll_once()] == [1]
+        assert wd.warning_count == 1 and wd.last_warning["rank"] == 1
+        assert fired and fired[0]["reason"] == "early-warning"
+        assert not stalled, "early warning must never escalate"
+        assert events and events[0]["kind"] == "straggler"
+        # renotify window suppresses the duplicate
+        watch.poll_once()
+        assert wd.warning_count == 1 and len(events) == 1
+
+    def test_tracer_subscription_writes_beats(self, tmp_path):
+        tracer = trace.Tracer()
+        hb = trace.HeartbeatWriter(str(tmp_path), rank=0)
+        tracer.subscribe(hb.on_step)
+        with tracer:
+            with trace.step(0):
+                with trace.span("fwd"):
+                    pass
+        beats = trace.read_heartbeats(str(tmp_path))
+        assert 0 in beats[0] and "fwd" in beats[0][0]["spans"]
+
+
+# --- link calibration --------------------------------------------------------
+
+class TestLinkbench:
+    def test_fit_recovers_synthetic_alpha_beta(self):
+        from apex_tpu.monitor.linkbench import LinkSample, fit_alpha_beta
+        alpha, bps = 1e-3, 2e9
+        samples = [LinkSample("all_reduce", "data", b, float(b),
+                              alpha + b / bps)
+                   for b in (1 << 14, 1 << 17, 1 << 20, 1 << 23)]
+        fit = fit_alpha_beta(samples)
+        assert fit.alpha_s == pytest.approx(alpha, rel=1e-6)
+        assert fit.bytes_per_s == pytest.approx(bps, rel=1e-6)
+        assert fit.residual < 1e-9
+        assert fit.seconds(1 << 20) == pytest.approx(
+            alpha + (1 << 20) / bps, rel=1e-6)
+
+    def test_fit_clamps_negative_slope(self):
+        from apex_tpu.monitor.linkbench import LinkSample, fit_alpha_beta
+        # pathological: bigger messages measured FASTER (noise)
+        samples = [LinkSample("all_reduce", "data", b, float(b), t)
+                   for b, t in ((1000, 2e-3), (100000, 1e-3))]
+        fit = fit_alpha_beta(samples)
+        assert fit.bytes_per_s > 0 and np.isfinite(fit.residual)
+
+    @pytest.mark.slow
+    def test_calibrate_cpu8_mesh(self, devices):
+        from jax.sharding import Mesh
+
+        from apex_tpu.lint.mesh_model import MeshModel, parse_mesh_spec
+        from apex_tpu.monitor import linkbench
+
+        template = parse_mesh_spec("dp2x4")
+        mesh = Mesh(np.array(devices).reshape(2, 4),
+                    ("data_inter", "data_intra"))
+        model, fits, samples = linkbench.calibrate(
+            mesh, template, sizes=(1 << 10, 1 << 13), iters=1)
+        assert model.measured
+        assert set(fits) == {"data_inter", "data_intra"}
+        for link in ("ici", "dcn"):
+            assert model.link_bytes_per_s[link] > 0
+            assert model.calibration[link]["n_samples"] == 6
+        # the emitted table round-trips with provenance intact
+        rt = MeshModel.from_json(json.dumps(model.to_json()))
+        assert rt.measured and rt.calibration == model.calibration
+        assert rt.link_bytes_per_s == model.link_bytes_per_s
+        events = linkbench.linkfit_events(model, rank=0)
+        assert len(events) == 2
+        assert _schema()([json.dumps(e) for e in events]) == []
+        table = linkbench.fit_table(fits, samples)
+        assert "data_intra" in table and "GB/s" in table
+
+    def test_all_gather_moves_the_recorded_payload(self, devices):
+        """The all_gather probe's GLOBAL input is the full logical
+        buffer (shard_map's in_specs shard it): the gathered output
+        must be elems elements, so the recorded size_bytes is really
+        what the collective rebuilt — a sliced input would move N×
+        fewer bytes than the LinkSample claims and corrupt the fit."""
+        from jax.sharding import Mesh
+
+        from apex_tpu.monitor.linkbench import _collective
+
+        mesh = Mesh(np.array(devices), ("data",))
+        fn = _collective("all_gather", mesh, "data")
+        elems = 1024
+        out = fn(jnp.arange(elems, dtype=jnp.float32))
+        assert out.shape == (elems,)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.arange(elems, dtype=np.float32))
+
+    def test_calibrate_rejects_mismatched_mesh(self, devices):
+        from jax.sharding import Mesh
+
+        from apex_tpu.lint.mesh_model import parse_mesh_spec
+        from apex_tpu.monitor import linkbench
+
+        template = parse_mesh_spec("dp2x4")
+        mesh = Mesh(np.array(devices).reshape(4, 2),
+                    ("data_inter", "data_intra"))
+        with pytest.raises(ValueError, match="template size"):
+            linkbench.calibrate(mesh, template)
+
+    def test_mesh_model_calibration_json(self):
+        from apex_tpu.lint.mesh_model import MeshAxis, MeshModel
+        mm = MeshModel((MeshAxis("s", 2, "dcn"), MeshAxis("d", 4)),
+                       link_bytes_per_s={"dcn": 1.2e8},
+                       calibration={"dcn": {"axis": "s",
+                                            "bytes_per_s": 1.2e8,
+                                            "alpha_us": 900.0,
+                                            "residual": 0.1,
+                                            "n_samples": 6}})
+        assert mm.measured
+        rt = MeshModel.from_json(mm.to_json())
+        assert rt.measured and rt.calibration == mm.calibration
+        plain = MeshModel((MeshAxis("d", 8),))
+        assert not plain.measured
+        assert "calibration" not in plain.to_json()
+
+
+# --- satellites --------------------------------------------------------------
+
+def test_link_constant_single_source():
+    """scripts/pod_comm_budget.py must IMPORT its ICI constant from the
+    mesh model's default table — a re-declared literal copy can
+    silently diverge (the bug this pin exists to prevent)."""
+    import importlib.util as _util
+
+    from apex_tpu.lint.mesh_model import DEFAULT_LINK_BYTES_PER_S
+    path = os.path.join(_REPO_ROOT, "scripts", "pod_comm_budget.py")
+    spec = _util.spec_from_file_location("pod_comm_budget", path)
+    pcb = _util.module_from_spec(spec)
+    spec.loader.exec_module(pcb)
+    assert pcb.ICI_BYTES_PER_S == DEFAULT_LINK_BYTES_PER_S["ici"]
+    src = open(path).read()
+    assert "ICI_BYTES_PER_S = DEFAULT_LINK_BYTES_PER_S" in src, \
+        "pod_comm_budget re-declared its own link constant"
+    assert "4.5e11" not in src.replace(
+        "ICI_BYTES_PER_S = DEFAULT_LINK_BYTES_PER_S", ""), \
+        "a literal copy of the ICI bandwidth crept back in"
+
+
+def test_stdout_sink_wire_columns():
+    import io
+
+    sink = monitor.StdoutSink(stream=io.StringIO(), header_every=1)
+    base = {"step": 0, "loss": 1.0, "loss_scale": 1.0, "grad_norm": 0.5,
+            "skip_count": 0, "step_time_ms": 10.0,
+            "throughput_steps_per_s": 100.0, "mfu": 0.5}
+    sink.emit(dict(base, wire_by_dtype={"bf16": 50_000_000,
+                                        "f32": 1_000_000},
+                   wire_to_logical=0.5))
+    out = sink.stream.getvalue()
+    assert "wire" in out and "w/l" in out          # header columns
+    assert "bf16:47.7M" in out                     # per-dtype split
+    assert "0.50" in out                           # the ratio
+    sink.emit(base)                                # statics not attached
+    assert "n/a" in sink.stream.getvalue().splitlines()[-1]
+
+
+def test_logger_attach_populates_wire_breakdown(tmp_path):
+    """attach() must derive the per-dtype wire split off the same
+    compiled HLO as the total, and flush must carry it per record with
+    the wire_to_logical ratio."""
+    import io
+
+    x = jnp.ones((8, 16), jnp.float32)
+
+    def step(m, x):
+        return m.count_step(jnp.bool_(True)).record_loss(
+            jnp.sum(x * x)), x
+
+    buf = io.StringIO()
+    n_logical = int(x.size * 4)
+    logger = monitor.MetricsLogger(
+        sinks=[monitor.JSONLSink(buf)], flush_every=1,
+        logical_collective_bytes=n_logical)
+    m = monitor.metrics_init()
+    logger.attach(step, m, x)
+    assert logger.collective_bytes_by_dtype is not None
+    m, _ = jax.jit(step)(m, x)
+    logger.record(m)
+    logger.close()
+    rec = json.loads(buf.getvalue().splitlines()[0])
+    assert "wire_by_dtype" in rec and "wire_to_logical" in rec
+    assert rec["logical_bytes"] == n_logical
+    # single-chip step: no collectives, wire 0, ratio 0
+    assert rec["collective_bytes"] == 0
+    assert rec["wire_to_logical"] == 0.0
+    from scripts.check_metrics_schema import check_lines
+    assert check_lines(buf.getvalue().splitlines()) == []

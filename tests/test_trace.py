@@ -140,21 +140,62 @@ def test_chrome_trace_is_structurally_valid(tmp_path):
     tracer.write_chrome_trace(str(path), rank=0)
     ct = json.loads(path.read_text())
     assert isinstance(ct, dict)
-    evs = ct["traceEvents"]
-    assert isinstance(evs, list) and len(evs) == 6   # 2 steps + 4 spans
+    all_evs = ct["traceEvents"]
+    evs = [e for e in all_evs if e["ph"] == "X"]
+    assert isinstance(all_evs, list) and len(evs) == 6  # 2 steps + 4 spans
     for ev in evs:
         assert isinstance(ev["name"], str) and ev["name"]
-        assert ev["ph"] == "X"
         for k in ("ts", "dur"):
             assert isinstance(ev[k], (int, float)) and ev[k] >= 0
         for k in ("pid", "tid"):
             assert isinstance(ev[k], int)
+    # metadata ("ph": "M") events label the rank track (tested in
+    # detail by test_chrome_trace_carries_rank_metadata)
+    assert any(e["ph"] == "M" for e in all_evs)
     # events nest consistently: child ts within parent [ts, ts+dur]
     spans = [e for e in evs if e["cat"] != "step"]
     a = [e for e in spans if e["name"] == "a"][0]
     b = [e for e in spans if e["name"] == "b"][0]
     assert a["ts"] <= b["ts"] <= b["ts"] + b["dur"] <= a["ts"] + a["dur"] \
         + 1e3  # 1ms slack for clock reads
+
+
+def test_chrome_trace_carries_rank_metadata():
+    """The multi-rank merge contract (ISSUE-9 satellite): every rank's
+    export tags its events with pid=rank AND labels the track with
+    process_name/process_sort_index metadata — so concatenating N
+    ranks' traceEvents yields N distinct, labeled, sorted Perfetto
+    tracks instead of anonymous colliding ones. StepTimeline's event
+    exports carry the same rank on every record."""
+    def one_rank(r):
+        tracer = trace.Tracer()
+        with tracer:
+            with trace.step(0):
+                with trace.span("fwd"):
+                    pass
+        return tracer
+
+    tracers = {r: one_rank(r) for r in (0, 3)}
+    merged = []
+    for r, tr in tracers.items():
+        ct = tr.chrome_trace(rank=r)
+        assert ct["metadata"]["rank"] == r
+        merged.extend(ct["traceEvents"])
+    names = {e["pid"]: e["args"]["name"] for e in merged
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {0: "rank 0", 3: "rank 3"}
+    sort = {e["pid"]: e["args"]["sort_index"] for e in merged
+            if e["ph"] == "M" and e["name"] == "process_sort_index"}
+    assert sort == {0: 0, 3: 3}
+    # every duration event rides its rank's pid track — no collisions
+    for r in (0, 3):
+        rank_evs = [e for e in merged if e["ph"] == "X"
+                    and e["pid"] == r]
+        assert {e["name"] for e in rank_evs} == {"step 0", "fwd"}
+    # the JSONL exports carry the rank field per record too
+    for r, tr in tracers.items():
+        assert all(ev["rank"] == r for ev in tr.step_events(rank=r))
+        assert all(ev["rank"] == r for ev in tr.span_events(rank=r))
 
 
 def test_trace_schema_rejects_malformed_values():
