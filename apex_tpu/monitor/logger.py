@@ -71,6 +71,7 @@ class MetricsLogger:
                  ckpt_sink: Optional[Sink] = None,
                  guard_sink: Optional[Sink] = None,
                  goodput_sink: Optional[Sink] = None,
+                 roofline_sink: Optional[Sink] = None,
                  logical_collective_bytes: Optional[int] = None,
                  donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
@@ -105,6 +106,13 @@ class MetricsLogger:
         #: ``check_metrics_schema.py --kind goodput``). Wire a
         #: GoodputLedger with ``ledger.subscribe(logger.record_goodput)``.
         self.goodput_sink = goodput_sink
+        #: the ``roofline`` event channel (kind="roofline"/"regress"
+        #: events from apex_tpu.prof.roofline / prof.sentinel —
+        #: validate with ``check_metrics_schema.py --kind roofline``).
+        #: Attach a report with ``attach_roofline_report``; stream
+        #: sentinel verdicts with ``record_roofline``.
+        self.roofline_sink = roofline_sink
+        self.roofline_report = None    # last attached RooflineReport
         #: the uncompressed payload one step SEMANTICALLY moves (e.g.
         #: ``4 * n_params`` for an fp32 grad sync) — enables the
         #: per-record ``wire_to_logical`` ratio, same contract as
@@ -413,6 +421,40 @@ class MetricsLogger:
                           for kk, vv in v.items()}
         self.goodput_sink.emit(rec)
 
+    # -- roofline channel ----------------------------------------------------
+
+    def record_roofline(self, event: Dict) -> None:
+        """Emit one roofline-channel event (``kind="roofline"|
+        "regress"``) — plain-dict pass-through like
+        :meth:`record_goodput` (roofline joins and sentinel verdicts
+        are rare AOT/offline audits; nothing is buffered). Non-finite
+        numbers are nulled to keep the strict-JSON contract."""
+        if self.roofline_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+        self.roofline_sink.emit(rec)
+
+    def attach_roofline_report(self, report,
+                               step: Optional[int] = None,
+                               top: Optional[int] = None
+                               ) -> "MetricsLogger":
+        """Attach an :class:`apex_tpu.prof.RooflineReport`: emits one
+        ``kind="roofline"`` event per row (``top`` bounds it) and keeps
+        the report for consumers (``bench.py`` reads ``worst_gaps``
+        into its default JSON)."""
+        self.roofline_report = report
+        if report is not None:
+            try:
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+            for ev in report.to_events(rank=rank, step=step, top=top):
+                self.record_roofline(ev)
+        return self
+
     def close(self) -> None:
         if self._closed:
             return
@@ -431,6 +473,8 @@ class MetricsLogger:
             self.guard_sink.close()
         if self.goodput_sink is not None:
             self.goodput_sink.close()
+        if self.roofline_sink is not None:
+            self.roofline_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

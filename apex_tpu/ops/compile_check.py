@@ -1167,6 +1167,57 @@ def _():
     assert ledger.steps[-1].buckets["recompile"] == 0
 
 
+@case("roofline/no-extra-dispatch")
+def _():
+    """Roofline observation is AOT + offline: compiling the step for
+    the analytic side, capturing a profiler trace around it, parsing
+    the xplane, building the roofline report, and attaching it to a
+    logger must leave the compiled HLO BIT-IDENTICAL (donated and
+    undonated) — the report reads the module and the trace, never the
+    program. Same guarantee the monitor/trace/memory/goodput cases
+    pin."""
+    import io
+    import tempfile
+
+    from apex_tpu import monitor, prof
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def train_step(p, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    for donate in (False, True):
+        kw = {"donate_argnums": (0,)} if donate else {}
+        plain = jax.jit(train_step, **kw)
+        hlo_plain = plain.lower(params, x, y).compile().as_text()
+
+        observed = jax.jit(train_step, **kw)
+        compiled = observed.lower(params, x, y).compile()
+        with tempfile.TemporaryDirectory() as tmp:
+            with prof.trace(tmp):
+                p2 = observed(params, x, y)
+                jax.block_until_ready(p2)
+            profile = prof.parse_trace(tmp)     # no device plane on CPU
+        rep = prof.roofline_report(compiled=compiled, profile=profile
+                                   if profile.ops else None)
+        logger = monitor.MetricsLogger(
+            sinks=[], roofline_sink=monitor.JSONLSink(io.StringIO()))
+        logger.attach_roofline_report(rep)
+        logger.close()
+        assert rep.rows, "roofline report attributed no ops"
+
+        hlo_obs = observed.lower(params, x, y).compile().as_text()
+        assert hlo_obs == hlo_plain, (
+            f"roofline observation changed the compiled program "
+            f"(donate={donate})")
+
+
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)
     regardless of cwd — the module lives next to the package root."""

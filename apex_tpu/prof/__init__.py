@@ -18,29 +18,44 @@ FLOPs analyzers). TPU-native, the same capability is:
   optimized HLO + ``memory_analysis()``, peak-live estimate, what-if
   batch scaler vs HBM capacity (docs/memory.md);
 - :mod:`~apex_tpu.prof.compile_watch` — trace/lower/compile counters +
-  retrace detector naming the argument whose shape changed.
+  retrace detector naming the argument whose shape changed (autotune-
+  origin compiles tagged separately via ``autotune_scope``);
+- :mod:`~apex_tpu.prof.roofline` — per-op efficiency attribution:
+  measured device time joined with analytic FLOPs/bytes against the
+  chip's peak table, compute/memory bound classes, per-family
+  aggregation, and the fingerprinted ``worst_gaps`` autotuner feed
+  (docs/profiling.md#roofline);
+- :mod:`~apex_tpu.prof.sentinel` — noise-aware perf-regression gate
+  over bench JSON trajectories (robust median/MAD, direction-aware,
+  fingerprinted waivers; ``scripts/perf_sentinel.py``).
 """
 
 from apex_tpu.prof.annotate import (CallRecord, annotate, annotate_modules,
                                     scope)
 from apex_tpu.prof.compile_watch import (CompileWatcher, FunctionWatch,
-                                         global_counters)
+                                         autotune_scope, global_counters)
 from apex_tpu.prof.hlo import (OpEstimate, compiled_hlo, cost_analysis,
-                               op_estimates)
+                               op_estimates, op_estimates_from_text)
 from apex_tpu.prof.memory import (BufferRecord, MemoryReport,
                                   device_memory_sample, hbm_capacity,
                                   memory_report)
-from apex_tpu.prof.report import (PEAK_FLOPS, StepReport, device_peak_flops,
+from apex_tpu.prof.report import (PEAK_FLOPS, PEAK_HBM_BW, StepReport,
+                                  device_peak_flops, device_peak_hbm_bw,
                                   profile_step, trace)
+from apex_tpu.prof.roofline import (RooflineReport, RooflineRow,
+                                    roofline_report)
 from apex_tpu.prof.xplane import OpRecord, TraceProfile, parse_trace
 
 __all__ = [
     "CallRecord", "annotate", "annotate_modules", "scope",
     "OpEstimate", "compiled_hlo", "cost_analysis", "op_estimates",
-    "PEAK_FLOPS", "StepReport", "device_peak_flops", "profile_step",
-    "trace",
+    "op_estimates_from_text",
+    "PEAK_FLOPS", "PEAK_HBM_BW", "StepReport", "device_peak_flops",
+    "device_peak_hbm_bw", "profile_step", "trace",
     "OpRecord", "TraceProfile", "parse_trace",
     "MemoryReport", "BufferRecord", "memory_report", "hbm_capacity",
     "device_memory_sample",
-    "CompileWatcher", "FunctionWatch", "global_counters",
+    "CompileWatcher", "FunctionWatch", "autotune_scope",
+    "global_counters",
+    "RooflineReport", "RooflineRow", "roofline_report",
 ]

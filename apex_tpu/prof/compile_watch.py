@@ -32,6 +32,7 @@ case pins bit-identical HLO).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import threading
@@ -42,7 +43,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import jax
 
 __all__ = ["CompileWatcher", "FunctionWatch", "install", "installed",
-           "global_counters", "reset_global_counters", "watch"]
+           "global_counters", "reset_global_counters", "watch",
+           "autotune_scope", "in_autotune"]
 
 # --- process-wide jax.monitoring counters ------------------------------------
 
@@ -55,7 +57,8 @@ _EVENT_KEYS = {
 _lock = threading.Lock()
 _installed = False
 _globals = {"traces": 0, "lowerings": 0, "compiles": 0,
-            "trace_secs": 0.0, "lower_secs": 0.0, "compile_secs": 0.0}
+            "trace_secs": 0.0, "lower_secs": 0.0, "compile_secs": 0.0,
+            "autotune_compiles": 0, "autotune_secs": 0.0}
 _SECS_KEY = {"traces": "trace_secs", "lowerings": "lower_secs",
              "compiles": "compile_secs"}
 
@@ -72,16 +75,53 @@ def _stack() -> List["FunctionWatch"]:
     return st
 
 
+# autotune-origin marker: compiles fired while a sweep holds this flag
+# are counted separately from (and in addition to) the plain compile
+# counters — so a kernel autotuner's grid sweep never reads as a
+# retrace storm in n_compiles (ROADMAP item 4's compile-attribution
+# note; the bench JSON splits the column)
+_autotune_tls = threading.local()
+
+
+def in_autotune() -> bool:
+    """True while an :func:`autotune_scope` is open on this thread."""
+    return getattr(_autotune_tls, "depth", 0) > 0
+
+
+@contextlib.contextmanager
+def autotune_scope():
+    """Tag every backend compile issued inside this context as
+    autotune-origin (re-entrant, per-thread). The kernel autotuner's
+    sweep loop wraps each candidate compile with it::
+
+        with compile_watch.autotune_scope():
+            timed = jax.jit(candidate).lower(*avals).compile()
+
+    ``global_counters()["autotune_compiles"]`` (a subset of
+    ``"compiles"``) and ``FunctionWatch.n_autotune_compiles`` count
+    them; ``bench.py`` reports the split as ``n_autotune_compiles``
+    next to ``n_compiles``."""
+    _autotune_tls.depth = getattr(_autotune_tls, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _autotune_tls.depth -= 1
+
+
 def _on_duration(name: str, secs: float, **_kw) -> None:
     key = _EVENT_KEYS.get(name)
     if key is None:
         return
+    autotune = key == "compiles" and in_autotune()
     with _lock:
         _globals[key] += 1
         _globals[_SECS_KEY[key]] += secs
+        if autotune:
+            _globals["autotune_compiles"] += 1
+            _globals["autotune_secs"] += secs
     st = _stack()
     if st:
-        st[-1]._count_event(key, secs)
+        st[-1]._count_event(key, secs, autotune=autotune)
 
 
 def install() -> bool:
@@ -170,6 +210,7 @@ class FunctionWatch:
     n_retraces: int = 0          # traces beyond the first
     n_lowerings: int = 0         # attributed jax.monitoring events
     n_compiles: int = 0
+    n_autotune_compiles: int = 0  # subset fired under autotune_scope()
     compile_secs: float = 0.0    # attributed backend-compile seconds
     trace_secs: float = 0.0
     last_signature: Optional[Tuple] = None
@@ -181,10 +222,13 @@ class FunctionWatch:
     # miscounted as retracing
     _seen: set = dataclasses.field(default_factory=set)
 
-    def _count_event(self, key: str, secs: float) -> None:
+    def _count_event(self, key: str, secs: float,
+                     autotune: bool = False) -> None:
         if key == "compiles":
             self.n_compiles += 1
             self.compile_secs += secs
+            if autotune:
+                self.n_autotune_compiles += 1
         elif key == "lowerings":
             self.n_lowerings += 1
         elif key == "traces":
@@ -320,6 +364,7 @@ class CompileWatcher:
         out = {name: {
             "n_calls": r.n_calls, "n_traces": r.n_traces,
             "n_retraces": r.n_retraces, "n_compiles": r.n_compiles,
+            "n_autotune_compiles": r.n_autotune_compiles,
             "compile_secs": round(r.compile_secs, 4),
             "last_change": r.last_change,
         } for name, r in self.watches.items()}
@@ -340,7 +385,8 @@ class CompileWatcher:
         g = global_counters()
         lines.append(f"process totals: {g['traces']} traces, "
                      f"{g['lowerings']} lowerings, {g['compiles']} "
-                     f"backend compiles ({g['compile_secs']:.2f}s)"
+                     f"backend compiles ({g['compile_secs']:.2f}s, "
+                     f"of which {g['autotune_compiles']} autotune)"
                      + ("" if _installed else
                         " [jax.monitoring unavailable — per-function "
                         "cache counts only]"))

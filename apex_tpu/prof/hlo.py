@@ -19,8 +19,8 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import numpy as np
 
-__all__ = ["cost_analysis", "op_estimates", "OpEstimate", "compiled_hlo",
-           "iter_instructions"]
+__all__ = ["cost_analysis", "op_estimates", "op_estimates_from_text",
+           "OpEstimate", "compiled_hlo", "iter_instructions"]
 
 _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
@@ -169,7 +169,17 @@ def op_estimates(fn, *args, top: Optional[int] = None,
     inside fused computations — and memory traffic for every op from its
     result shape. Sorted by flops desc, then bytes.
     """
-    text = compiled_hlo(fn, *args, **kwargs)
+    return op_estimates_from_text(compiled_hlo(fn, *args, **kwargs),
+                                  top=top)
+
+
+def op_estimates_from_text(text: str,
+                           top: Optional[int] = None) -> List[OpEstimate]:
+    """:func:`op_estimates` over an already-dumped HLO text, for
+    callers that hold the module text rather than a traceable fn (the
+    flat per-instruction estimate; :mod:`apex_tpu.prof.roofline` walks
+    the same text separately because it additionally needs
+    per-computation FLOP fold-in and scope/operand metadata)."""
     shapes: Dict[str, str] = {}
     parsed = []
     for name, shape, op, operands, line in iter_instructions(text):

@@ -25,7 +25,9 @@ import jax
 from apex_tpu.prof import hlo as _hlo
 from apex_tpu.prof import xplane as _xplane
 
-__all__ = ["trace", "profile_step", "StepReport"]
+__all__ = ["trace", "profile_step", "StepReport", "PEAK_FLOPS",
+           "PEAK_HBM_BW", "VMEM_BYTES", "device_peak_flops",
+           "device_peak_hbm_bw"]
 
 # per-chip peak bf16 FLOP/s by device kind (public spec sheets)
 PEAK_FLOPS = {
@@ -38,15 +40,59 @@ PEAK_FLOPS = {
     "TPU v6e": 918e12,
 }
 
+# per-chip peak HBM bandwidth (bytes/s) by device kind — public spec
+# sheets; PERF.md's measured steps sustain 97-98% of these, so the
+# roofline denominator is honest. The bandwidth half of the peak table
+# device_peak_flops starts (apex_tpu.prof.roofline reads both).
+PEAK_HBM_BW = {
+    "TPU v4": 1.228e12,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2.765e12,
+    "TPU v5p": 2.765e12,
+    "TPU v6 lite": 1.64e12,
+    "TPU v6e": 1.64e12,
+}
 
-def device_peak_flops(device=None) -> float:
-    """Peak bf16 FLOP/s of a jax device, 0.0 if unknown (CPU)."""
-    device = device or jax.devices()[0]
-    kind = getattr(device, "device_kind", "cpu")
-    for k, v in PEAK_FLOPS.items():
+# per-chip VMEM capacity (bytes) — the on-chip scratch a Mosaic kernel
+# tiles against (not a bandwidth: VMEM feeds the MXU at compute rate by
+# construction, so a VMEM-resident working set never bounds a roofline;
+# what DOES bound kernels is whether their tiles FIT — the autotuner's
+# sweep constraint, see docs/profiling.md#roofline)
+VMEM_BYTES = {
+    "TPU v4": 128 << 20,
+    "TPU v5 lite": 128 << 20,
+    "TPU v5e": 128 << 20,
+    "TPU v5": 112 << 20,
+    "TPU v5p": 112 << 20,
+    "TPU v6 lite": 128 << 20,
+    "TPU v6e": 128 << 20,
+}
+
+
+def lookup_peak(table, kind: str) -> float:
+    """Device-kind prefix match into a peak table, 0.0 when unknown
+    (the one place the prefix-match semantics live — roofline_report
+    resolves its explicit ``device_kind`` strings through here too)."""
+    for k, v in table.items():
         if kind.startswith(k):
             return v
     return 0.0
+
+
+def _device_kind(device) -> str:
+    device = device or jax.devices()[0]
+    return getattr(device, "device_kind", "cpu")
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak bf16 FLOP/s of a jax device, 0.0 if unknown (CPU)."""
+    return lookup_peak(PEAK_FLOPS, _device_kind(device))
+
+
+def device_peak_hbm_bw(device=None) -> float:
+    """Peak HBM bytes/s of a jax device, 0.0 if unknown (CPU)."""
+    return lookup_peak(PEAK_HBM_BW, _device_kind(device))
 
 
 @contextlib.contextmanager

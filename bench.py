@@ -18,10 +18,16 @@ snapshot vs a synchronous save — the checkpoint-overhead claim of
 docs/checkpointing.md as a measured column), ``goodput_frac`` (the
 steady-state useful-time fraction of the instrumented headline step
 with its wall-time bucket breakdown — apex_tpu.monitor.GoodputLedger,
-closure asserted by ``scripts/goodput_audit.py --cpu8``), and
-``link_fit`` (measured alpha-beta link calibration of the local device
+closure asserted by ``scripts/goodput_audit.py --cpu8``), ``link_fit`` (measured alpha-beta link calibration of the local device
 mesh — apex_tpu.monitor.linkbench / ``scripts/link_probe.py``;
-single-device hosts skip).
+single-device hosts skip), ``roofline_worst_gap`` (the headline step's
+worst measured-vs-attainable per-op gap — apex_tpu.prof.roofline; the
+fingerprinted autotuner candidate, measured on TPU / AOT-only
+classification elsewhere), ``n_autotune_compiles`` (the autotune-origin
+subset of ``n_compiles`` — prof.compile_watch.autotune_scope), and
+``sentinel_regressions`` (the noise-aware perf-regression gate's
+verdict on this row vs the committed BENCH_r0*.json trajectory —
+apex_tpu.prof.sentinel / ``scripts/perf_sentinel.py``).
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
@@ -524,6 +530,29 @@ def run_all():
     except Exception as e:
         goodput_note = (f"- Goodput + link calibration: row failed "
                         f"({type(e).__name__}).")
+    try:
+        rl = _roofline_row(256 if on_tpu else 8, size)
+        wg = (rl.get("worst_gaps") or [None])[0]
+        wg_txt = (f"worst gap {wg['family']}/{wg['op']} "
+                  f"{wg['measured_us']:.0f} us vs "
+                  f"{wg['attainable_us']:.0f} us attainable "
+                  f"(eff {wg['efficiency']:.0%})" if wg else
+                  "no measured gaps"
+                  + ("" if rl.get("measured") else
+                     " (AOT-only off-TPU — the measured join is "
+                     "CI-pinned on the committed BERT fixture)"))
+        roofline_note = (
+            f"- Roofline + sentinel ({host}): per-op efficiency "
+            f"attribution of the headline step over "
+            f"{rl.get('n_ops')} ops — {wg_txt}; `roofline_worst_gap` "
+            f"+ `sentinel_regressions` ride the default bench JSON "
+            f"(apex_tpu.prof.roofline / prof.sentinel; gate: "
+            f"`scripts/perf_sentinel.py --check BENCH_r0*.json`, "
+            f"audit: `scripts/roofline_audit.py --cpu8`, "
+            f"docs/profiling.md#roofline).")
+    except Exception as e:
+        roofline_note = (f"- Roofline + sentinel: row failed "
+                         f"({type(e).__name__}).")
 
     dev = getattr(jax.devices()[0], "device_kind", "?")
     lines = [
@@ -565,6 +594,7 @@ def run_all():
         ckpt_note,
         loader_note,
         goodput_note,
+        roofline_note,
     ]
     open("BENCH_TABLE.md", "w").write("\n".join(lines) + "\n")
     print("\n".join(lines))
@@ -839,6 +869,67 @@ def _link_fit_row():
             "n_samples": cal.get("n_samples")}
 
 
+def _roofline_row(batch: int, size: int):
+    """The ``roofline_worst_gap`` column: per-op efficiency attribution
+    of the headline step (apex_tpu.prof.roofline). On TPU a short
+    profiled run joins MEASURED per-op device time with the analytic
+    HLO costs against the chip's peak table; off-TPU the row is
+    AOT-only (analytic classification, no gaps — the measured join is
+    regression-tested in CI off the committed fixtures by
+    ``scripts/roofline_audit.py --cpu8``). The profiled twin is a
+    separate undonated jit, so the measured bench path is untouched."""
+    from apex_tpu import prof
+
+    step, (state, batch_stats), (x, y) = _resnet_step_builder(batch, size)
+    jitted = jax.jit(step)
+    compiled = jitted.lower(state, batch_stats, x, y).compile()
+    profile = None
+    if jax.default_backend() == "tpu":
+        profile = prof.profile_step(jitted, state, batch_stats, x, y,
+                                    iters=2, warmup=1).profile
+        if not profile.ops:
+            profile = None
+    rep = prof.roofline_report(compiled, profile)
+    return rep.summary(k=3)
+
+
+def _sentinel_row(current):
+    """The ``sentinel_regressions`` column: judge THIS bench run (plus
+    the committed BENCH_r0*.json trajectory) through the noise-aware
+    perf-regression gate (apex_tpu.prof.sentinel / docs/profiling.md
+    #sentinel). The current row only joins the trajectory when it was
+    measured on the same device kind — a CPU smoke run is not a
+    regression against the TPU history, it is skipped with a note."""
+    import glob as _glob
+    import os as _os
+
+    from apex_tpu.prof import sentinel as sn
+
+    repo = _os.path.dirname(_os.path.abspath(__file__))
+    rows = sn.load_rows(sorted(_glob.glob(
+        _os.path.join(repo, "BENCH_r0*.json"))))
+    hist_dev = next((r["row"].get("extra", {}).get("device")
+                     for r in rows if r.get("row")), None)
+    cur_dev = current.get("extra", {}).get("device")
+    if not rows or cur_dev != hist_dev:
+        # the column means "unwaived regressions of THIS row"; a
+        # cross-device comparison (CPU smoke vs the TPU history) or an
+        # absent trajectory judges nothing, so it reports None, not a
+        # verdict about some already-committed row
+        return {"n_regressions": None, "regressed": [], "judged": None,
+                "note": (f"current row ({cur_dev}) not judged against "
+                         f"the {hist_dev} trajectory — device mismatch"
+                         if rows else "no committed trajectory")}
+    rows.append({"path": "(this run)", "row": current,
+                 "metrics": sn.extract_metrics(current), "note": None})
+    waivers = sn.load_baseline(
+        _os.path.join(repo, "scripts", "perf_baseline.json"))
+    rep = sn.check_trajectory(rows, waivers=waivers)
+    return {"n_regressions": len(rep.regressions),
+            "regressed": [v.metric for v in rep.regressions],
+            "judged": rep.subject, "note": None}
+
+
 def _memory_row(batch: int, size: int):
     """The `peak_hbm_bytes` + `lint_findings` columns: AOT-compile the
     headline step (one compile, ZERO dispatches — the measured path is
@@ -945,12 +1036,20 @@ def main():
         link_fit = _link_fit_row()
     except Exception as e:
         link_fit = {"failed": type(e).__name__}
+    try:
+        roofline = _roofline_row(best_batch, size)
+    except Exception as e:
+        roofline = {"failed": type(e).__name__}
     # every trace/lowering/backend-compile the bench performed — a
     # steady-state regression (a step silently retracing per call)
-    # shows up here as n_compiles exploding
-    n_compiles = int(_cw.global_counters()["compiles"])
+    # shows up here as n_compiles exploding; autotune-origin compiles
+    # (prof.compile_watch.autotune_scope) are split out so a tuner
+    # sweep never reads as a retrace storm
+    counters = _cw.global_counters()
+    n_compiles = int(counters["compiles"])
+    n_autotune = int(counters["autotune_compiles"])
 
-    print(json.dumps({
+    out = {
         "metric": "resnet50_amp_o2_images_per_sec",
         "value": round(best, 2),
         "unit": "images/sec/chip",
@@ -985,6 +1084,17 @@ def main():
                   "lint_spmd_errors": mem.get("lint_spmd", {}).get(
                       "congruence_errors"),
                   "n_compiles": n_compiles,
+                  # the autotune-origin subset of n_compiles (0 until
+                  # the item-4 tuner lands; the column exists so its
+                  # sweeps are attributable from day one)
+                  "n_autotune_compiles": n_autotune,
+                  # per-op efficiency attribution of the headline step
+                  # (apex_tpu.prof.roofline; worst_gaps is the
+                  # autotuner's fingerprinted candidate list —
+                  # measured on TPU, AOT-only classification off-TPU)
+                  "roofline_worst_gap": (roofline.get("worst_gaps")
+                                         or [None])[0],
+                  "roofline": roofline,
                   # async checkpoint overhead on the step path (median
                   # per-step capture stall vs a synchronous
                   # save-and-wait; apex_tpu.ckpt, docs/checkpointing.md)
@@ -1001,7 +1111,18 @@ def main():
                   "link_fit": link_fit,
                   "bert_large_lamb": bert,
                   "ddp_comm_modes": ddp_comm},
-    }))
+    }
+    # the perf-regression sentinel judges the row just built against
+    # the committed BENCH_r0*.json trajectory (device-matched;
+    # docs/profiling.md#sentinel) — appended before print so the
+    # column rides the same JSON line
+    try:
+        sentinel = _sentinel_row(out)
+    except Exception as e:
+        sentinel = {"failed": type(e).__name__, "n_regressions": None}
+    out["extra"]["sentinel_regressions"] = sentinel.get("n_regressions")
+    out["extra"]["sentinel"] = sentinel
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -67,6 +67,17 @@ slowest span class on the lagging rank); a ``linkfit`` records one
 link class's measured α–β calibration (latency, bytes/s, fit
 residual).
 
+``--kind roofline`` — the roofline-observatory channel
+(``MetricsLogger(roofline_sink=...)``; keep in lockstep with
+``apex_tpu/prof/roofline.py`` and ``prof/sentinel.py``): ``kind`` in
+{roofline, regress}. A ``roofline`` event is one op's
+measured-vs-attainable verdict (bound class in {compute, memory,
+unknown}, efficiency ∈ [0, 1] or null, ``measured_us`` nullable — an
+AOT-only audit has analytic rows with no trace); a ``regress`` event is
+one perf-sentinel verdict (direction in {higher, lower}, robust
+baseline/MAD/threshold, the regressed/waived booleans and the waiver
+fingerprint).
+
 ``--kind ckpt`` — the checkpoint event channel
 (``MetricsLogger(ckpt_sink=...)``; keep in lockstep with
 ``apex_tpu/ckpt/manager.py`` and ``escalate.py``): ``kind`` in
@@ -83,7 +94,8 @@ jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
-           [--kind metrics|trace|memory|lint|ckpt|guard|goodput] FILE
+           [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline]
+           FILE
 """
 
 from __future__ import annotations
@@ -287,6 +299,105 @@ def check_goodput_lines(lines) -> List[str]:
                     not _is_number(bps) or bps <= 0):
                 errors.append(f"line {i}: 'bytes_per_s' must be a "
                               f"positive number, got {bps!r}")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
+
+
+# --- roofline / sentinel channel schema ---------------------------------------
+
+ROOFLINE_KINDS = ("roofline", "regress")
+#: roofline bound classes (keep in lockstep with
+#: apex_tpu/prof/roofline.py BOUND_CLASSES)
+ROOFLINE_BOUNDS = ("compute", "memory", "unknown")
+#: sentinel degradation directions (prof/sentinel.py DIRECTIONS)
+REGRESS_DIRECTIONS = ("higher", "lower")
+#: required keys per roofline-event kind (beyond "kind" itself)
+ROOFLINE_REQUIRED = {
+    "roofline": ("op", "family", "bound", "flops", "bytes",
+                 "attainable_us", "fingerprint"),
+    "regress": ("metric", "direction", "regressed", "n_history",
+                "fingerprint"),
+}
+#: keys that may be null per kind (everything else non-null when
+#: present); measured_us/efficiency/gap_us are null on AOT-only rows,
+#: the regress baselines on insufficient-history verdicts
+ROOFLINE_NULLABLE = {
+    "roofline": ("step", "measured_us", "efficiency", "gap_us",
+                 "scope", "dtype"),
+    "regress": ("latest", "baseline", "mad", "threshold",
+                "degradation"),
+}
+
+
+def check_roofline_lines(lines) -> List[str]:
+    """All roofline-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates per-op roofline verdicts and perf-sentinel
+    regression verdicts."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in ROOFLINE_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{ROOFLINE_KINDS}, got {kind!r}")
+            continue
+        for key in ROOFLINE_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = ROOFLINE_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        for key in ("step", "occurrences", "n_history"):
+            _check_counter(i, rec, key, errors, what="field")
+        if "fingerprint" in rec and not isinstance(
+                rec.get("fingerprint"), str):
+            errors.append(f"line {i}: 'fingerprint' must be a string")
+        if kind == "roofline":
+            bound = rec.get("bound")
+            if bound is not None and bound not in ROOFLINE_BOUNDS:
+                errors.append(f"line {i}: 'bound' must be one of "
+                              f"{ROOFLINE_BOUNDS}, got {bound!r}")
+            eff = rec.get("efficiency")
+            if eff is not None and "efficiency" in rec:
+                if not _is_number(eff) or not 0.0 <= eff <= 1.0:
+                    errors.append(f"line {i}: 'efficiency' must be in "
+                                  f"[0, 1] or null, got {eff!r}")
+            for dk in ("flops", "bytes", "attainable_us", "measured_us",
+                       "gap_us"):
+                v = rec.get(dk)
+                if dk not in rec or v is None:
+                    continue
+                if not _is_number(v) or v < 0:
+                    errors.append(f"line {i}: {dk!r} must be a "
+                                  f"non-negative number, got {v!r}")
+            for sk in ("op", "family"):
+                if sk in rec and not isinstance(rec.get(sk), str):
+                    errors.append(f"line {i}: {sk!r} must be a string")
+        if kind == "regress":
+            d = rec.get("direction")
+            if d is not None and d not in REGRESS_DIRECTIONS:
+                errors.append(f"line {i}: 'direction' must be one of "
+                              f"{REGRESS_DIRECTIONS}, got {d!r}")
+            if not isinstance(rec.get("metric"), str):
+                errors.append(f"line {i}: 'metric' must be a string")
+            for bk in ("regressed", "waived"):
+                v = rec.get(bk)
+                if v is not None and bk in rec and not isinstance(v,
+                                                                  bool):
+                    errors.append(f"line {i}: {bk!r} must be a boolean")
+            for dk in ("mad", "threshold"):
+                v = rec.get(dk)
+                if v is not None and dk in rec and (
+                        not _is_number(v) or v < 0):
+                    errors.append(f"line {i}: {dk!r} must be a "
+                                  f"non-negative number, got {v!r}")
     if n_records == 0:
         errors.append("no records found")
     return errors
@@ -724,7 +835,8 @@ def check_lint_lines(lines) -> List[str]:
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
             "ckpt": check_ckpt_lines, "guard": check_guard_lines,
-            "goodput": check_goodput_lines}
+            "goodput": check_goodput_lines,
+            "roofline": check_roofline_lines}
 
 
 def main(argv=None) -> int:

@@ -1,23 +1,35 @@
 #!/usr/bin/env python
-"""Regenerate tests/fixtures/resnet_step.xplane.pb.
+"""Regenerate tests/fixtures/resnet_step.xplane.pb (+ the BERT-layer
+fixture bert_layer.xplane.pb).
 
-A miniature XSpace trace shaped exactly like an on-chip
-``jax.profiler.trace`` capture of one ResNet O2 step (device plane
-"/device:TPU:0" with "XLA Modules" + "XLA Ops" lines, per-op HLO
-metadata carrying fusion kinds and named-scope paths, plus a host plane
-the parser must skip). Written with a pure-stdlib protobuf encoder —
-regenerating the fixture needs no tensorflow, and
-``tests/test_prof.py::TestXplaneFixture`` pins the decoded per-op table
-against the values below, so a parser regression surfaces in CI instead
+Miniature XSpace traces shaped exactly like on-chip
+``jax.profiler.trace`` captures (device plane "/device:TPU:0" with
+"XLA Modules" + "XLA Ops" lines, per-op HLO metadata carrying fusion
+kinds and named-scope paths, plus a host plane the parser must skip).
+Written with a pure-stdlib protobuf encoder — regenerating the fixtures
+needs no tensorflow, and ``tests/test_prof.py::TestXplaneFixture`` /
+``tests/test_roofline.py`` pin the decoded tables against the values
+below, so a parser or roofline-join regression surfaces in CI instead
 of only on-chip.
 
-The op set is a faithful miniature of a real v5e capture's shape
+The ResNet op set is a faithful miniature of a real v5e capture's shape
 (mega-fusions dominating, one conv, one all-reduce, a copy) with
 hand-chosen durations — small enough to commit, rich enough to exercise
 opcode extraction, fusion-kind categories, collective classification,
 scope attribution, and occurrence aggregation.
 
-Usage: python scripts/make_xplane_fixture.py [OUT.pb]
+The BERT op set is one BERT-Large layer's fwd+bwd hot ops at the bench
+geometry (b=16 s=512 h=16 d=64, hidden 1024/4096), with durations taken
+from the PERF.md round-5 ledger — notably the fused backward attention
+kernel at 549 us against its ~436 us d=64 MXU floor, the one >10% gap
+ROADMAP item 4 is chasing — and op durations summing to within 5% of
+the module time, so ``apex_tpu.prof.roofline``'s attribution-closure
+and worst-gap assertions (``scripts/roofline_audit.py --cpu8``) are
+regression-tested tf-free.
+
+Usage: python scripts/make_xplane_fixture.py            # both fixtures
+       python scripts/make_xplane_fixture.py OUT.pb     # resnet only
+       python scripts/make_xplane_fixture.py --bert OUT.pb
 """
 
 import os
@@ -120,17 +132,81 @@ OPS = [
 MODULE_RUNS = [990.0, 1010.0]     # us — two steps captured
 
 
-def build() -> bytes:
+# --- the BERT-layer fixture (roofline regression target) ---------------------
+#
+# One BERT-Large encoder layer's fwd+bwd hot ops at the bench geometry
+# (b=16, s=512, h=16, d=64 -> 8192 tokens, hidden 1024, ffn 4096), ONE
+# captured step, durations from the PERF.md round-5 per-component
+# ledger. The roofline math this pins (v5e: 197 TFLOP/s, 819 GB/s,
+# d=64 -> 0.5 MXU cap):
+#   attn fwd  354.0 us vs 4*B*H*S^2*D / 98.5e12 = 174.4 us  (eff 0.49)
+#   attn bwd  549.0 us vs 10*B*H*S^2*D / 98.5e12 = 436.1 us (eff 0.79)
+#     ^ THE known fused-backward gap (PERF round-5: "~550 vs ~440")
+#   LN fwd     55.0 us vs 33.6 MB / 819 GB/s = 41.0 us      (memory)
+#   LN bwd     71.0 us vs 50.4 MB / 819 GB/s = 61.5 us      (memory)
+#   MLP fc1   370.0 us vs 2*8192*4096*1024 / 197e12 = 348.8 (eff 0.94)
+#   MLP fc2   365.0 us vs same                              (eff 0.96)
+#   bias grad  90.0 us vs 67.1 MB / 819 GB/s = 82.0 us      (memory)
+# Op sum 1854.0 us vs the 1900.0 us module run = 2.4% closure error,
+# inside roofline_audit's 5% gate.
+BERT_OPS = [
+    (20, '%custom-call.201 = bf16[16,512,16,64]{3,2,1,0} custom-call('
+         'bf16[16,512,16,64]{3,2,1,0} %q, bf16[16,512,16,64]{3,2,1,0} '
+         '%k, bf16[16,512,16,64]{3,2,1,0} %v), custom_call_target='
+         '"tpu_custom_call", metadata={op_name='
+         '"jit(step)/jvp(bert/encoder_5/attn)/flash_attention_fwd"}',
+     [354.0]),
+    (21, '%custom-call.202 = (bf16[16,512,16,64]{3,2,1,0}, '
+         'bf16[16,512,16,64]{3,2,1,0}, bf16[16,512,16,64]{3,2,1,0}) '
+         'custom-call(bf16[16,512,16,64]{3,2,1,0} %q, '
+         'bf16[16,512,16,64]{3,2,1,0} %k, bf16[16,512,16,64]{3,2,1,0} '
+         '%v, bf16[16,512,16,64]{3,2,1,0} %do), custom_call_target='
+         '"tpu_custom_call", metadata={op_name="jit(step)/transpose('
+         'jvp(bert/encoder_5/attn))/flash_attention_bwd"}',
+     [549.0]),
+    (22, '%fusion.210 = bf16[8192,1024]{1,0} fusion('
+         'bf16[8192,1024]{1,0} %x, f32[1024]{0} %gamma, '
+         'f32[1024]{0} %beta), kind=kOutput, calls=%fused_ln_fwd, '
+         'metadata={op_name='
+         '"jit(step)/jvp(bert/encoder_5/layer_norm)/ln_fwd"}',
+     [55.0]),
+    (23, '%fusion.211 = (bf16[8192,1024]{1,0}, f32[1024]{0}, '
+         'f32[1024]{0}) fusion(bf16[8192,1024]{1,0} %dz, '
+         'bf16[8192,1024]{1,0} %x, f32[1024]{0} %gamma), kind=kInput, '
+         'calls=%fused_ln_bwd, metadata={op_name="jit(step)/transpose('
+         'jvp(bert/encoder_5/layer_norm))/ln_bwd"}',
+     [71.0]),
+    (24, '%dot.220 = bf16[8192,4096]{1,0} dot(bf16[8192,1024]{1,0} %h, '
+         'bf16[1024,4096]{1,0} %w1), lhs_contracting_dims={1}, '
+         'rhs_contracting_dims={0}, metadata={op_name='
+         '"jit(step)/jvp(bert/encoder_5/mlp)/fc1"}',
+     [370.0]),
+    (25, '%dot.221 = bf16[8192,1024]{1,0} dot(bf16[8192,4096]{1,0} '
+         '%act, bf16[4096,1024]{1,0} %w2), lhs_contracting_dims={1}, '
+         'rhs_contracting_dims={0}, metadata={op_name='
+         '"jit(step)/jvp(bert/encoder_5/mlp)/fc2"}',
+     [365.0]),
+    (26, '%fusion.230 = f32[4096]{0} fusion(bf16[8192,4096]{1,0} '
+         '%dact), kind=kInput, calls=%fused_bias_grad, '
+         'metadata={op_name="jit(step)/transpose('
+         'jvp(bert/encoder_5/mlp))/bias_grad"}',
+     [90.0]),
+]
+
+BERT_MODULE_RUNS = [1900.0]       # us — one step captured
+
+
+def build(ops=OPS, module_runs=MODULE_RUNS) -> bytes:
     md = [(1, event_metadata(1, "jit_step(1234)"))]
     op_events = []
     t = 0
-    for mid, hlo, durs in OPS:
+    for mid, hlo, durs in ops:
         md.append((mid, event_metadata(mid, hlo)))
         for d in durs:
             op_events.append(event(mid, int(d * 1e6), offset_ps=t))
             t += int(d * 1e6)
     mod_events = [event(1, int(d * 1e6), offset_ps=i * 10 ** 9)
-                  for i, d in enumerate(MODULE_RUNS)]
+                  for i, d in enumerate(module_runs)]
     device = plane("/device:TPU:0",
                    lines=[line("XLA Modules", mod_events),
                           line("XLA Ops", op_events)],
@@ -141,16 +217,34 @@ def build() -> bytes:
     return xspace([host, device])
 
 
-def main() -> int:
-    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "tests", "fixtures", "resnet_step.xplane.pb")
-    data = build()
+_FIXTURES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tests", "fixtures")
+
+
+def _write(out: str, ops, module_runs) -> None:
+    data = build(ops, module_runs)
     os.makedirs(os.path.dirname(out), exist_ok=True)
     with open(out, "wb") as f:
         f.write(data)
-    print(f"wrote {out} ({len(data)} bytes, {len(OPS)} ops, "
-          f"{len(MODULE_RUNS)} module runs)")
+    print(f"wrote {out} ({len(data)} bytes, {len(ops)} ops, "
+          f"{len(module_runs)} module runs)")
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    if args and args[0] == "--bert":
+        out = args[1] if len(args) > 1 else os.path.join(
+            _FIXTURES, "bert_layer.xplane.pb")
+        _write(out, BERT_OPS, BERT_MODULE_RUNS)
+        return 0
+    if args:                           # explicit path: resnet only
+        _write(args[0], OPS, MODULE_RUNS)
+        return 0
+    _write(os.path.join(_FIXTURES, "resnet_step.xplane.pb"),
+           OPS, MODULE_RUNS)
+    _write(os.path.join(_FIXTURES, "bert_layer.xplane.pb"),
+           BERT_OPS, BERT_MODULE_RUNS)
     return 0
 
 

@@ -35,6 +35,17 @@
 #                                   #   apexlint --mesh with APX203 hop
 #                                   #   evidence from the measured
 #                                   #   bytes/s
+#                                   # + the roofline observatory audit
+#                                   #   (--cpu8): per-op attribution
+#                                   #   closure on the committed BERT
+#                                   #   fixture, the known fused-
+#                                   #   backward gap named, AOT-only
+#                                   #   path, sentinel seeded positive
+#                                   #   + negative twin
+#                                   # + the perf sentinel gate over the
+#                                   #   committed BENCH_r0*.json
+#                                   #   trajectory (exit 1 on unwaived
+#                                   #   regression)
 #
 # Exit status is pytest's (or the first failing smoke step). The full
 # run prints DOTS_PASSED=<n> — the count of passing-test dots the driver
@@ -163,6 +174,32 @@ EOF
     # milliseconds computed from the MEASURED bytes/s, (d) every
     # stream passes --kind goodput
     JAX_PLATFORMS=cpu python scripts/goodput_audit.py --cpu8
+
+    echo "== smoke: roofline observatory audit (--cpu8)"
+    # asserts: (a) the per-op roofline join over the committed
+    # BERT-layer fixture closes over the trace's module device time
+    # within 5%, classifies attention compute-bound / LayerNorm
+    # memory-bound, and worst_gaps names the PERF.md round-5 fused-
+    # backward attention gap (~549 us measured vs its ~436 us d=64 MXU
+    # floor), (b) an AOT-only report carries measured_us=null analytic
+    # rows with dot FLOPs folded into calling fusions, (c) the
+    # sentinel flags a seeded 45% MFU drop on the committed r01–r05
+    # trajectory AND passes clean on the unmodified trajectory (the
+    # negative twin), (d) every stream passes --kind roofline
+    JAX_PLATFORMS=cpu python scripts/roofline_audit.py --cpu8
+
+    echo "== smoke: perf sentinel gate over the committed trajectory"
+    # the noise-aware regression gate (robust median/MAD baselines,
+    # direction-aware thresholds, fingerprinted waivers in
+    # scripts/perf_baseline.json) judging the newest committed bench
+    # row — exit 1 here means a landed change regressed a judged
+    # column (ms/step, MFU, peak HBM, wire ratio, goodput_frac, lint
+    # counts) without an explicit waiver
+    python scripts/perf_sentinel.py --check BENCH_r0*.json \
+        --baseline scripts/perf_baseline.json \
+        --jsonl "$tmp/sentinel.jsonl"
+    python scripts/check_metrics_schema.py --kind roofline \
+        "$tmp/sentinel.jsonl"
 
     echo "smoke ok"
     exit 0
