@@ -33,7 +33,8 @@ resumes on a *different* mesh shape. Four pieces:
 from apex_tpu.ckpt.elastic import repartition_flat, zero_layout
 from apex_tpu.ckpt.escalate import (ESCALATION_EXIT_CODE,
                                     EscalationPolicy, PreemptionError)
-from apex_tpu.ckpt.format import (CheckpointError, committed_steps,
+from apex_tpu.ckpt.format import (CheckpointError, checkpoint_in_use,
+                                  checkpoint_is_in_use, committed_steps,
                                   gc_checkpoints, latest_checkpoint,
                                   read_manifest, step_dir)
 from apex_tpu.ckpt.manager import CheckpointManager
@@ -45,6 +46,7 @@ __all__ = [
     "device_snapshot",
     "CheckpointError", "latest_checkpoint", "committed_steps",
     "gc_checkpoints", "read_manifest", "step_dir",
+    "checkpoint_in_use", "checkpoint_is_in_use",
     "repartition_flat", "zero_layout",
     "EscalationPolicy", "PreemptionError", "ESCALATION_EXIT_CODE",
 ]

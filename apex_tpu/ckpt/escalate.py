@@ -94,9 +94,12 @@ class EscalationPolicy:
         if self.event_sink is None:
             return
         try:
+            from apex_tpu.ckpt.format import tag_generation
             rank = getattr(self.manager, "rank", 0)
-            self.event_sink(dict(event, rank=rank,
-                                 wall_time=time.time()))
+            ev = tag_generation(
+                dict(event, rank=rank, wall_time=time.time()),
+                getattr(self.manager, "fence", None))
+            self.event_sink(ev)
         except Exception:
             pass
 

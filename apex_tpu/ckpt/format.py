@@ -36,17 +36,24 @@ import io
 import json
 import os
 import signal
+import threading
 import time
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 __all__ = ["write_process_file", "commit_manifest", "read_manifest",
            "assemble_arrays", "latest_checkpoint", "committed_steps",
-           "gc_checkpoints", "step_dir", "MANIFEST", "CheckpointError"]
+           "gc_checkpoints", "step_dir", "MANIFEST", "CheckpointError",
+           "checkpoint_in_use", "checkpoint_is_in_use", "INUSE_PREFIX"]
 
 MANIFEST = "manifest.json"
 FORMAT_VERSION = 1
+#: in-use marker files (``inuse.rank00000.12345.json``): a restore in
+#: progress pins its directory against a concurrent ``gc_checkpoints``
+#: on another rank — see :func:`checkpoint_in_use`
+INUSE_PREFIX = "inuse."
 
 #: test hook: crash the process (SIGKILL — no handlers, no atexit) at a
 #: named point of the save. Points: "before_data_rename" (data tmp
@@ -66,6 +73,7 @@ class CheckpointError(RuntimeError):
 # turns a slow NFS into a stalled commit — hence the de-phased,
 # seed-independent jittered backoff (see apex_tpu/utils/backoff.py)
 from apex_tpu.utils.backoff import backoff_sleep as _backoff_sleep
+from apex_tpu.utils.fsio import write_atomic
 
 
 def _test_crash(point: str) -> None:
@@ -73,32 +81,42 @@ def _test_crash(point: str) -> None:
         os.kill(os.getpid(), signal.SIGKILL)
 
 
+def _check_fence(fence, what: str, *, path: Optional[str] = None,
+                 step: Optional[int] = None) -> None:
+    """Validate a generation fence token before mutating shared state.
+
+    ``fence`` is any object with ``check(what, *, path, step)`` —
+    in practice an :class:`apex_tpu.cluster.ClusterMembership`. The
+    check re-reads the cluster's COMMITTED generation and raises
+    ``StaleGenerationError`` (after emitting the ``cluster_fence``
+    refusal event) when this process's token is stale — the zombie
+    fence: a rank resumed from a pause/preemption must not write into
+    a checkpoint tree a newer generation already owns. ``fence=None``
+    keeps the whole path unconditional (single-incarnation runs)."""
+    if fence is not None:
+        fence.check(what, path=path, step=step)
+
+
+def tag_generation(event: Dict, fence) -> Dict:
+    """Stamp the fence token on a checkpoint-layer event (in place) —
+    the forensic half of generation fencing: a refused zombie's
+    save/escalation record names the stale epoch it acted FROM. One
+    helper so every emitter (CheckpointManager, EscalationPolicy)
+    tags identically and a change to the contract lands once."""
+    if fence is not None and "generation" not in event:
+        event["generation"] = int(getattr(fence, "generation", 0))
+    return event
+
+
 def step_dir(root: str, step: int) -> str:
     return os.path.join(root, f"step_{int(step):08d}")
 
 
-def _fsync_dir(path: str) -> None:
-    try:
-        fd = os.open(path, os.O_RDONLY)
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
-    except OSError:
-        pass                     # not all filesystems allow dir fsync
-
-
 def _write_atomic(path: str, data: bytes, crash_point: str = "") -> None:
     """temp → fsync → rename; durable against crash at any instant."""
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-        f.flush()
-        os.fsync(f.fileno())
-    if crash_point:
-        _test_crash(crash_point)
-    os.replace(tmp, path)
-    _fsync_dir(os.path.dirname(path))
+    write_atomic(path, data,
+                 before_rename=((lambda: _test_crash(crash_point))
+                                if crash_point else None))
 
 
 def _sha256(data: bytes) -> str:
@@ -137,14 +155,19 @@ def _chunks_of(leaf, rank: int) -> Optional[List[Tuple[Optional[List],
 
 
 def write_process_file(ckpt_dir: str, rank: int,
-                       leaves: Sequence[Tuple[str, Any]]) -> Dict:
+                       leaves: Sequence[Tuple[str, Any]], *,
+                       fence=None) -> Dict:
     """Write this process's data file + its files.json piece.
 
     ``leaves`` is ``[(path_str, leaf)]`` where a leaf is a numpy array,
     a scalar, or a :class:`~apex_tpu.ckpt.snapshot.ShardChunks`. Returns
     the files.json record (also written to disk, atomically, after the
-    data file commits).
+    data file commits). ``fence`` refuses the write when this process's
+    generation token is stale — checked BEFORE the first byte lands: a
+    zombie overwriting ``proc{rank}.npz`` under an already-committed
+    manifest would otherwise break that manifest's content hash.
     """
+    _check_fence(fence, "write", path=ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
     fname = f"proc{rank:05d}.npz"
     arrays: List[Dict] = []
@@ -195,13 +218,24 @@ def commit_manifest(ckpt_dir: str, *, step: int, process_count: int,
                     extra: Optional[Dict] = None,
                     prng_impls: Optional[Dict[str, str]] = None,
                     wait_for_ranks: bool = True,
-                    barrier_timeout_s: float = 120.0) -> str:
+                    barrier_timeout_s: float = 120.0,
+                    fence=None,
+                    generation: Optional[int] = None) -> str:
     """Rank 0's commit: gather every rank's files.json, write the
     manifest LAST. ``wait_for_ranks=False`` (the escalation path — dead
     peers will never write theirs) commits with whatever files exist;
     restore's coverage check decides whether the result is usable.
+
+    ``fence`` re-validates the generation token immediately before the
+    manifest rename (the commit point — a zombie that passed the write
+    fence but was lapped during the rank barrier is still refused
+    here); ``generation`` (defaulting to ``fence.generation``) is
+    recorded in the manifest, so every committed checkpoint names the
+    epoch that produced it.
     """
     deadline = time.monotonic() + barrier_timeout_s
+    if generation is None and fence is not None:
+        generation = int(getattr(fence, "generation", 0))
     files: List[Dict] = []
     attempt = 0
     while True:
@@ -238,12 +272,19 @@ def commit_manifest(ckpt_dir: str, *, step: int, process_count: int,
         "wall_time": time.time(), "process_count": int(process_count),
         "n_files": len(files),
         "complete_barrier": len(files) == process_count,
+        "generation": (int(generation) if generation is not None
+                       else None),
         "meta": dict(meta or {}),
         "zero": dict(zero or {}),
         "extra": dict(extra or {}),
         "prng_impls": dict(prng_impls or {}),
         "files": files,
     }
+    # the fence is re-validated at the COMMIT POINT, after the (possibly
+    # long) rank barrier: a generation bump that landed while this rank
+    # waited means the cluster moved on — committing now would publish
+    # a stale epoch's state as the newest checkpoint
+    _check_fence(fence, "commit", path=ckpt_dir, step=int(step))
     path = os.path.join(ckpt_dir, MANIFEST)
     _write_atomic(path, json.dumps(manifest, indent=1).encode(),
                   crash_point="before_manifest")
@@ -430,15 +471,120 @@ def latest_checkpoint(root: str) -> Optional[str]:
     return step_dir(root, steps[-1]) if steps else None
 
 
-def gc_checkpoints(root: str, keep: int) -> List[str]:
+@contextmanager
+def checkpoint_in_use(ckpt_dir: str, rank: int = 0, *,
+                      refresh_s: float = 60.0):
+    """Pin a checkpoint directory against concurrent retention.
+
+    ``gc_checkpoints(keep=N)`` on one rank can race a ``restore`` on
+    another and delete the directory mid-read — the reader then fails
+    its gather (or worse, its hash check) on a checkpoint that was
+    committed and healthy. A restore wraps its gather in this context
+    manager: it drops an ``inuse.rank{r}.{pid}.json`` marker
+    (atomically) that :func:`gc_checkpoints` honors, and removes it on
+    exit. The marker is advisory and TTL'd (``gc``'s ``inuse_ttl_s``)
+    so a reader that died mid-restore cannot pin a directory forever —
+    a LIVE reader re-stamps it every ``refresh_s`` (<< the ttl) on a
+    daemon thread, so a legitimately slow gather on a degraded fs
+    stays pinned however long it runs. A marker write that fails must
+    never block the restore itself (``refresh_s=0`` disables the
+    refresher).
+    """
+    path = os.path.join(
+        ckpt_dir, f"{INUSE_PREFIX}rank{int(rank):05d}.{os.getpid()}.json")
+
+    def _stamp() -> None:
+        _write_atomic(path, json.dumps(
+            {"rank": int(rank), "pid": os.getpid(),
+             "wall_time": time.time()}).encode())
+
+    try:
+        _stamp()
+    except OSError:
+        path = None
+    stop = thread = None
+    if path is not None and refresh_s > 0:
+        stop = threading.Event()
+
+        def _refresh() -> None:
+            while not stop.wait(refresh_s):
+                try:
+                    _stamp()
+                except OSError:
+                    pass       # a lost re-stamp falls back to the ttl
+                if stop.is_set():
+                    # the owner may have removed the marker while our
+                    # stamp was in flight on a stalled fs — a re-stamp
+                    # landing AFTER that removal would pin a finished
+                    # restore's directory against gc for a full ttl
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+        thread = threading.Thread(target=_refresh,
+                                  name="apex_tpu.ckpt.inuse",
+                                  daemon=True)
+        thread.start()
+    try:
+        yield
+    finally:
+        if stop is not None:
+            stop.set()
+            thread.join(timeout=1.0)
+        if path is not None:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+
+def checkpoint_is_in_use(ckpt_dir: str, *,
+                         ttl_s: float = 300.0) -> bool:
+    """True when the directory carries a live in-use marker (younger
+    than ``ttl_s``). A torn/unreadable marker counts as live — it is
+    probably a reader racing its own marker write, and skipping one gc
+    round is cheaper than deleting under a reader."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return False
+    now = time.time()
+    for name in names:
+        if not (name.startswith(INUSE_PREFIX)
+                and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(ckpt_dir, name)) as f:
+                rec = json.load(f)
+            if now - float(rec.get("wall_time", 0)) < ttl_s:
+                return True
+        except (OSError, ValueError, TypeError):
+            return True
+    return False
+
+
+def gc_checkpoints(root: str, keep: int, *, fence=None,
+                   inuse_ttl_s: float = 300.0) -> List[str]:
     """Delete committed checkpoints beyond the newest ``keep`` (and any
     uncommitted partial dirs older than the newest committed one).
-    Returns the removed directory paths."""
+    Returns the removed directory paths.
+
+    Two guards make retention safe at pod scale: ``fence`` refuses the
+    whole pass when the caller's generation token is stale (a zombie
+    must not delete checkpoints the new epoch may still restore from),
+    and directories pinned by a live :func:`checkpoint_in_use` marker
+    (a concurrent restore on another rank) are skipped this round —
+    they fall to a later pass once the reader finishes or its marker
+    ages past ``inuse_ttl_s``.
+    """
     import shutil
+    _check_fence(fence, "delete", path=root)
     steps = committed_steps(root)
     removed = []
     for s in steps[:-keep] if keep > 0 else []:
         d = step_dir(root, s)
+        if checkpoint_is_in_use(d, ttl_s=inuse_ttl_s):
+            continue               # a reader holds it; next round's job
         shutil.rmtree(d, ignore_errors=True)
         removed.append(d)
     if steps:
@@ -451,7 +597,8 @@ def gc_checkpoints(root: str, keep: int) -> List[str]:
             d = os.path.join(root, name)
             if (name.startswith("step_") and d != newest
                     and not os.path.exists(os.path.join(d, MANIFEST))
-                    and d < newest):
+                    and d < newest
+                    and not checkpoint_is_in_use(d, ttl_s=inuse_ttl_s)):
                 shutil.rmtree(d, ignore_errors=True)
                 removed.append(d)
     return removed

@@ -74,19 +74,38 @@ class CheckpointManager:
     ``keep`` bounds retention (rank 0 deletes older committed
     checkpoints after each commit); ``meta`` statics land in every
     manifest (mesh shape, run tags, ...).
+
+    ``fence`` (an :class:`apex_tpu.cluster.ClusterMembership`, or any
+    object with ``generation`` + ``check(what, *, path, step)``)
+    generation-fences every mutation: data-file writes, the manifest
+    commit, and retention deletes all validate the fence token against
+    the cluster's committed generation first, so a zombie of a
+    previous incarnation is refused (``StaleGenerationError``, after a
+    ``cluster_fence`` event) instead of corrupting the successor run's
+    checkpoints. The manifest records the committing generation, and
+    every ``ckpt_save``/``ckpt_restore``/``ckpt_escalation`` event
+    carries it as the ``generation`` field. ``rank``/``process_count``
+    override the jax-derived defaults — a per-rank local checkpoint
+    tree (each rank its own root, its own single-writer commit) passes
+    ``rank=0, process_count=1`` regardless of the pod shape.
     """
 
     def __init__(self, root: str, *, keep: int = 2,
                  event_sink: Optional[Callable[[Dict], None]] = None,
                  meta: Optional[Dict] = None,
-                 barrier_timeout_s: float = 120.0):
+                 barrier_timeout_s: float = 120.0,
+                 fence=None,
+                 rank: Optional[int] = None,
+                 process_count: Optional[int] = None):
         self.root = root
         self.keep = int(keep)
         self.event_sink = event_sink
         self.meta = dict(meta or {})
         self.barrier_timeout_s = float(barrier_timeout_s)
-        self.rank = _rank()
-        self.process_count = _process_count()
+        self.fence = fence
+        self.rank = _rank() if rank is None else int(rank)
+        self.process_count = (_process_count() if process_count is None
+                              else int(process_count))
         self._snap = Snapshotter(on_ready=self._write_snapshot)
         self._pending_zero: Dict[str, int] = {}
         self._last_committed: Optional[str] = None
@@ -106,8 +125,10 @@ class CheckpointManager:
         if self.event_sink is None:
             return
         try:
-            self.event_sink(dict(event, rank=self.rank,
-                                 wall_time=time.time()))
+            ev = _format.tag_generation(
+                dict(event, rank=self.rank, wall_time=time.time()),
+                self.fence)
+            self.event_sink(ev)
         except Exception:
             pass                  # telemetry must never break a save
 
@@ -189,7 +210,8 @@ class CheckpointManager:
             if os.path.exists(os.path.join(d, _format.MANIFEST)):
                 return d           # this step already committed
             leaves = tree_paths(snap.tree)
-            rec = _format.write_process_file(d, self.rank, leaves)
+            rec = _format.write_process_file(d, self.rank, leaves,
+                                             fence=self.fence)
             if self.rank == 0:
                 _format.commit_manifest(
                     d, step=snap.step,
@@ -198,7 +220,8 @@ class CheckpointManager:
                     zero=self._pending_zero, extra=snap.extra,
                     prng_impls=snap.prng_impls,
                     wait_for_ranks=wait_for_ranks,
-                    barrier_timeout_s=self.barrier_timeout_s)
+                    barrier_timeout_s=self.barrier_timeout_s,
+                    fence=self.fence)
                 self._last_committed = d
                 # retention runs only after COOPERATIVE commits: a
                 # lone-rank escalation manifest may cover only this
@@ -206,7 +229,8 @@ class CheckpointManager:
                 # fully-committed checkpoint would destroy the very
                 # fallback its own restore error points at
                 if self.keep > 0 and wait_for_ranks:
-                    _format.gc_checkpoints(self.root, self.keep)
+                    _format.gc_checkpoints(self.root, self.keep,
+                                           fence=self.fence)
         finally:
             self._write_lock.release()
         self._emit({
@@ -296,12 +320,17 @@ class CheckpointManager:
                 f"no committed checkpoint under {self.root!r} — nothing "
                 f"to restore (a crash before the first commit leaves "
                 f"only partial step_* dirs, which are not checkpoints)")
-        manifest = _format.read_manifest(d)
-        flat = jax.tree_util.tree_flatten_with_path(like)
-        want = [jax.tree_util.keystr(p) for p, _ in flat[0]]
-        loaded = _format.assemble_arrays(d, manifest, paths=want,
-                                         verify=verify,
-                                         io_deadline_s=io_deadline_s)
+        # pin the directory for the whole read — manifest included: a
+        # concurrent gc_checkpoints(keep=N) on another rank must not
+        # delete it mid-read (the marker is advisory, TTL'd, and
+        # refreshed while held; see format.checkpoint_in_use)
+        with _format.checkpoint_in_use(d, self.rank):
+            manifest = _format.read_manifest(d)
+            flat = jax.tree_util.tree_flatten_with_path(like)
+            want = [jax.tree_util.keystr(p) for p, _ in flat[0]]
+            loaded = _format.assemble_arrays(d, manifest, paths=want,
+                                             verify=verify,
+                                             io_deadline_s=io_deadline_s)
         zero = manifest.get("zero", {})
         impls = manifest.get("prng_impls", {})
         resharded = 0

@@ -1,0 +1,95 @@
+"""apex_tpu.cluster — the shared-fs cluster control plane.
+
+Generation-fenced membership and coordinated multi-rank recovery
+(docs/resilience.md#control-plane) — the dynamic complement of
+apexlint's APX201 static cross-rank congruence check. Three pieces:
+
+- **membership & fencing** (:mod:`~apex_tpu.cluster.membership`):
+  per-rank lease files (the heartbeat one-file-per-rank pattern, TTL'd
+  so a crash needs no cleanup) plus a monotonic **generation** epoch
+  committed manifest-last; :class:`ClusterMembership` is the ``fence=``
+  object :class:`apex_tpu.ckpt.CheckpointManager` accepts — every
+  checkpoint write/commit/delete validates its generation token against
+  the committed epoch and a stale holder (a resumed zombie) is refused
+  with a ``cluster_fence`` event before it can corrupt anything;
+- **coordinated recovery** (:mod:`~apex_tpu.cluster.coordinator`):
+  :class:`RecoveryCoordinator` turns
+  :class:`~apex_tpu.guard.GuardPolicy`'s local rewind/escalate verdicts
+  into cluster decisions — signed per-rank intents, deterministic
+  resolution (oldest good step wins), a deadline-bounded barrier, and a
+  generation bump fencing out stragglers of the old epoch;
+  :class:`CollectiveDeadline` watches ``kind="collective"`` spans and
+  distinguishes a hung collective from a slow one, feeding
+  ``EscalationPolicy.trip("collective:...")``;
+- **relaunch hygiene** (:func:`relaunch`): the ``elastic_run v2`` hook
+  — bump the generation and garbage-collect stale lease/heartbeat
+  files before a shrink-restart, so a dead rank's last heartbeat never
+  reads as a "silent rank" of the new epoch.
+
+Everything is host-side only: the ``cluster/no-extra-dispatch``
+compile-check case pins that an instrumented step's compiled HLO is
+bit-identical, donated and undonated. Events are JSONL on the cluster
+channel (``MetricsLogger(cluster_sink=...)``, unbuffered — fencing
+events must survive the crash they document;
+``check_metrics_schema.py --kind cluster`` validates);
+``scripts/cluster_audit.py --cpu8`` is the asserted scenario soak.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+from apex_tpu.cluster.coordinator import (CollectiveDeadline,
+                                          CoordinationError,
+                                          RecoveryCoordinator,
+                                          RecoveryDecision, intent_path)
+from apex_tpu.cluster.membership import (GENERATION_PREFIX,
+                                         INTENT_PREFIX,
+                                         ClusterMembership, LeaseWriter,
+                                         StaleGenerationError,
+                                         bump_generation, cluster_token,
+                                         gc_stale_intents,
+                                         gc_stale_leases,
+                                         generation_path, lease_path,
+                                         mac_ok, read_generation,
+                                         read_generation_record,
+                                         read_leases)
+
+__all__ = [
+    "ClusterMembership", "LeaseWriter", "StaleGenerationError",
+    "read_generation", "read_generation_record", "bump_generation",
+    "read_leases", "lease_path", "gc_stale_leases", "gc_stale_intents",
+    "mac_ok", "cluster_token", "GENERATION_PREFIX", "generation_path",
+    "INTENT_PREFIX",
+    "RecoveryCoordinator", "RecoveryDecision", "CoordinationError",
+    "CollectiveDeadline", "intent_path",
+    "relaunch",
+]
+
+
+def relaunch(directory: str, *, reason: str = "elastic_restart",
+             rank: Optional[int] = None,
+             heartbeat_dir: Optional[str] = None,
+             event_sink: Optional[Callable[[Dict], None]] = None) -> int:
+    """Fence and clean before a restart — the ``elastic_run v2`` hook.
+
+    Bumps the committed generation (every straggler of the previous
+    attempt now fails its fence checks instead of corrupting the new
+    run) and garbage-collects lease files — and, when
+    ``heartbeat_dir`` is given, straggler heartbeat files — left by
+    older generations (a dead rank's last heartbeat otherwise reads as
+    a "silent rank" forever). Returns the new generation.
+
+    Idempotent *per restart*, not globally: each call opens a new
+    epoch, which is exactly what a relaunch means.
+    """
+    member = ClusterMembership(directory, rank=rank,
+                               event_sink=event_sink)
+    member.join()
+    new = member.bump(reason)
+    member.gc_stale(heartbeat_dir=heartbeat_dir)
+    # the relauncher is a controller, not a member: drop its transient
+    # lease so the restarted ranks join a clean table (they re-acquire
+    # their own leases under the new epoch)
+    member.lease.release()
+    return new

@@ -26,7 +26,21 @@ collective stall                        host: sleep — a peer wedged in a
 proc       sigkill                      host: SIGKILL this process
 ckpt       truncate                     host: truncate the newest
                                         committed checkpoint's data file
+cluster    lease_expire, zombie_resume, host: control-plane faults
+           split_brain                  against apex_tpu.cluster (needs
+                                        ``post_step(membership=...)``)
 ========== ============================ ================================
+
+The ``cluster`` site exercises the generation-fencing paths
+(docs/resilience.md#control-plane): ``lease_expire`` backdates this
+rank's lease so the cluster declares it dead while the process keeps
+running (what a long VM pause looks like from outside);
+``zombie_resume`` SIGSTOPs this process — the driver (``cluster_audit``
+or a test) escalates + relaunches around the pause and SIGCONTs it
+afterwards, turning it into a live zombie whose late writes the fence
+must refuse; ``split_brain`` makes this rank *claim* a generation the
+cluster never committed (``arg`` = the offset, default +1), which every
+verifier (intent MACs + generation checks, commit fences) must refuse.
 
 In-graph sites work through one extra i32 scalar step input (the
 ``fault_code``): the instrumented step calls
@@ -64,6 +78,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "collective": ("stall",),
     "proc": ("sigkill",),
     "ckpt": ("truncate",),
+    "cluster": ("lease_expire", "zombie_resume", "split_brain"),
 }
 
 
@@ -278,10 +293,30 @@ class ChaosHarness:
         return (x,) + tuple(batch[1:])
 
     def post_step(self, step: int, state, *, ckpt_root: Optional[str]
-                  = None):
+                  = None, membership=None):
         """Apply after-the-commit faults: param corruption, a stalled
-        collective, SIGKILL, checkpoint truncation. Returns the
-        (possibly corrupted) state tree."""
+        collective, SIGKILL, checkpoint truncation, cluster
+        control-plane faults (``membership`` — an
+        :class:`apex_tpu.cluster.ClusterMembership` — is required when
+        the plan carries a ``cluster`` fault). Returns the (possibly
+        corrupted) state tree."""
+        f = self.plan.at(step, self.rank, "cluster")
+        if f is not None:
+            if membership is None:
+                raise ValueError("cluster fault planned but post_step "
+                                 "got no membership")
+            self._note(step, f)
+            if f.kind == "lease_expire":
+                membership.lease.expire_now()
+            elif f.kind == "split_brain":
+                # claim (locally!) an epoch the cluster never committed
+                # — downstream fences/intent verification must refuse
+                membership.claim_generation(
+                    membership.generation + (int(f.arg) or 1))
+            else:                       # zombie_resume
+                # pause self; the DRIVER escalates + relaunches around
+                # the pause and SIGCONTs this process into a zombie
+                os.kill(os.getpid(), signal.SIGSTOP)
         f = self.plan.at(step, self.rank, "params")
         if f is not None:
             state = self._corrupt_params(state, f)

@@ -119,6 +119,11 @@ class GuardPolicy:
         self._skip_steps: list = []      # loop steps of recent skips
         self._prev: Optional[Dict[str, int]] = None
         self._last_poll = -1
+        #: (step, like, tree, manifest) of the last probe_good_step
+        #: winner — rewind() reuses it when the agreed target IS this
+        #: rank's own good step (the healthy-majority case), halving
+        #: the shared-fs read traffic of a coordinated recovery round
+        self._probe_cache: Optional[tuple] = None
 
     # -- events ----------------------------------------------------------------
 
@@ -269,8 +274,47 @@ class GuardPolicy:
                 return False
         return True
 
+    def probe_good_step(self, like) -> Optional[int]:
+        """The newest checkpoint step this rank can actually restore —
+        manifest hash verified AND finite params — or None when no
+        loadable checkpoint exists. This is the rank's *vote* in a
+        coordinated recovery round
+        (:meth:`apex_tpu.cluster.RecoveryCoordinator.propose` posts it
+        as ``good_step``; resolution takes the cluster-wide minimum —
+        "oldest good step wins" — because that is the only step every
+        rank can restore). Costs a restore per rejected candidate;
+        acceptable at recovery time — and the winner is cached so the
+        :meth:`rewind` that follows in the same round reuses it
+        instead of re-gathering the identical checkpoint when the
+        cluster target equals this rank's own good step.
+        """
+        from apex_tpu.ckpt import format as _fmt
+        from apex_tpu.ckpt.format import CheckpointError
+        self._probe_cache = None
+        if self.manager is None:
+            return None
+        for s in reversed(list(self.manager.all_steps())):
+            d = _fmt.step_dir(self.manager.root, s)
+            try:
+                cand, mf = self.manager.restore(like, ckpt_dir=d)
+            except CheckpointError:
+                continue
+            if self._params_finite(cand):
+                self._probe_cache = (int(s), like, cand, mf)
+                return int(s)
+        return None
+
+    def drop_probe_cache(self) -> None:
+        """Release :meth:`probe_good_step`'s cached restored tree — a
+        full params+optimizer copy — when no :meth:`rewind` will
+        consume it (a coordination round that failed before deciding);
+        leaving it pinned could cost the HBM the recovery retry
+        itself needs."""
+        self._probe_cache = None
+
     def rewind(self, step: int, like, source, *,
-               reason: str = "") -> Tuple[Any, Dict]:
+               reason: str = "",
+               target_step: Optional[int] = None) -> Tuple[Any, Dict]:
         """Restore the newest *good* snapshot and fast-forward ``source``
         past the offending window.
 
@@ -284,7 +328,13 @@ class GuardPolicy:
         Fallback chain: a candidate checkpoint is rejected — and the
         next-older one tried — when its files fail the manifest hash
         (truncation/corruption) or its restored params are non-finite
-        (the corruption predates the snapshot). Returns
+        (the corruption predates the snapshot). ``target_step`` caps
+        the search (only steps ≤ it are candidates) — the coordinated-
+        recovery hook: when an
+        :class:`apex_tpu.cluster.RecoveryCoordinator` round resolved to
+        an older step than this rank's own newest good one, the rank
+        MUST honor the cluster target or the ranks diverge (the exact
+        split-brain the coordinator exists to prevent). Returns
         ``(restored_tree, manifest)``; raises :class:`GuardEscalation`
         (or trips ``escalation``) when nothing loadable remains.
         """
@@ -295,9 +345,21 @@ class GuardPolicy:
                                  f"CheckpointManager wired ({reason})")
         cur_index = int(source.cursor_index())
         steps = list(self.manager.all_steps())
+        if target_step is not None:
+            steps = [s for s in steps if s <= int(target_step)]
         fallbacks = 0
         restored = manifest = None
+        probe, self._probe_cache = self._probe_cache, None
         for s in reversed(steps):
+            # the probe of this same recovery round already restored
+            # and finite-checked this exact candidate — reuse it
+            # rather than re-gathering the checkpoint from the shared
+            # fs (identity-matched on `like`: a different target tree
+            # means a different placement, so no reuse)
+            if (probe is not None and probe[0] == s
+                    and probe[1] is like):
+                restored, manifest = probe[2], probe[3]
+                break
             d = _fmt.step_dir(self.manager.root, s)
             try:
                 cand, mf = self.manager.restore(like, ckpt_dir=d)
@@ -356,6 +418,7 @@ class GuardPolicy:
         """Hand off to the wired :class:`~apex_tpu.ckpt.EscalationPolicy`
         (checkpoint + dump + exit 75 / PreemptionError), or raise
         :class:`GuardEscalation` when none is wired."""
+        self.drop_probe_cache()    # don't pin a restored tree across it
         self._emit({"kind": "guard_action",
                     "step": int(self._prev["step"]) if self._prev else 0,
                     "action": "escalate", "classes": [],

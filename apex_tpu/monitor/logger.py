@@ -72,6 +72,7 @@ class MetricsLogger:
                  guard_sink: Optional[Sink] = None,
                  goodput_sink: Optional[Sink] = None,
                  roofline_sink: Optional[Sink] = None,
+                 cluster_sink: Optional[Sink] = None,
                  logical_collective_bytes: Optional[int] = None,
                  donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
@@ -113,6 +114,15 @@ class MetricsLogger:
         #: sentinel verdicts with ``record_roofline``.
         self.roofline_sink = roofline_sink
         self.roofline_report = None    # last attached RooflineReport
+        #: the ``cluster`` event channel (kind="cluster_lease"/
+        #: "cluster_generation"/"cluster_fence"/"cluster_coord" events
+        #: from apex_tpu.cluster — validate with
+        #: ``check_metrics_schema.py --kind cluster``). Wire a
+        #: ClusterMembership / RecoveryCoordinator with
+        #: ``event_sink=logger.record_cluster``. Unbuffered, like
+        #: record_ckpt: a fence refusal usually precedes the zombie's
+        #: exit, and the event must survive the crash it documents.
+        self.cluster_sink = cluster_sink
         #: the uncompressed payload one step SEMANTICALLY moves (e.g.
         #: ``4 * n_params`` for an fp32 grad sync) — enables the
         #: per-record ``wire_to_logical`` ratio, same contract as
@@ -437,6 +447,26 @@ class MetricsLogger:
                 rec[k] = None
         self.roofline_sink.emit(rec)
 
+    # -- cluster channel -----------------------------------------------------
+
+    def record_cluster(self, event: Dict) -> None:
+        """Emit one cluster-control-plane event (``kind=
+        "cluster_lease"|"cluster_generation"|"cluster_fence"|
+        "cluster_coord"``) — plain-dict pass-through like
+        :meth:`record_ckpt` (membership edges, generation bumps, fence
+        refusals and coordination rounds are rare and forensic;
+        NOTHING is buffered — a ``cluster_fence`` refusal that only
+        landed at flush time would be lost to the zombie exit it
+        precedes). Non-finite numbers are nulled to keep the
+        strict-JSON contract."""
+        if self.cluster_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+        self.cluster_sink.emit(rec)
+
     def attach_roofline_report(self, report,
                                step: Optional[int] = None,
                                top: Optional[int] = None
@@ -475,6 +505,8 @@ class MetricsLogger:
             self.goodput_sink.close()
         if self.roofline_sink is not None:
             self.roofline_sink.close()
+        if self.cluster_sink is not None:
+            self.cluster_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

@@ -1218,6 +1218,70 @@ def _():
             f"(donate={donate})")
 
 
+@case("cluster/no-extra-dispatch")
+def _():
+    """The cluster control plane is host-side only: a step driven
+    under full membership instrumentation — a joined
+    ClusterMembership renewing its lease every step, a
+    generation-fenced CheckpointManager saving mid-loop, a
+    RecoveryCoordinator polling for peer intents, and a
+    CollectiveDeadline watching the tracer's collective spans — must
+    compile BIT-IDENTICAL HLO to the uninstrumented twin, donated and
+    undonated (membership is lease files + fence checks BETWEEN
+    dispatches, never ops). Same guarantee the ckpt/guard/goodput
+    cases pin for their layers."""
+    import tempfile
+
+    from apex_tpu import ckpt, cluster, trace
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def train_step(p, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        g = jax.grad(loss_fn)(p)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    for donate in ((), (0,)):
+        plain = jax.jit(train_step, donate_argnums=donate)
+        hlo_plain = plain.lower(params, x, y).compile().as_text()
+
+        jitted = jax.jit(train_step, donate_argnums=donate)
+        tracer = trace.Tracer()
+        with tempfile.TemporaryDirectory() as tmp:
+            member = cluster.ClusterMembership(
+                os.path.join(tmp, "cluster"), rank=0)
+            member.join()
+            coord = cluster.RecoveryCoordinator(member,
+                                                barrier_timeout_s=0.2)
+            deadline = cluster.CollectiveDeadline(
+                tracer, deadline_s=60.0, generation=member.refresh)
+            mgr = ckpt.CheckpointManager(os.path.join(tmp, "ck"),
+                                         fence=member, rank=0,
+                                         process_count=1)
+            p = params
+            with tracer:
+                for i in range(3):
+                    with trace.step(i):
+                        p = jitted(p, x, y)
+                        jax.block_until_ready(p)
+                    member.heartbeat()
+                    assert deadline.poll_once() is None
+                    assert not coord.peer_requested()
+                    if i == 1:
+                        mgr.save(i, p)
+            mgr.wait()
+            assert member.check("commit") == 0    # fence valid: gen 0
+            member.leave()
+        hlo_obs = jitted.lower(params, x, y).compile().as_text()
+        assert hlo_obs == hlo_plain, (
+            f"cluster membership instrumentation changed the compiled "
+            f"step (donate={donate})")
+
+
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)
     regardless of cwd — the module lives next to the package root."""

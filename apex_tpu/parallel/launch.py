@@ -68,7 +68,9 @@ def distributed_init(coordinator_address: Optional[str] = None,
 def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
                        capacity: int = 64,
                        hang_deadline_s: Optional[float] = None,
-                       escalation=None):
+                       escalation=None,
+                       collective_deadline_s: Optional[float] = None,
+                       membership=None):
     """One-call forensics bring-up for (multi-host) launches.
 
     Builds a :class:`apex_tpu.trace.Tracer`, a per-rank
@@ -85,9 +87,20 @@ def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
     stall escalates to checkpoint-save → crash-dump → nonzero exit
     (docs/checkpointing.md §escalation).
 
-    Returns ``(tracer, recorder, watchdog-or-None)``; enter the tracer
-    around the train loop and wrap steps in ``trace.step()`` /
-    ``trace.span`` so dumps carry span timelines (docs/tracing.md).
+    ``collective_deadline_s`` adds the tier between "one rank slow"
+    and "no step landed": a started
+    :class:`apex_tpu.cluster.CollectiveDeadline` polling the tracer's
+    open ``kind="collective"`` spans — a collective still open past
+    the deadline is *hung*, not slow, and trips
+    ``escalation.trip("collective:<span>")`` with the offender named.
+    ``membership`` (an :class:`apex_tpu.cluster.ClusterMembership`)
+    tags its events with the current generation.
+
+    Returns ``(tracer, recorder, watchdog-or-None,
+    collective-deadline-or-None)`` — a fixed shape regardless of which
+    tiers are enabled; enter the tracer around the train loop and wrap
+    steps in ``trace.step()`` / ``trace.span`` so dumps carry span
+    timelines (docs/tracing.md).
     """
     from apex_tpu import trace as _trace
     tracer = _trace.Tracer()
@@ -102,7 +115,16 @@ def enable_crash_dumps(path: str = "apex_tpu_crash.jsonl", *,
         watchdog = _trace.HangWatchdog(
             hang_deadline_s, recorder=recorder, tracer=tracer,
             on_stall=escalation).start()
-    return tracer, recorder, watchdog
+    deadline = None
+    if collective_deadline_s:
+        from apex_tpu.cluster import CollectiveDeadline
+        deadline = CollectiveDeadline(
+            tracer, deadline_s=collective_deadline_s,
+            escalation=escalation,
+            event_sink=getattr(membership, "event_sink", None),
+            generation=(membership.refresh if membership is not None
+                        else None)).start()
+    return tracer, recorder, watchdog, deadline
 
 
 # --- elastic restart-on-smaller-mesh -----------------------------------------
@@ -128,7 +150,10 @@ def shrink_schedule(world: int, *, min_world: int = 1,
 def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
                 = None, escalation_exit_codes=(75,),
                 restart_backoff_s: float = 0.0,
-                restart_backoff_cap_s: float = 60.0):
+                restart_backoff_cap_s: float = 60.0,
+                cluster_dir: Optional[str] = None,
+                heartbeat_dir: Optional[str] = None,
+                event_sink=None):
     """Restart-on-smaller-mesh: the single-controller recovery loop.
 
     ``train_fn(world, attempt)`` runs the training job on ``world``
@@ -155,6 +180,17 @@ def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
     re-attaching to the scheduler/checkpoint filesystem in lockstep is
     a thundering herd the chaos runs exercise. Default 0 keeps tests
     instant.
+
+    ``cluster_dir`` makes the loop *generation-fenced* (elastic_run
+    v2, docs/resilience.md#control-plane): before every shrink-restart
+    it calls :func:`apex_tpu.cluster.relaunch` — reporting any
+    lease-expired (dead) ranks, committing the next generation so
+    every straggler of the failed attempt is fenced out of the shared
+    checkpoint tree, and garbage-collecting stale lease files (and,
+    with ``heartbeat_dir``, stale straggler heartbeats — a dead rank's
+    last beat must not read as a "silent rank" of the new epoch).
+    ``event_sink`` (``logger.record_cluster``) streams the hygiene
+    pass's events.
     """
     from apex_tpu.ckpt import PreemptionError
     from apex_tpu.utils.backoff import backoff_sleep
@@ -191,6 +227,27 @@ def elastic_run(train_fn, *, world_sizes, max_restarts: Optional[int]
         if restart_backoff_s > 0:
             backoff_sleep(attempt - 1, base_s=restart_backoff_s,
                           cap_s=restart_backoff_cap_s)
+        if cluster_dir is not None:
+            # fence + clean BEFORE the relaunch: the new attempt joins
+            # a fresh generation and a clean lease/heartbeat table; a
+            # zombie of the failed attempt now fails its fence checks
+            # instead of corrupting the new run's checkpoints. The
+            # controller only OBSERVES here — join() would overwrite
+            # the dead rank's lease with the controller's own (same
+            # default rank) and silently drop it from the report
+            from apex_tpu import cluster as _cluster
+            member = _cluster.ClusterMembership(cluster_dir,
+                                               event_sink=event_sink)
+            dead = member.expired_ranks()
+            if dead:
+                maybe_print(f"apex_tpu.elastic: lease-expired ranks "
+                            f"{dead} (dead members of the failed "
+                            f"attempt)", rank0=True)
+            gen = _cluster.relaunch(
+                cluster_dir, reason=f"elastic_restart:{attempt}",
+                heartbeat_dir=heartbeat_dir, event_sink=event_sink)
+            maybe_print(f"apex_tpu.elastic: relaunching under "
+                        f"generation {gen}", rank0=True)
 
 
 def is_distributed() -> bool:

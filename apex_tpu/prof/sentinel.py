@@ -143,8 +143,9 @@ def load_rows(paths: Sequence[str]) -> List[Dict[str, Any]]:
         if isinstance(obj, dict) and "parsed" in obj:
             row = obj.get("parsed")
             if row is None:
-                note = (f"no parsed bench row (rc={obj.get('rc')}) — "
-                        "skipped")
+                why = obj.get("failure_reason")
+                note = (f"no parsed bench row (rc={obj.get('rc')}"
+                        + (f"; {why}" if why else "") + ") — skipped")
         metrics = extract_metrics(row)
         if row is not None and not metrics and note is None:
             note = "no judged metrics in row — skipped"

@@ -299,6 +299,24 @@ class Tracer:
                 return name
         return None
 
+    def in_flight_collective_age(self) -> Optional[Any]:
+        """``(name, age_seconds, start)`` of the deepest OPEN
+        ``kind="collective"`` span, or None. The age is host wall time
+        since the span opened — what
+        :class:`apex_tpu.cluster.CollectiveDeadline` polls to tell a
+        *hung* collective (one span instance open past the deadline)
+        from a *slow* one (which closes and reopens, resetting the
+        age); ``start`` is the span's fixed open timestamp on the
+        tracer clock — the stable instance identity its fire-once
+        logic keys on. Exception-unwound collectives are excluded:
+        they already belong to the crash handlers, not a liveness
+        poll."""
+        now = time.perf_counter() - self._t0
+        for name, kind, t0 in reversed(self._open):
+            if kind == "collective":
+                return name, max(now - t0, 0.0), t0
+        return None
+
     # -- exports -------------------------------------------------------------
 
     def timeline(self) -> StepTimeline:

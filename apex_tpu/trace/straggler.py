@@ -58,7 +58,7 @@ from typing import Callable, Dict, List, Optional
 from apex_tpu.utils.backoff import backoff_sleep
 
 __all__ = ["HeartbeatWriter", "StragglerDetector", "StragglerReport",
-           "StragglerWatch", "read_heartbeats"]
+           "StragglerWatch", "read_heartbeats", "gc_stale_heartbeats"]
 
 _HB_PREFIX = "hb.rank"
 
@@ -88,14 +88,25 @@ class HeartbeatWriter:
     not this tier's)."""
 
     def __init__(self, directory: str, rank: Optional[int] = None, *,
-                 attempts: int = 3):
+                 attempts: int = 3, generation: Optional[int] = None):
         self.rank = _rank_default() if rank is None else int(rank)
         self.directory = directory
         self.attempts = max(int(attempts), 1)
+        #: cluster-epoch fence token stamped on every beat (see
+        #: apex_tpu.cluster): a reader scoped to the current generation
+        #: ignores a dead previous attempt's records instead of
+        #: mistaking them for a silent rank. None = untagged (treated
+        #: as generation 0 by generation-scoped readers).
+        self.generation = generation
         os.makedirs(directory, exist_ok=True)
         self.path = heartbeat_path(directory, self.rank)
         self.n_written = 0
         self.n_dropped = 0
+
+    def set_generation(self, generation: Optional[int]) -> None:
+        """Re-tag after a coordinated bump (survivors keep their writer
+        across the epoch change)."""
+        self.generation = generation
 
     def on_step(self, st) -> None:
         """Tracer subscriber (:class:`~apex_tpu.trace.StepTrace`)."""
@@ -112,6 +123,8 @@ class HeartbeatWriter:
                "dur_ms": round(dur_ms, 4) if dur_ms is not None else None,
                "spans": {k: round(v, 4)
                          for k, v in (spans or {}).items()}}
+        if self.generation is not None:
+            rec["generation"] = int(self.generation)
         line = json.dumps(rec) + "\n"
         for attempt in range(self.attempts):
             try:
@@ -126,11 +139,21 @@ class HeartbeatWriter:
         return False
 
 
-def read_heartbeats(directory: str) -> Dict[int, Dict[int, Dict]]:
+def read_heartbeats(directory: str, *,
+                    generation: Optional[int] = None
+                    ) -> Dict[int, Dict[int, Dict]]:
     """``{rank: {step: record}}`` over every rank file present.
 
     Malformed lines (a reader racing a writer's partial append) are
-    skipped; a later complete record for the same step wins."""
+    skipped; a later complete record for the same step wins.
+
+    ``generation`` scopes the read to one cluster epoch: records whose
+    ``generation`` tag differs (untagged records count as generation 0)
+    are ignored, and a rank whose file carries NO current-generation
+    records is omitted entirely — a dead previous attempt's heartbeats
+    must not read as a live-but-silent rank of the new epoch (the
+    exact bug an ``elastic_run`` restart over stale files exhibits).
+    """
     out: Dict[int, Dict[int, Dict]] = {}
     try:
         names = sorted(os.listdir(directory))
@@ -154,13 +177,46 @@ def read_heartbeats(directory: str) -> Dict[int, Dict[int, Dict]]:
                         rec = json.loads(line)
                     except ValueError:
                         continue           # torn tail of a live append
+                    if generation is not None:
+                        g = rec.get("generation")
+                        g = g if isinstance(g, int) else 0
+                        if g != int(generation):
+                            continue       # another epoch's record
                     step = rec.get("step")
                     if isinstance(step, int):
                         per[step] = rec
         except OSError:
             continue
-        out[rank] = per
+        if per:
+            out[rank] = per
     return out
+
+
+def gc_stale_heartbeats(directory: str,
+                        current_generation: int) -> List[str]:
+    """Delete heartbeat files whose NEWEST record belongs to an older
+    generation — the ``elastic_run`` relaunch hygiene pass (see
+    :func:`apex_tpu.cluster.relaunch`): without it, a rank that died
+    in generation N leaves a file whose last beat reads as a "silent
+    rank" to every future detector poll. A file carrying any
+    current-generation record is kept (a survivor's history is still
+    its history). Returns removed paths."""
+    removed: List[str] = []
+    cur = int(current_generation)
+    for rank, per in read_heartbeats(directory).items():
+        # one read serves both questions (a second generation-scoped
+        # pass would double the shared-fs traffic of the restart path)
+        if any((rec.get("generation") if isinstance(
+                rec.get("generation"), int) else 0) == cur
+               for rec in per.values()):
+            continue               # a survivor's history stays
+        p = heartbeat_path(directory, rank)
+        try:
+            os.remove(p)
+            removed.append(p)
+        except OSError:
+            pass
+    return removed
 
 
 @dataclasses.dataclass
@@ -217,18 +273,25 @@ class StragglerDetector:
 
     def __init__(self, directory: str, *, window: int = 16,
                  z_threshold: float = 4.0, hysteresis: int = 3,
-                 lag_floor_ms: float = 1.0, min_ranks: int = 2):
+                 lag_floor_ms: float = 1.0, min_ranks: int = 2,
+                 generation: Optional[int] = None):
         self.directory = directory
         self.window = max(int(window), 1)
         self.z_threshold = float(z_threshold)
         self.hysteresis = max(int(hysteresis), 1)
         self.lag_floor_ms = float(lag_floor_ms)
         self.min_ranks = max(int(min_ranks), 2)
+        #: when set, only heartbeats of this cluster epoch are judged —
+        #: a dead previous attempt's records neither flag laggards nor
+        #: read as silent ranks (pass the current generation after an
+        #: elastic relaunch; see apex_tpu.cluster)
+        self.generation = generation
 
     def check(self) -> List[StragglerReport]:
         """Read every rank's heartbeats and report persistent laggards
         (empty = healthy, or not enough ranks/steps to judge)."""
-        beats = read_heartbeats(self.directory)
+        beats = read_heartbeats(self.directory,
+                                generation=self.generation)
         if len(beats) < self.min_ranks:
             return []
         common = set.intersection(*(set(per) for per in beats.values()))

@@ -132,6 +132,25 @@ class HangWatchdog:
             except Exception:
                 pass
 
+    def lease_expired(self, event: Dict) -> None:
+        """Peer-death tier (docs/resilience.md#straggler): a PEER
+        rank's cluster lease expired — this rank is healthy but a
+        member it collectives with is dead (or paused long enough to
+        be fenced as dead). Alerting-only, like :meth:`early_warning`
+        (tagged ``tier="lease-expiry"``): the coordinated response —
+        shrink + generation bump — belongs to the recovery layer
+        (:class:`apex_tpu.cluster.RecoveryCoordinator` /
+        ``elastic_run``'s relaunch hygiene), not to a per-rank
+        watchdog; escalating every survivor here would turn one dead
+        rank into a pod-wide exit storm before the coordinator could
+        agree on a checkpoint. Wire it as the ``ClusterMembership``
+        caller's hook on :meth:`~apex_tpu.cluster.ClusterMembership.
+        expired_ranks` observations. The wedged-collective case (this
+        rank BLOCKED on the dead peer) is the hard deadline's job —
+        :class:`apex_tpu.cluster.CollectiveDeadline` names the
+        collective and does escalate."""
+        self.early_warning(dict(event, tier="lease-expiry"))
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "HangWatchdog":

@@ -1,4 +1,5 @@
 from apex_tpu.utils.backoff import backoff_sleep
+from apex_tpu.utils.fsio import fsync_dir, write_atomic
 from apex_tpu.utils.tree import (
     tree_cast,
     tree_all_finite,
@@ -16,4 +17,6 @@ __all__ = [
     "tree_size",
     "global_norm",
     "backoff_sleep",
+    "write_atomic",
+    "fsync_dir",
 ]

@@ -40,6 +40,12 @@ Perfetto-loadable Chrome trace (TRACE.json), a trace-event JSONL stream
 ``scripts/check_metrics_schema.py --kind trace``), and the per-step
 span timeline table.
 
+A backend that never comes up (the round-5 tunnel-down failure) does
+not silently lose the round: every mode first forces backend init and,
+on failure, prints a structured ``{"parsed": null, "failure_reason":
+...}`` row and exits :data:`BACKEND_FAILURE_EXIT_CODE` (13) — which
+``perf_sentinel`` skips with a note instead of judging.
+
 See PERF.md for the profiling breakdown behind the current number
 (captured with apex_tpu.prof).
 """
@@ -1125,12 +1131,51 @@ def main():
     print(json.dumps(out))
 
 
+#: exit status of a bench run whose BACKEND never came up (tunnel
+#: down, no accelerator runtime, driver mismatch) — distinct from 0
+#: (measured) and 1 (a bench bug), so the driver's trajectory keeps a
+#: structured row instead of silently losing the round
+BACKEND_FAILURE_EXIT_CODE = 13
+
+
+def _backend_probe():
+    """Force backend initialization NOW, before any measurement —
+    jax is lazy, so a dead tunnel otherwise surfaces as an opaque
+    rc=1 deep inside the first dispatch (the round-5 failure mode)."""
+    return jax.devices()
+
+
+def run_with_backend_guard(fn, mode: str = "default"):
+    """Run one bench mode, degrading a backend-init failure into a
+    STRUCTURED row: ``{"parsed": null, "failure_reason": ...}`` on
+    stdout (the committed BENCH_rNN.json then records a skippable row
+    — ``perf_sentinel`` skips it with a note instead of the
+    trajectory silently losing a round) and exit code
+    :data:`BACKEND_FAILURE_EXIT_CODE`. Only *backend bring-up*
+    failures are absorbed; an exception after devices enumerate is a
+    bench bug and propagates with exit 1 as before."""
+    try:
+        _backend_probe()
+    except Exception as e:
+        reason = f"{type(e).__name__}: {e}"
+        print(json.dumps({
+            "parsed": None,
+            "mode": mode,
+            "failure_reason": f"backend init failed: {reason}",
+            "rc": BACKEND_FAILURE_EXIT_CODE,
+        }))
+        return BACKEND_FAILURE_EXIT_CODE
+    fn()
+    return 0
+
+
 if __name__ == "__main__":
     if "--all" in sys.argv:
-        run_all()
+        mode_fn, mode_name = run_all, "all"
     elif "--monitor" in sys.argv:
-        run_monitor()
+        mode_fn, mode_name = run_monitor, "monitor"
     elif "--trace" in sys.argv:
-        run_trace()
+        mode_fn, mode_name = run_trace, "trace"
     else:
-        main()
+        mode_fn, mode_name = main, "default"
+    sys.exit(run_with_backend_guard(mode_fn, mode_name))

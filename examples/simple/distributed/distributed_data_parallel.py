@@ -71,7 +71,7 @@ def main():
     # + hang watchdog, one file per rank; the tracer feeds both.
     tracer, recorder = trace.Tracer(), None
     if args.crash_dumps:
-        tracer, recorder, _wd = parallel.enable_crash_dumps(
+        tracer, recorder, _wd, _cd = parallel.enable_crash_dumps(
             os.path.join(args.crash_dumps, "crash.jsonl"),
             hang_deadline_s=args.hang_deadline)
 

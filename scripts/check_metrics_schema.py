@@ -78,6 +78,22 @@ one perf-sentinel verdict (direction in {higher, lower}, robust
 baseline/MAD/threshold, the regressed/waived booleans and the waiver
 fingerprint).
 
+``--kind cluster`` — the cluster-control-plane channel
+(``MetricsLogger(cluster_sink=...)``; keep in lockstep with
+``apex_tpu/cluster/membership.py`` and ``coordinator.py``): ``kind``
+in {cluster_lease, cluster_generation, cluster_fence, cluster_coord}.
+A ``cluster_lease`` records a membership edge (action in {acquire,
+release, expire, gc}); a ``cluster_generation`` records an epoch
+commit or observation (action in {bump, observe} — a bump's
+``generation`` must exceed its ``prev_generation``, and bumps are
+monotone non-decreasing across the stream); a ``cluster_fence`` is a
+REFUSAL (action in {refused_commit, refused_write, refused_delete,
+refused_intent}) naming the stale token and the committed generation
+it lost to; a ``cluster_coord`` is one recovery-round edge (action in
+{propose, resolve, barrier_timeout, collective_hang}) — deadline and
+target fields are nullable (a resolve that escalated has no rewind
+target).
+
 ``--kind ckpt`` — the checkpoint event channel
 (``MetricsLogger(ckpt_sink=...)``; keep in lockstep with
 ``apex_tpu/ckpt/manager.py`` and ``escalate.py``): ``kind`` in
@@ -94,7 +110,8 @@ jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
-           [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline]
+           [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline
+                   |cluster]
            FILE
 """
 
@@ -199,6 +216,119 @@ CKPT_NULLABLE = {
     "ckpt_restore": (),
     "ckpt_escalation": ("path", "step", "exit_code"),
 }
+
+
+# --- cluster control-plane channel schema -------------------------------------
+
+CLUSTER_KINDS = ("cluster_lease", "cluster_generation", "cluster_fence",
+                 "cluster_coord")
+#: action enums per cluster-event kind (keep in lockstep with
+#: apex_tpu/cluster/membership.py / coordinator.py emitters)
+CLUSTER_ACTIONS = {
+    "cluster_lease": ("acquire", "release", "expire", "gc"),
+    "cluster_generation": ("bump", "observe"),
+    "cluster_fence": ("refused_commit", "refused_write",
+                      "refused_delete", "refused_intent"),
+    "cluster_coord": ("propose", "resolve", "barrier_timeout",
+                      "collective_hang"),
+}
+#: required keys per cluster-event kind (beyond "kind" itself)
+CLUSTER_REQUIRED = {
+    "cluster_lease": ("action", "generation"),
+    "cluster_generation": ("action", "generation"),
+    "cluster_fence": ("action", "generation", "current_generation"),
+    "cluster_coord": ("action", "generation"),
+}
+#: keys that may be null per kind (everything else non-null when
+#: present) — deadline/target fields are nullable by design: an
+#: escalate-resolve has no rewind target, an unreadable lease no
+#: expires_at, and a rejoin-observe no prev epoch
+CLUSTER_NULLABLE = {
+    "cluster_lease": ("expires_at", "reason"),
+    "cluster_generation": ("reason", "prev_generation"),
+    "cluster_fence": ("path", "step", "reason"),
+    "cluster_coord": ("good_step", "target_step", "deadline_s",
+                      "reason"),
+}
+
+
+def check_cluster_lines(lines) -> List[str]:
+    """All cluster-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates membership-lease edges, generation
+    commits (monotone, non-negative), fence refusals and
+    recovery-coordination rounds."""
+    errors: List[str] = []
+    n_records = 0
+    last_bump: Optional[int] = None
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in CLUSTER_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{CLUSTER_KINDS}, got {kind!r}")
+            continue
+        for key in CLUSTER_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = CLUSTER_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        _check_counter(i, rec, "rank", errors, what="field")
+        for key in ("generation", "current_generation",
+                    "prev_generation", "new_generation", "good_step",
+                    "target_step", "step", "expired_rank", "leader",
+                    "n_removed", "n_refused", "n_intents"):
+            _check_counter(i, rec, key, errors, what="field")
+        act = rec.get("action")
+        if act is not None and act not in CLUSTER_ACTIONS[kind]:
+            errors.append(f"line {i}: {kind} 'action' must be one of "
+                          f"{CLUSTER_ACTIONS[kind]}, got {act!r}")
+        for dk in ("ttl_s", "deadline_s", "age_s", "wall_time",
+                   "expires_at"):
+            v = rec.get(dk)
+            if dk not in rec or v is None:
+                continue
+            if not _is_number(v) or (v < 0 and dk != "expires_at"):
+                errors.append(f"line {i}: {dk!r} must be a non-negative "
+                              f"number, got {v!r}")
+        if kind == "cluster_generation" and act == "bump":
+            gen, prev = rec.get("generation"), rec.get("prev_generation")
+            if (isinstance(gen, int) and isinstance(prev, int)
+                    and not isinstance(gen, bool)
+                    and not isinstance(prev, bool) and gen <= prev):
+                errors.append(f"line {i}: generation bump goes backwards "
+                              f"({prev} -> {gen})")
+            if isinstance(gen, int) and not isinstance(gen, bool):
+                if last_bump is not None and gen < last_bump:
+                    errors.append(f"line {i}: bump generation {gen} "
+                                  f"below an earlier bump {last_bump} — "
+                                  "epochs must be monotone")
+                last_bump = gen
+        if kind == "cluster_fence":
+            what = rec.get("what")
+            if what is not None and not isinstance(what, str):
+                errors.append(f"line {i}: 'what' must be a string")
+        if kind == "cluster_coord":
+            for lk in ("ranks", "missing"):
+                v = rec.get(lk)
+                if v is not None and lk in rec and not (
+                        isinstance(v, list)
+                        and all(isinstance(r, int)
+                                and not isinstance(r, bool)
+                                and r >= 0 for r in v)):
+                    errors.append(f"line {i}: {lk!r} must be a list of "
+                                  "non-negative rank ids")
+            for sk in ("proposed", "decided", "collective"):
+                v = rec.get(sk)
+                if v is not None and sk in rec and not isinstance(v, str):
+                    errors.append(f"line {i}: {sk!r} must be a string")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
 
 
 # --- goodput / straggler / linkfit channel schema -----------------------------
@@ -836,7 +966,8 @@ CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
             "ckpt": check_ckpt_lines, "guard": check_guard_lines,
             "goodput": check_goodput_lines,
-            "roofline": check_roofline_lines}
+            "roofline": check_roofline_lines,
+            "cluster": check_cluster_lines}
 
 
 def main(argv=None) -> int:

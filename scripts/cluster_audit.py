@@ -1,0 +1,441 @@
+#!/usr/bin/env python
+"""Cluster control-plane audit: fence zombies, coordinate rewinds.
+
+The asserting sibling of ``chaos_audit.py --cpu8`` for the cluster axis
+(``run_tier1.sh --smoke`` runs it; exit status is the verdict). Four
+claims over :mod:`apex_tpu.cluster`, each printed and asserted — the
+in-process twins of the two multi-process acceptance tests in
+``tests/test_cluster.py`` (TestZombieAcceptance /
+TestCoordinatedRewindAcceptance, ``-m slow``):
+
+(a) **zombies are fenced** — a rank paused through an escalation +
+    relaunch (its lease expired, the generation bumped past it) has
+    BOTH its late checkpoint write and its retention delete refused
+    by the generation fence, leaving the new epoch's
+    ``latest_checkpoint`` untouched and the refusals on the cluster
+    event stream;
+(b) **coordinated rewind is bitwise** — chaos poisons rank 1's
+    committed params (rank 0 stays clean); rank 1's guard detects and
+    posts a signed intent, rank 0 joins the round, both resolve to the
+    SAME target (oldest good step wins — rank 0 honors the cluster
+    verdict over its own newer good checkpoint), the generation bumps
+    EXACTLY once, and both ranks' post-rewind losses and final params
+    are **bitwise-equal** to a fault-free oracle pair that never saw
+    the poison window — the chaos_audit claim (b), now cross-rank;
+(c) **split-brain is refused** — a rank claiming a generation the
+    cluster never committed (the ``cluster:split_brain`` chaos site)
+    has its intent refused at verification, its checkpoint fence
+    checks refused (a future claim is split-brain, not seniority) and
+    its CAS bump refused at commit, with the evidence on the stream;
+(d) **the event stream validates** — every emitted event passes
+    ``check_metrics_schema.py --kind cluster`` and all four event
+    kinds are present (a hung-collective probe supplies the
+    ``collective_hang`` edge).
+
+Usage: python scripts/cluster_audit.py --cpu8
+       python scripts/cluster_audit.py        # same audit, local devs
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from scripts.chaos_audit import (BATCH, LR, SEED, _init_params,  # noqa: E402
+                                 _make_cfg, _make_step)
+
+N_STEPS = 14
+SAVE_EVERY = 2
+POISON_STEP = 7
+
+
+def _logger(path):
+    from apex_tpu import monitor
+    return monitor.MetricsLogger(sinks=[],
+                                 cluster_sink=monitor.JSONLSink(path))
+
+
+# --- (a) zombie fence ---------------------------------------------------------
+
+def audit_zombie(tmp, events_path):
+    import jax.numpy as jnp
+
+    from apex_tpu import ckpt, cluster
+
+    cdir = os.path.join(tmp, "cluster_a")
+    root = os.path.join(tmp, "ck_a")
+    logger = _logger(events_path)
+
+    zombie = cluster.ClusterMembership(cdir, rank=1, ttl_s=60.0,
+                                       event_sink=logger.record_cluster)
+    assert zombie.join() == 0
+    mgr_z = ckpt.CheckpointManager(root, fence=zombie, rank=0,
+                                   process_count=1, keep=0)
+    mgr_z.save(3, {"w": jnp.full((8,), 3.0)}, block=True)
+    mgr_z.wait()
+    assert ckpt.read_manifest(
+        ckpt.latest_checkpoint(root))["generation"] == 0
+
+    # the rank pauses (a long SIGSTOP / VM migration, seen from
+    # outside): its lease expires — the dead-member signal the
+    # coordinated shrink acts on
+    zombie.lease.expire_now()
+    watcher = cluster.ClusterMembership(cdir, rank=0)
+    watcher.join()
+    assert watcher.expired_ranks() == [1]
+
+    # escalation + relaunch: the controller fences out generation 0
+    gen = cluster.relaunch(cdir, reason="elastic_restart:1",
+                           event_sink=logger.record_cluster)
+    assert gen == 1
+    fresh = cluster.ClusterMembership(cdir, rank=0,
+                                      event_sink=logger.record_cluster)
+    assert fresh.join() == 1
+    mgr_f = ckpt.CheckpointManager(root, fence=fresh, rank=0,
+                                   process_count=1, keep=0)
+    mgr_f.save(5, {"w": jnp.full((8,), 5.0)}, block=True)
+    mgr_f.wait()
+    latest_before = ckpt.latest_checkpoint(root)
+    assert latest_before == ckpt.step_dir(root, 5)
+
+    # ---- the zombie resumes and tries to write / gc ----
+    refused = 0
+    mgr_z.save(99, {"w": jnp.full((8,), 99.0)}, block=True)
+    try:
+        mgr_z.wait()
+    except cluster.StaleGenerationError:
+        refused += 1
+    try:
+        ckpt.gc_checkpoints(root, keep=1, fence=zombie)
+    except cluster.StaleGenerationError:
+        refused += 1
+    assert refused == 2, f"zombie mutations not fenced ({refused}/2)"
+    assert not os.path.exists(ckpt.step_dir(root, 99)), \
+        "the refused write left debris"
+    assert ckpt.latest_checkpoint(root) == latest_before
+    assert len(ckpt.committed_steps(root)) == 2, \
+        "the refused gc deleted checkpoints"
+    logger.close()
+    with open(events_path) as f:
+        acts = [json.loads(l).get("action") for l in f if l.strip()]
+    assert "refused_write" in acts and "refused_delete" in acts, acts
+    print("  (a) zombie fenced: lease expiry detected, write + delete "
+          "both refused after the generation bump; "
+          "latest_checkpoint untouched")
+
+
+# --- (b) coordinated rewind ---------------------------------------------------
+
+def _run_pair(imgroot, workdir, cluster_dir, jstep, cfg, mesh, *,
+              n_steps, poison_step=None, oracle_skip=None, tag,
+              event_sink=None):
+    """Two logical ranks trained in lockstep in ONE process: per-rank
+    data shard, checkpoint tree, guard policy, and cluster membership
+    over a SHARED cluster directory. Deterministic resolution makes
+    the sequential drive equivalent to the concurrent one — every
+    rank computes the same decision from the same intent files (the
+    multi-process version is the ``-m slow`` acceptance test)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from apex_tpu import ckpt, cluster, guard
+    from apex_tpu.data.pipeline import ImageFolderSource
+
+    shd = NamedSharding(mesh, P("data"))
+    members, coords, mgrs, policies, srcs = [], [], [], [], []
+    for r in (0, 1):
+        m = cluster.ClusterMembership(cluster_dir, rank=r,
+                                      event_sink=event_sink)
+        m.join()
+        members.append(m)
+        coords.append(cluster.RecoveryCoordinator(
+            m, barrier_timeout_s=30.0, event_sink=event_sink))
+        mgr = ckpt.CheckpointManager(
+            os.path.join(workdir, f"ck_{tag}_r{r}"), fence=m, rank=0,
+            process_count=1, keep=0)
+        mgrs.append(mgr)
+        policies.append(guard.GuardPolicy(manager=mgr,
+                                          rewind_budget=2))
+        srcs.append(ImageFolderSource(imgroot, batch=BATCH, size=16,
+                                      seed=SEED, workers=2,
+                                      process_index=r,
+                                      process_count=2))
+    plan = None
+    if poison_step is not None:
+        plan = guard.FaultPlan(seed=1).add(poison_step, "params",
+                                           "nan", rank=1)
+    harness = guard.ChaosHarness(plan, rank=1) if plan else None
+
+    params = [_init_params(mesh) for _ in (0, 1)]
+    gs = [guard.guard_init(cfg) for _ in (0, 1)]
+    its = [None, None]
+
+    def pull(r):
+        while True:
+            if its[r] is None:
+                its[r] = srcs[r].epoch()
+            try:
+                return next(its[r])
+            except StopIteration:
+                its[r] = None
+
+    losses = [[], []]
+    rewound = [[], []]
+    for step in range(n_steps):
+        for r in (0, 1):
+            if oracle_skip and srcs[r].cursor_index() == oracle_skip[0]:
+                srcs[r].skip_batches(oracle_skip[1])
+                its[r] = None
+            x, y = pull(r)
+            xd = jax.device_put(x, shd)
+            yd = jax.device_put(np.asarray(y, np.int32), shd)
+            params[r], gs[r], loss = jstep(params[r], gs[r], xd, yd,
+                                           jax.numpy.int32(0))
+            losses[r].append(np.float32(np.asarray(loss)))
+            if step % SAVE_EVERY == 0:
+                mgrs[r].save(step, {"params": params[r], "gs": gs[r]},
+                             extra={"cursor": srcs[r].state()})
+                mgrs[r].wait()
+            members[r].heartbeat()
+            if harness is not None and r == 1:
+                params[r] = harness.post_step(step, params[r])
+        # --- the coordination sweep (both ranks crossed the step) ---
+        needs = [policies[r].update(step, gs[r]).kind == "rewind"
+                 for r in (0, 1)]
+        if any(needs):
+            likes = [{"params": params[r], "gs": gs[r]}
+                     for r in (0, 1)]
+            for r in (0, 1):
+                if needs[r]:
+                    coords[r].propose(
+                        action="rewind", step=step,
+                        good_step=policies[r].probe_good_step(
+                            likes[r]))
+            for r in (0, 1):
+                if not needs[r]:
+                    assert coords[r].peer_requested(), \
+                        "healthy rank failed to notice the intent"
+            for r in (0, 1):
+                dec, restored = coords[r].run_round(
+                    policies[r], step, likes[r], srcs[r],
+                    expect_ranks=[0, 1])
+                tree, manifest = restored
+                params[r], gs[r] = tree["params"], tree["gs"]
+                its[r] = None
+                rewound[r].append((step, dec.target_step,
+                                   dec.generation,
+                                   dec.new_generation))
+    for s in srcs:
+        s.close()
+    return {"losses": losses, "params": params, "rewound": rewound,
+            "final_cursors": [s.cursor_index() for s in srcs],
+            "members": members}
+
+
+def audit_coordinated_rewind(tmp, imgroot, jstep, cfg, mesh,
+                             events_path):
+    import numpy as np
+
+    from apex_tpu import cluster
+
+    logger = _logger(events_path)
+    cdir_f = os.path.join(tmp, "cluster_b")
+    faulted = _run_pair(imgroot, tmp, cdir_f, jstep, cfg, mesh,
+                        n_steps=N_STEPS, poison_step=POISON_STEP,
+                        tag="faulted",
+                        event_sink=logger.record_cluster)
+    oracle = _run_pair(imgroot, tmp, os.path.join(tmp, "cluster_bo"),
+                       jstep, cfg, mesh, n_steps=N_STEPS - 2,
+                       oracle_skip=(POISON_STEP, 2), tag="oracle")
+
+    # both ranks agreed on one round: detect at 8, target 6 (rank 1's
+    # ckpt@8 captured the corruption; rank 0 honors the cluster
+    # verdict over its own good step 8), generation 0 -> 1
+    for r in (0, 1):
+        assert faulted["rewound"][r] == [(POISON_STEP + 1,
+                                          POISON_STEP - 1, 0, 1)], \
+            (r, faulted["rewound"][r])
+        assert oracle["rewound"][r] == []
+        f_tail = [l.tobytes().hex()
+                  for l in faulted["losses"][r][POISON_STEP + 2:]]
+        o_tail = [l.tobytes().hex()
+                  for l in oracle["losses"][r][POISON_STEP:]]
+        assert f_tail == o_tail, (
+            f"rank {r} post-rewind losses diverge from the oracle")
+        for k in ("w", "b"):
+            a = np.asarray(faulted["params"][r][k])
+            b = np.asarray(oracle["params"][r][k])
+            assert np.array_equal(a, b), \
+                f"rank {r} final params[{k}] not bitwise vs oracle"
+        assert (faulted["final_cursors"][r]
+                == oracle["final_cursors"][r])
+    assert cluster.read_generation(cdir_f) == 1
+    logger.close()
+    with open(events_path) as f:
+        evs = [json.loads(l) for l in f if l.strip()]
+    bumps = [e for e in evs if e["kind"] == "cluster_generation"
+             and e["action"] == "bump"]
+    assert len(bumps) == 1, "the leader alone commits the bump"
+    resolves = [e for e in evs if e.get("action") == "resolve"]
+    assert len(resolves) == 2
+    assert all(e["decided"] == "rewind"
+               and e["target_step"] == POISON_STEP - 1
+               for e in resolves)
+    print(f"  (b) coordinated rewind: rank 1 poisoned at step "
+          f"{POISON_STEP}, detected at {POISON_STEP + 1}; both ranks "
+          f"resolved to target {POISON_STEP - 1} (oldest good wins), "
+          f"generation bumped exactly once, post-rewind losses + "
+          f"final params BITWISE == fault-free oracle on both ranks")
+
+
+# --- (c) split-brain refused --------------------------------------------------
+
+def audit_split_brain(tmp, events_path):
+    from apex_tpu import cluster, guard
+
+    cdir = os.path.join(tmp, "cluster_c")
+    logger = _logger(events_path)
+    honest = cluster.ClusterMembership(cdir, rank=0,
+                                       event_sink=logger.record_cluster)
+    honest.join()
+    rogue = cluster.ClusterMembership(cdir, rank=1)
+    rogue.join()
+
+    # the chaos site: rank 1 claims an epoch the cluster never agreed
+    plan = guard.FaultPlan(seed=2).add(3, "cluster", "split_brain",
+                                       rank=1, arg=2.0)
+    guard.ChaosHarness(plan, rank=1).post_step(3, {}, membership=rogue)
+    assert rogue.generation == 2
+    assert cluster.read_generation(cdir) == 0
+
+    c_honest = cluster.RecoveryCoordinator(
+        honest, barrier_timeout_s=1.0,
+        event_sink=logger.record_cluster)
+    c_rogue = cluster.RecoveryCoordinator(rogue, barrier_timeout_s=1.0)
+    c_rogue.propose(action="rewind", step=5, good_step=4)
+    # the claimed epoch's intent never counts at the committed one —
+    # forged down to the committed prefix it is refused with evidence
+    assert not c_honest.peer_requested()
+    src = cluster.intent_path(cdir, 2, 1)
+    dst = cluster.intent_path(cdir, 0, 1)
+    os.replace(src, dst)
+    assert c_honest.pending() == {}
+    assert c_honest.last_refused == (1,)
+    # the checkpoint fence refuses the claim too — a never-committed
+    # generation is split-brain, not seniority
+    try:
+        rogue.check("commit")
+        raise AssertionError("split-brain fence check was not refused")
+    except cluster.StaleGenerationError:
+        pass
+    # and the claim cannot commit itself: the CAS bump refuses it
+    try:
+        rogue.bump("split-brain")
+        raise AssertionError("split-brain bump was not refused")
+    except cluster.StaleGenerationError:
+        pass
+    assert cluster.read_generation(cdir) == 0
+    logger.close()
+    with open(events_path) as f:
+        evs = [json.loads(l) for l in f if l.strip()]
+    refusals = [e for e in evs if e.get("action") == "refused_intent"]
+    assert refusals and "claims generation 2" in refusals[-1]["reason"]
+    print("  (c) split-brain refused: the claimed generation's intent "
+          "failed verification, its fence check and CAS bump were "
+          "refused; the committed epoch never moved")
+
+
+# --- (d) collective deadline + stream validation ------------------------------
+
+def audit_stream(tmp, event_paths):
+    from apex_tpu import cluster, trace
+
+    # a hung collective (span instance open past the deadline) is
+    # named and escalated — the missing cluster_coord edge
+    events_path = os.path.join(tmp, "cluster_d.jsonl")
+    logger = _logger(events_path)
+    tracer = trace.Tracer()
+    trips = []
+
+    class _Trip:
+        def trip(self, reason):
+            trips.append(reason)
+
+    cd = cluster.CollectiveDeadline(tracer, deadline_s=0.05,
+                                    escalation=_Trip(),
+                                    event_sink=logger.record_cluster)
+    with tracer:
+        with trace.step(0):
+            with trace.span("ddp/sync_gradients", kind="collective"):
+                time.sleep(0.12)
+                ev = cd.poll_once()
+                assert ev is not None and ev["action"] == \
+                    "collective_hang"
+            assert cd.poll_once() is None   # it closed: not hung
+    assert trips == ["collective:ddp/sync_gradients"]
+    logger.close()
+    event_paths = list(event_paths) + [events_path]
+
+    from scripts.check_metrics_schema import check_cluster_lines
+    kinds, n = set(), 0
+    for path in event_paths:
+        with open(path) as f:
+            lines = [l for l in f if l.strip()]
+        errors = check_cluster_lines(lines)
+        assert not errors, (f"{path} schema violations:\n"
+                            + "\n".join(errors))
+        n += len(lines)
+        kinds |= {json.loads(l)["kind"] for l in lines}
+    want = {"cluster_lease", "cluster_generation", "cluster_fence",
+            "cluster_coord"}
+    assert kinds >= want, f"missing event kinds: {want - kinds}"
+    print(f"  (d) hung collective named + escalated; {n} cluster "
+          f"events across {len(event_paths)} streams validate "
+          f"(--kind cluster), all 4 kinds present")
+
+
+def main_audit():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from apex_tpu.data.pipeline import make_fake_imagefolder
+
+    devs = jax.devices()
+    if len(devs) < 8:
+        raise SystemExit("audit needs 8 devices — pass --cpu8 for the "
+                         "8-device virtual mesh")
+    mesh = Mesh(np.array(devs[:8]), ("data",))
+    cfg = _make_cfg()
+    jstep = _make_step(cfg)
+
+    tmp = tempfile.mkdtemp(prefix="apex_cluster_audit_")
+    imgroot = make_fake_imagefolder(os.path.join(tmp, "imgs"),
+                                    n_classes=4, per_class=8, size=64,
+                                    seed=0)
+    ev_a = os.path.join(tmp, "cluster_a.jsonl")
+    ev_b = os.path.join(tmp, "cluster_b.jsonl")
+    ev_c = os.path.join(tmp, "cluster_c.jsonl")
+    audit_zombie(tmp, ev_a)
+    audit_coordinated_rewind(tmp, imgroot, jstep, cfg, mesh, ev_b)
+    audit_split_brain(tmp, ev_c)
+    audit_stream(tmp, [ev_a, ev_b, ev_c])
+    print("cluster audit ok")
+
+
+def main():
+    if "--cpu8" in sys.argv:
+        import jax
+        from apex_tpu import _compat
+        jax.config.update("jax_platforms", "cpu")
+        _compat.request_cpu_devices(8)
+    main_audit()
+
+
+if __name__ == "__main__":
+    main()

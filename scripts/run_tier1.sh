@@ -14,6 +14,14 @@
 #                                   #   NaN-spike rewind bitwise vs a
 #                                   #   fault-free oracle, skip-class
 #                                   #   convergence, guard schema
+#                                   # + the cluster control-plane audit
+#                                   #   (--cpu8): zombie write/delete
+#                                   #   fenced after a generation bump,
+#                                   #   coordinated cross-rank rewind
+#                                   #   bitwise vs oracle with exactly
+#                                   #   one bump, split-brain intent +
+#                                   #   CAS refused, hung collective
+#                                   #   named, cluster schema
 #                                   # + apexlint on the flagship steps
 #                                   #   incl. the guarded/ckpt
 #                                   #   self-audit targets (asserts
@@ -122,6 +130,18 @@ EOF
     # batch faults are skipped in-graph and still converge, (d) the
     # guard event stream passes --kind guard
     JAX_PLATFORMS=cpu python scripts/chaos_audit.py --cpu8
+
+    echo "== smoke: cluster control-plane audit (8-device CPU mesh)"
+    # asserts: (a) a rank paused through an escalation + relaunch has
+    # its late checkpoint write AND retention delete refused by the
+    # generation fence (latest_checkpoint untouched), (b) rank-
+    # asymmetric param corruption resolves to ONE agreed rewind target
+    # (oldest good step wins) with exactly one generation bump and
+    # post-rewind losses + params bitwise vs a fault-free oracle on
+    # both ranks, (c) a split-brain generation claim is refused at
+    # intent verification and at the CAS bump, (d) a hung collective
+    # is named + escalated and every stream passes --kind cluster
+    JAX_PLATFORMS=cpu python scripts/cluster_audit.py --cpu8
 
     echo "== smoke: apexlint flagship steps (--fail-on error)"
     # lints the flagship ResNet-O2 and BERT-LAMB steps (CPU structural

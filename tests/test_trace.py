@@ -555,7 +555,7 @@ _MP_CHILD = textwrap.dedent("""
     from apex_tpu import parallel, trace
     from apex_tpu.parallel import DistributedDataParallel
 
-    tracer, rec, _wd = enable_crash_dumps(sys.argv[1], capacity=8)
+    tracer, rec, _wd, _cd = enable_crash_dumps(sys.argv[1], capacity=8)
 
     mesh = parallel.data_parallel_mesh()
     ddp = DistributedDataParallel(mesh)
