@@ -31,8 +31,9 @@ from apex_tpu.monitor.check import module_count_and_host_ops
 from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
                                           collective_bytes,
                                           collective_bytes_by_dtype,
+                                          collective_bytes_by_hop,
                                           collective_bytes_from_text,
-                                          wire_report)
+                                          scope_hop, wire_report)
 from apex_tpu.monitor.goodput import (BUCKETS, GoodputLedger, StepLedger,
                                       classify_span)
 from apex_tpu.monitor.linkbench import (LinkFit, LinkSample, calibrate,
@@ -49,7 +50,8 @@ __all__ = [
     "MetricsLogger",
     "Sink", "StdoutSink", "JSONLSink", "CSVSink",
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
-    "collective_bytes_by_dtype", "wire_report",
+    "collective_bytes_by_dtype", "collective_bytes_by_hop", "scope_hop",
+    "wire_report",
     "module_count_and_host_ops",
     "GoodputLedger", "StepLedger", "BUCKETS", "classify_span",
     "LinkFit", "LinkSample", "calibrate", "fit_alpha_beta",

@@ -17,6 +17,7 @@ double-count.
 
 from __future__ import annotations
 
+import re
 from typing import Dict, Optional
 
 from apex_tpu.prof import hlo as _hlo
@@ -24,7 +25,7 @@ from apex_tpu.prof import xplane as _xplane
 
 __all__ = ["COLLECTIVE_OPCODES", "collective_bytes",
            "collective_bytes_from_text", "collective_bytes_by_dtype",
-           "wire_report"]
+           "collective_bytes_by_hop", "scope_hop", "wire_report"]
 
 # The canonical prefix list lives next to the trace categorizer so live
 # accounting and post-hoc attribution bucket opcodes identically.
@@ -51,14 +52,11 @@ def collective_bytes_from_text(hlo_text: str) -> Dict[str, int]:
     return totals
 
 
-def collective_bytes_by_dtype(hlo_text: str) -> Dict[str, Dict[str, int]]:
-    """Collective result bytes per opcode, split per wire dtype:
-    ``{opcode: {dtype: bytes}}``. The breakdown is what makes compressed
-    collectives auditable — a ``compress="bf16"`` DDP step shows its
-    grad traffic under ``{"all-reduce": {"bf16": ...}}`` while the
-    logical gradient is fp32. Async ``-start`` halves are skipped
-    (counted at the matching ``-done``)."""
-    out: Dict[str, Dict[str, int]] = {}
+def _iter_collective_rows(hlo_text: str):
+    """Yield ``(opcode_prefix, dtype, bytes, stripped_scope)`` per
+    collective result buffer of an optimized module. Async ``-start``
+    halves are skipped (counted at the matching ``-done``) — the one
+    scan behind both the per-dtype and the per-hop views."""
     for raw in hlo_text.splitlines():
         line = raw.strip()
         m = _hlo._INSTR_RE.match(line)
@@ -69,6 +67,8 @@ def collective_bytes_by_dtype(hlo_text: str) -> Dict[str, Dict[str, int]]:
             if op.startswith(prefix):
                 if op.endswith("-start"):
                     break  # counted at the matching -done
+                sm = _SCOPE_RE.search(line)
+                scope = _xplane.strip_scope(sm.group(1)) if sm else ""
                 for dt, dims in _hlo._SHAPE_RE.findall(m.group("shape")):
                     if dt not in _hlo._DTYPE_BYTES:
                         continue
@@ -76,10 +76,64 @@ def collective_bytes_by_dtype(hlo_text: str) -> Dict[str, Dict[str, int]]:
                     for d in dims.split(","):
                         if d:
                             elems *= int(d)
-                    slot = out.setdefault(prefix, {})
-                    slot[dt] = slot.get(dt, 0) + elems * \
-                        _hlo._DTYPE_BYTES[dt]
+                    yield (prefix, dt,
+                           elems * _hlo._DTYPE_BYTES[dt], scope)
                 break
+
+
+_SCOPE_RE = re.compile(r'op_name="((?:[^"\\]|\\.)*)"')
+
+#: hop classification of a collective's stripped scope: the
+#: hierarchical sync nests each hop under a ``bucketNN/ici`` or
+#: ``bucketNN/dcn`` sub-span (apex_tpu.parallel.hierarchy), so the
+#: link class each byte rides is readable from the compiled program.
+#: Everything else — the flat sync's whole traffic included — lands in
+#: ``"unattributed"``.
+_HOP_RES = (("dcn", re.compile(r"(^|/)dcn(/|$)")),
+            ("ici", re.compile(r"(^|/)ici(/|$)")))
+
+
+def scope_hop(scope: str) -> str:
+    """Link-hop class of a stripped collective scope — the ONE
+    classifier for the ``bucketNN/ici|dcn`` sub-span convention
+    (``pod_comm_budget``'s hierarchical structure audit keys off the
+    same function, so the audit and ``by_hop`` cannot drift apart)."""
+    for hop, rx in _HOP_RES:
+        if rx.search(scope):
+            return hop
+    return "unattributed"
+
+
+def collective_bytes_by_dtype(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Collective result bytes per opcode, split per wire dtype:
+    ``{opcode: {dtype: bytes}}``. The breakdown is what makes compressed
+    collectives auditable — a ``compress="bf16"`` DDP step shows its
+    grad traffic under ``{"all-reduce": {"bf16": ...}}`` while the
+    logical gradient is fp32. Async ``-start`` halves are skipped
+    (counted at the matching ``-done``)."""
+    out: Dict[str, Dict[str, int]] = {}
+    for prefix, dt, nbytes, _scope in _iter_collective_rows(hlo_text):
+        slot = out.setdefault(prefix, {})
+        slot[dt] = slot.get(dt, 0) + nbytes
+    return out
+
+
+def collective_bytes_by_hop(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Collective result bytes per **hop**, split per wire dtype:
+    ``{"ici" | "dcn" | "unattributed": {dtype: bytes}}``.
+
+    The hop comes from the collective's named scope (the hierarchical
+    sync's ``bucketNN/ici`` / ``bucketNN/dcn`` sub-spans survive into
+    the compiled program), so a hierarchical step shows its per-hop
+    dtype split — int8 inside the slice, bf16-or-int8 across — while a
+    flat sync reports everything ``unattributed``. This is the static
+    complement of the goodput ledger's ``exposed_comm`` bucket: the
+    ledger measures how much collective time a step exposed, this says
+    which link class and wire dtype the bytes behind it rode."""
+    out: Dict[str, Dict[str, int]] = {}
+    for _prefix, dt, nbytes, scope in _iter_collective_rows(hlo_text):
+        slot = out.setdefault(scope_hop(scope), {})
+        slot[dt] = slot.get(dt, 0) + nbytes
     return out
 
 
@@ -92,21 +146,31 @@ def wire_report(fn=None, *args, hlo_text: Optional[str] = None,
     come from the optimized HLO's collective result shapes. Returns::
 
         {"wire_bytes": int, "by_opcode": {op: {dtype: bytes}},
+         "by_hop": {hop: {dtype: bytes}},
          "logical_bytes": int | None, "wire_to_logical": float | None}
 
     A bucketed+``compress="bf16"`` DDP step reports
     ``wire_to_logical ≈ 0.5`` — the number the acceptance audit pins
     (tests/test_pod_hlo.py) and the uncompressed baseline DynamiQ-style
-    collectives are judged against.
+    collectives are judged against. ``by_hop`` is the per-hop per-dtype
+    split of the hierarchical schedule (``"ici"``/``"dcn"`` from the
+    hop sub-span scopes; flat traffic is ``"unattributed"``) — see
+    :func:`collective_bytes_by_hop`.
     """
     if hlo_text is None:
         if fn is None:
             raise ValueError("pass a step function or hlo_text=")
         hlo_text = _hlo.compiled_hlo(fn, *args, **kwargs)
-    by_op = collective_bytes_by_dtype(hlo_text)
+    by_op: Dict[str, Dict[str, int]] = {}
+    by_hop: Dict[str, Dict[str, int]] = {}
+    for prefix, dt, nbytes, scope in _iter_collective_rows(hlo_text):
+        slot = by_op.setdefault(prefix, {})
+        slot[dt] = slot.get(dt, 0) + nbytes
+        slot = by_hop.setdefault(scope_hop(scope), {})
+        slot[dt] = slot.get(dt, 0) + nbytes
     wire = sum(b for per in by_op.values() for b in per.values())
     ratio = (wire / logical_bytes) if logical_bytes else None
-    return {"wire_bytes": wire, "by_opcode": by_op,
+    return {"wire_bytes": wire, "by_opcode": by_op, "by_hop": by_hop,
             "logical_bytes": logical_bytes, "wire_to_logical": ratio}
 
 

@@ -1385,7 +1385,9 @@ def _():
     """The default (no-bucket, no-compress) DDP sync must compile to a
     program structurally identical to a direct sync_gradients call —
     same instruction opcodes in the same order, same collectives. The
-    new comm modes are strictly opt-in."""
+    new comm modes are strictly opt-in: that includes the hierarchical
+    ``comm_plan`` — ``comm_plan=None`` (explicit or defaulted) must
+    leave the compiled text BIT-identical to the default path."""
     from jax.sharding import Mesh
     from apex_tpu import parallel
     collectives = _pod_budget().collectives
@@ -1397,6 +1399,9 @@ def _():
     n = len(local)
     mesh = Mesh(np.array(local), ("data",))
     hlo_ddp, _ = _ddp_toy_step(mesh, n)
+    hlo_none, _ = _ddp_toy_step(mesh, n, comm_plan=None)
+    assert hlo_none == hlo_ddp, (
+        "comm_plan=None changed the compiled default DDP program")
 
     # the manual twin: same step body, sync_gradients under the same
     # collective span DDP.sync uses

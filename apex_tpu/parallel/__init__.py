@@ -9,12 +9,16 @@ capability the reference lacks but a TPU framework owes its users.
 
 from apex_tpu.parallel.mesh import (
     DATA_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS, EXPERT_AXIS,
+    DATA_INTER_AXIS, DATA_INTRA_AXIS,
     make_mesh, data_parallel_mesh, hierarchical_data_mesh,
     replicated, batch_sharding, axis_size, local_batch,
 )
 from apex_tpu.parallel.comm import (
     bucket_plan, bucket_table, bucketed_all_reduce, init_residual,
     wire_bytes,
+)
+from apex_tpu.parallel.hierarchy import (
+    CommPlan, Hop, plan_comm, hierarchical_sync, hierarchical_pmean,
 )
 from apex_tpu.parallel.distributed import (
     DistributedDataParallel, Reducer, sync_gradients, flat_all_reduce,
@@ -42,8 +46,11 @@ __all__ = [
     "replicated", "batch_sharding", "axis_size", "local_batch",
     "DistributedDataParallel", "Reducer", "sync_gradients",
     "flat_all_reduce", "flat_tree_all_reduce", "replicate",
+    "DATA_INTER_AXIS", "DATA_INTRA_AXIS",
     "bucket_plan", "bucket_table", "bucketed_all_reduce",
     "init_residual", "wire_bytes",
+    "CommPlan", "Hop", "plan_comm", "hierarchical_sync",
+    "hierarchical_pmean",
     "LARC", "larc_rewrite_grads",
     "CollectiveScope", "COLLECTIVE_SCOPES", "known_patterns",
     "scope_axis", "scope_entry",

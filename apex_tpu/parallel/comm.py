@@ -126,31 +126,58 @@ def bucket_plan(leaves, message_size: Optional[int] = None) -> List[Bucket]:
     return out
 
 
-def wire_bytes(plan: List[Bucket], compress: Optional[str] = None,
+def dtype_wire_bytes(elems: int, dtype: Optional[str],
+                     compress_block: int = DEFAULT_COMPRESS_BLOCK) -> int:
+    """Payload bytes of ``elems`` fp32-logical elements at a wire
+    dtype: ``None`` fp32, ``"bf16"`` half, ``"int8"`` one byte per
+    element plus one fp32 scale per ``compress_block``."""
+    if dtype is None:
+        return elems * 4
+    if dtype == "bf16":
+        return elems * 2
+    if dtype == "int8":
+        return elems + 4 * (-(-elems // compress_block))
+    raise ValueError(f"unknown compress mode {dtype!r}")
+
+
+def wire_bytes(plan: List[Bucket], compress=None,
                compress_block: int = DEFAULT_COMPRESS_BLOCK) -> int:
-    """Payload bytes on the wire for one full sync under ``compress``
-    (per all-reduce-equivalent, before the ring's 2·(N−1)/N factor).
-    int8 includes the per-block fp32 scales of both phases."""
-    total = 0
-    for b in plan:
-        if compress is None:
-            total += b.bytes()
-        elif compress == "bf16":
-            total += b.elems * 2
-        elif compress == "int8":
-            n_blocks = -(-b.elems // compress_block)
-            total += b.elems + 4 * n_blocks
-        else:
-            raise ValueError(f"unknown compress mode {compress!r}")
-    return total
+    """Payload bytes on the wire for one full sync under ``compress``,
+    in **all-reduce-equivalent** units (the buffer bytes a flat
+    all-reduce would carry, before the ring's 2·(N−1)/N factor), so the
+    ratio against ``wire_bytes(plan, None)`` is the wire compression.
+
+    ``compress`` is a single mode string applied to the whole sync
+    (``None``/``"bf16"``/``"int8"`` — int8 includes the per-block fp32
+    scales of both phases) **or** a
+    :class:`apex_tpu.parallel.hierarchy.CommPlan`, whose hops may mix
+    dtypes: each hop's per-chip ring-factored bytes are summed and
+    normalized back by the flat ring factor, so one number stays
+    comparable across flat and hierarchical schedules."""
+    if hasattr(compress, "hops"):          # a hierarchy.CommPlan —
+        total = sum(compress.bucket_wire_bytes(b.elems)  # duck-typed to
+                    for b in plan)                       # avoid the
+        return int(total / compress.flat_ring_factor())  # import cycle
+    # dtype_wire_bytes raises on unknown modes
+    return sum(dtype_wire_bytes(b.elems, compress, compress_block)
+               for b in plan)
 
 
-def bucket_table(plan: List[Bucket]) -> str:
-    """Human-readable bytes-per-bucket table."""
-    lines = ["  bucket  dtype     tensors      elems        MiB"]
+def bucket_table(plan: List[Bucket], compress=None,
+                 compress_block: int = DEFAULT_COMPRESS_BLOCK) -> str:
+    """Human-readable bytes-per-bucket table. ``compress`` (a mode
+    string or a hierarchical ``CommPlan``) appends the wire MiB the
+    bucket actually moves under that schedule — mixed per-hop dtypes
+    accounted, not the single-mode approximation."""
+    head = "  bucket  dtype     tensors      elems        MiB"
+    lines = [head + ("   wire MiB" if compress is not None else "")]
     for i, b in enumerate(plan):
-        lines.append(f"  {i:6d}  {b.dtype:8s} {len(b.leaf_idx):7d} "
-                     f"{b.elems:10d} {b.bytes() / 2 ** 20:10.2f}")
+        row = (f"  {i:6d}  {b.dtype:8s} {len(b.leaf_idx):7d} "
+               f"{b.elems:10d} {b.bytes() / 2 ** 20:10.2f}")
+        if compress is not None:
+            w = wire_bytes([b], compress, compress_block)
+            row += f" {w / 2 ** 20:10.2f}"
+        lines.append(row)
     return "\n".join(lines)
 
 
@@ -171,7 +198,17 @@ def init_residual(grads):
 
 def _quantize_int8(x: jax.Array, block: int):
     """Blockwise symmetric int8: one fp32 scale per ``block`` elements.
-    ``x.shape[0]`` must be a multiple of ``block``."""
+
+    Lengths not divisible by ``block`` are zero-padded to the next
+    block boundary (zeros quantize exactly and never raise a block's
+    max-abs scale, so the pad is invisible to the payload); ``q`` comes
+    back at the padded length — mask it off with
+    ``_dequantize_int8(..., n=x.shape[0])``. This lets a planner pick
+    ``compress_block`` independently of bucket boundaries."""
+    n = x.shape[0]
+    npad = -(-n // block) * block - n
+    if npad:
+        x = jnp.pad(x, (0, npad))
     xb = x.reshape(-1, block)
     scale = jnp.max(jnp.abs(xb), axis=1) / 127.0
     safe = jnp.where(scale > 0, scale, 1.0)
@@ -179,9 +216,11 @@ def _quantize_int8(x: jax.Array, block: int):
     return q.reshape(-1), scale
 
 
-def _dequantize_int8(q: jax.Array, scale: jax.Array, block: int):
-    return (q.astype(jnp.float32).reshape(-1, block)
-            * scale[:, None]).reshape(-1)
+def _dequantize_int8(q: jax.Array, scale: jax.Array, block: int,
+                     n: Optional[int] = None):
+    out = (q.astype(jnp.float32).reshape(-1, block)
+           * scale[:, None]).reshape(-1)
+    return out if n is None or n == out.shape[0] else out[:n]
 
 
 def _int8_all_reduce(buf: jax.Array, axis_name: str, block: int):

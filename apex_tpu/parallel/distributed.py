@@ -201,9 +201,39 @@ class DistributedDataParallel:
                  grad_dtype=None,
                  bucket_allreduce: bool = False,
                  compress: Optional[str] = None,
-                 compress_block: Optional[int] = None):
+                 compress_block: Optional[int] = None,
+                 comm_plan=None):
         from apex_tpu.parallel import comm as _comm
-        if axis_name not in mesh.axis_names:
+        if comm_plan is not None:
+            # a hierarchy.CommPlan IS the compression + topology spec:
+            # its axes replace axis_name, its per-hop dtypes replace
+            # compress, and delay_allreduce's one terminal flat reduce
+            # is the exact shape it exists to remove
+            if compress is not None or allreduce_always_fp32 or \
+                    delay_allreduce or compress_block is not None:
+                raise ValueError(
+                    "comm_plan fixes the per-hop wire dtypes, the "
+                    "quantization block and the topology; it does not "
+                    "compose with compress, compress_block, "
+                    "allreduce_always_fp32 or delay_allreduce (set "
+                    "compress_block via plan_comm)")
+            for ax in comm_plan.axis_names:
+                if ax not in mesh.axis_names:
+                    raise ValueError(
+                        f"comm_plan axis {ax!r} not in mesh "
+                        f"{mesh.axis_names} — build the mesh with "
+                        "hierarchical_data_mesh (or matching axis "
+                        "names) for a hierarchical plan")
+            for hop in comm_plan.hops:
+                if mesh.shape[hop.axis] != hop.size:
+                    raise ValueError(
+                        f"comm_plan axis {hop.axis!r} has size "
+                        f"{hop.size} but the mesh has "
+                        f"{mesh.shape[hop.axis]}")
+            axis_name = (comm_plan.axis_names[0]
+                         if len(comm_plan.axis_names) == 1
+                         else tuple(comm_plan.axis_names))
+        elif axis_name not in mesh.axis_names:
             raise ValueError(f"axis {axis_name!r} not in mesh "
                              f"{mesh.axis_names}")
         if compress not in _comm.COMPRESS_MODES:
@@ -218,6 +248,12 @@ class DistributedDataParallel:
                              "terminal flat reduce) are opposite modes")
         self.mesh = mesh
         self.axis_name = axis_name
+        #: None | hierarchy.CommPlan — the topology-aware hierarchical
+        #: schedule (int8 ICI reduce-scatter / bf16-or-int8 DCN reduce /
+        #: ICI all-gather, planner-chosen per hop; see
+        #: apex_tpu.parallel.hierarchy). Strictly opt-in: comm_plan=None
+        #: leaves every existing path untouched.
+        self.comm_plan = comm_plan
         self.gradient_average = gradient_average
         self.gradient_predivide_factor = gradient_predivide_factor
         self.allreduce_always_fp32 = allreduce_always_fp32
@@ -245,6 +281,11 @@ class DistributedDataParallel:
 
     @property
     def world_size(self) -> int:
+        if isinstance(self.axis_name, tuple):
+            n = 1
+            for a in self.axis_name:
+                n *= self.mesh.shape[a]
+            return n
         return self.mesh.shape[self.axis_name]
 
     # -- in-step API ---------------------------------------------------------
@@ -279,6 +320,29 @@ class DistributedDataParallel:
             return grads if residual is None else (grads, residual)
         from apex_tpu.parallel import comm as _comm
         from apex_tpu.trace.spans import span as _span
+        if self.comm_plan is not None:
+            from apex_tpu.parallel import hierarchy as _hier
+            msg = self.message_size if self.message_size else (
+                _comm.DEFAULT_MESSAGE_SIZE if self.bucket_allreduce
+                else None)
+            with _span("ddp/sync_gradients", kind="collective"):
+                if self.comm_plan.is_hierarchical:
+                    return _hier.hierarchical_sync(
+                        grads, self.comm_plan, message_size=msg,
+                        gradient_average=self.gradient_average,
+                        gradient_predivide_factor=self
+                        .gradient_predivide_factor,
+                        residual=residual)
+                # a flat (single-slice) plan is the planner-chosen
+                # compress mode over one axis — the existing machinery
+                return _comm.bucketed_all_reduce(
+                    grads, self.axis_name, message_size=msg,
+                    gradient_average=self.gradient_average,
+                    gradient_predivide_factor=self
+                    .gradient_predivide_factor,
+                    compress=self.comm_plan.hops[0].dtype,
+                    residual=residual,
+                    compress_block=self.comm_plan.compress_block)
         if self.bucket_allreduce or self.compress is not None:
             # compress without bucketing = one bucket per dtype
             msg = self.message_size if self.message_size else (
@@ -310,6 +374,19 @@ class DistributedDataParallel:
         see :func:`apex_tpu.parallel.comm.init_residual`."""
         from apex_tpu.parallel import comm as _comm
         return _comm.init_residual(grads)
+
+    def pmean(self, x):
+        """Cross-replica mean over this DDP's topology (use for the
+        logged loss). Matters with a hierarchical ``comm_plan``: a
+        ``jax.lax.pmean`` over the axis *tuple* lowers to one flat
+        whole-mesh all-reduce — the DCN-crossing shape APX203 flags —
+        while this emits one psum per axis (within-slice, then
+        one-member-per-slice across). Call it inside a registered
+        collective span (``ddp/loss_pmean``)."""
+        if self.comm_plan is not None and self.comm_plan.is_hierarchical:
+            from apex_tpu.parallel import hierarchy as _hier
+            return _hier.hierarchical_pmean(x, self.comm_plan)
+        return jax.lax.pmean(x, self.axis_name)
 
     def no_sync(self):
         """Context manager: steps wrapped while active skip gradient

@@ -32,6 +32,14 @@ SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
 
+#: canonical names of the FACTORED data axes (the hierarchical
+#: gradient-sync topology): ``data_inter`` crosses slices over DCN,
+#: ``data_intra`` stays inside a slice on ICI — the same names
+#: `hierarchical_data_mesh` builds and the ``dp2x4`` mesh-model spec
+#: declares, so a plan, a mesh and a model line up by construction.
+DATA_INTER_AXIS = "data_inter"
+DATA_INTRA_AXIS = "data_intra"
+
 
 def make_mesh(axis_sizes: Sequence[Tuple[str, int]],
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
@@ -71,7 +79,7 @@ def hierarchical_data_mesh(local_size: int, devices=None) -> Mesh:
     intra-node group + inter-node group). Collectives over ``data_intra``
     ride the fast interconnect; ``data_inter`` crosses slices/hosts.
     """
-    return make_mesh([("data_inter", -1), ("data_intra", local_size)],
+    return make_mesh([(DATA_INTER_AXIS, -1), (DATA_INTRA_AXIS, local_size)],
                      devices)
 
 

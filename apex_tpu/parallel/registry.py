@@ -33,7 +33,8 @@ import dataclasses
 import re
 from typing import Optional, Sequence, Tuple
 
-from apex_tpu.parallel.mesh import DATA_AXIS, SEQ_AXIS
+from apex_tpu.parallel.mesh import (DATA_AXIS, DATA_INTER_AXIS,
+                                    DATA_INTRA_AXIS, SEQ_AXIS)
 
 __all__ = ["CollectiveScope", "COLLECTIVE_SCOPES", "known_patterns",
            "scope_axis", "scope_entry"]
@@ -57,6 +58,16 @@ class CollectiveScope:
 COLLECTIVE_SCOPES: Tuple[CollectiveScope, ...] = (
     CollectiveScope(r"ddp/sync_gradients", DATA_AXIS, "ddp",
                     "gradient all-reduce across the data axis"),
+    # hop sub-spans of the hierarchical schedule BEFORE the generic
+    # bucket row: scope_entry returns the first match, and these carry
+    # the factored-axis attribution (canonical names — a deployment
+    # using its mesh model's own axis names still matches the pattern)
+    CollectiveScope(r"(^|/)bucket\d+/ici", DATA_INTRA_AXIS, "ddp",
+                    "hierarchical sync within-slice hop (reduce-"
+                    "scatter / all-gather over ICI)"),
+    CollectiveScope(r"(^|/)bucket\d+/dcn", DATA_INTER_AXIS, "ddp",
+                    "hierarchical sync cross-slice hop (one-member-"
+                    "per-slice reduce over DCN)"),
     CollectiveScope(r"(^|/)bucket\d+", DATA_AXIS, "ddp",
                     "per-bucket overlapped all-reduce sub-spans"),
     CollectiveScope(r"ddp/loss_pmean", DATA_AXIS, "ddp",

@@ -41,7 +41,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["MetricSpec", "METRICS", "Verdict", "SentinelReport",
            "extract_metrics", "load_rows", "check_row",
-           "check_trajectory", "load_baseline", "save_baseline"]
+           "check_trajectory", "load_baseline", "save_baseline",
+           "metric_specs_from_baseline"]
 
 #: degradation directions (the schema enum): "higher" = higher is
 #: better (a drop regresses), "lower" = lower is better (a rise does)
@@ -89,6 +90,53 @@ METRICS: Tuple[MetricSpec, ...] = (
 )
 
 
+def metric_specs_from_baseline(path_or_data) -> List[MetricSpec]:
+    """Extra judged metrics declared in the committed perf-baseline
+    file — the ``"metrics"`` list next to ``"waivers"``::
+
+        {"metrics": [{"name": "ddp_wire_bytes",
+                      "path": ["extra", "ddp_comm_modes", "modes",
+                               "hier_int8", "wire_bytes"],
+                      "direction": "lower", "rel_floor": 0.02,
+                      "reason": "..."}], ...}
+
+    A deployment (or a PR landing a new bench column) gates custom
+    metrics without forking the METRICS table; the entries are
+    direction-aware and waiverable exactly like the built-ins
+    (fingerprint ``regress|<name>``). A missing file or section is
+    empty; malformed entries raise — a silently-dropped gate is worse
+    than a loud config error."""
+    if isinstance(path_or_data, str):
+        try:
+            with open(path_or_data) as f:
+                data = json.load(f)
+        except OSError:
+            return []
+    else:
+        data = path_or_data or {}
+    out: List[MetricSpec] = []
+    for i, entry in enumerate(data.get("metrics", []) or []):
+        if not isinstance(entry, dict) or "name" not in entry \
+                or "path" not in entry or "direction" not in entry:
+            raise ValueError(
+                f"metrics[{i}]: want {{name, path, direction}} "
+                f"(+optional rel_floor/z/abs_floor/counter), got "
+                f"{entry!r}")
+        if entry["direction"] not in DIRECTIONS:
+            raise ValueError(f"metrics[{i}]: direction must be one of "
+                             f"{DIRECTIONS}, got "
+                             f"{entry['direction']!r}")
+        out.append(MetricSpec(
+            name=str(entry["name"]),
+            path=tuple(str(k) for k in entry["path"]),
+            direction=entry["direction"],
+            rel_floor=float(entry.get("rel_floor", 0.05)),
+            z=float(entry.get("z", 3.0)),
+            abs_floor=float(entry.get("abs_floor", 0.0)),
+            counter=bool(entry.get("counter", False))))
+    return out
+
+
 def _get_path(row: Dict, path: Tuple[str, ...]) -> Optional[float]:
     cur: Any = row
     for key in path:
@@ -100,10 +148,13 @@ def _get_path(row: Dict, path: Tuple[str, ...]) -> Optional[float]:
     return float(cur)
 
 
-def extract_metrics(row: Optional[Dict]) -> Dict[str, float]:
+def extract_metrics(row: Optional[Dict],
+                    specs: Sequence[MetricSpec] = METRICS
+                    ) -> Dict[str, float]:
     """The judged metric values present in one bench JSON row
     (missing/null columns are simply absent — older rows predate newer
-    columns)."""
+    columns). ``specs`` extends the table with baseline-declared
+    metrics (:func:`metric_specs_from_baseline`)."""
     if not isinstance(row, dict):
         return {}
     row = dict(row)
@@ -112,14 +163,16 @@ def extract_metrics(row: Optional[Dict]) -> Dict[str, float]:
     if value and batch:
         row["__ms_per_step__"] = batch / value * 1e3
     out: Dict[str, float] = {}
-    for spec in METRICS:
+    for spec in specs:
         v = _get_path(row, spec.path)
         if v is not None:
             out[spec.name] = v
     return out
 
 
-def load_rows(paths: Sequence[str]) -> List[Dict[str, Any]]:
+def load_rows(paths: Sequence[str],
+              specs: Sequence[MetricSpec] = METRICS
+              ) -> List[Dict[str, Any]]:
     """Load bench rows from files, tolerating both wire formats: a
     plain ``bench.py`` JSON line, or the driver capture wrapper
     (``{"n": …, "rc": …, "parsed": {…}|null}``). Returns
@@ -146,7 +199,7 @@ def load_rows(paths: Sequence[str]) -> List[Dict[str, Any]]:
                 why = obj.get("failure_reason")
                 note = (f"no parsed bench row (rc={obj.get('rc')}"
                         + (f"; {why}" if why else "") + ") — skipped")
-        metrics = extract_metrics(row)
+        metrics = extract_metrics(row, specs)
         if row is not None and not metrics and note is None:
             note = "no judged metrics in row — skipped"
         out.append({"path": path, "row": row, "metrics": metrics,
@@ -304,7 +357,9 @@ def check_trajectory(rows: Sequence[Dict[str, Any]], *,
 
 def replay_trajectory(rows: Sequence[Dict[str, Any]], *,
                       waivers: Optional[Dict[str, Dict]] = None,
-                      min_history: int = 2) -> List[SentinelReport]:
+                      min_history: int = 2,
+                      specs: Sequence[MetricSpec] = METRICS
+                      ) -> List[SentinelReport]:
     """Judge EVERY metric-bearing row against its prefix — the
     backtest proving the gate stays quiet on the committed history
     (``roofline_audit`` asserts it, then seeds a regression and asserts
@@ -318,7 +373,8 @@ def replay_trajectory(rows: Sequence[Dict[str, Any]], *,
         if bearing_seen <= min_history:
             continue                    # nothing judgeable yet
         reports.append(check_trajectory(rows[:i + 1], waivers=waivers,
-                                        min_history=min_history))
+                                        min_history=min_history,
+                                        specs=specs))
     return reports
 
 
@@ -351,6 +407,13 @@ def save_baseline(path: str, report: SentinelReport, *,
                                   "allow_to": v.latest,
                                   "baseline_was": v.baseline}
     data = {"version": 1, "waivers": waivers}
+    try:                      # a refresh must not drop the declared
+        with open(path) as f:  # extra-metrics section
+            prev = json.load(f)
+        if prev.get("metrics"):
+            data["metrics"] = prev["metrics"]
+    except (OSError, ValueError):
+        pass
     with open(path, "w") as f:
         json.dump(data, f, indent=1, sort_keys=True)
         f.write("\n")

@@ -387,7 +387,9 @@ def _bert_step_builder(batch, seq, encoder=None, vocab=30000,
             from apex_tpu.trace.spans import span
             grads = ddp.sync(grads)
             with span("ddp/loss_pmean", kind="collective"):
-                loss = jax.lax.pmean(loss, ddp.axis_name)
+                # topology-aware: one psum per axis under a hierarchical
+                # comm_plan, the plain flat pmean otherwise
+                loss = ddp.pmean(loss)
         return amp_opt.apply_gradients(state, grads, finite), loss
 
     return step, state, (toks, labels), policy, enc, variables
@@ -713,6 +715,32 @@ def _ddp_comm_modes():
         out["modes"][mode or "exact"] = {
             "wire_mib": round(w / 2 ** 20, 2),
             "ratio": round(w / logical, 4)}
+
+    # the hierarchical schedule over the canonical 2-slice model
+    # (collectives v2): mixed per-hop dtypes accounted (all-reduce-
+    # equivalent units, so the ratio is against the same flat-fp32
+    # denominator), plus the predicted DCN milliseconds next to what
+    # the FLAT sync's DCN crossing would cost — the number APX203
+    # prints, now with the hierarchical answer beside it. wire_bytes
+    # feeds the perf sentinel's ddp_wire_bytes metric
+    # (scripts/perf_baseline.json): a regression toward flat sync
+    # multiplies it.
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    from apex_tpu.parallel import hierarchy
+
+    mm = parse_mesh_spec("dp2x4")
+    cplan = hierarchy.plan_comm(mm, grad_bytes=logical)
+    w = comm.wire_bytes(plan, cplan)
+    pred = cplan.predicted_seconds(logical)
+    out["modes"]["hier_int8"] = {
+        "wire_mib": round(w / 2 ** 20, 2),
+        "ratio": round(w / logical, 4),
+        "wire_bytes": int(w),
+        "dtype_by_link": {k: (v or "f32")
+                          for k, v in cplan.dtype_by_link().items()},
+        "predicted_dcn_ms": round(pred.get("dcn", 0.0) * 1e3, 3),
+        "flat_dcn_ms": round(mm.hop_seconds(logical, "dcn") * 1e3, 3),
+        "source": cplan.source}
     return out
 
 

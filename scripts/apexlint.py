@@ -32,12 +32,17 @@ flagship targets become their DDP shard_map variants compiled over a
 matching device mesh (on CPU: 8 virtual devices, structural
 downscalings per the ``pod_comm_budget --cpu8`` convention), with the
 topology judged against the declarative mesh model
-(``apex_tpu.lint.mesh_model``). With ``--hlo``/``--import`` the mesh
+(``apex_tpu.lint.mesh_model``). A MULTI-SLICE model builds the
+factored mesh and the hierarchical ``comm_plan`` flagship
+(docs/parallel.md#hierarchical) — APX203-clean is the expected state
+(docs/linting.md#apx203-clean). ``--flat-sync`` forces the historical
+flat-mesh flat-sync variant instead: the negative-twin/debug view
+whose APX203 finding carries the model's (possibly measured) DCN hop
+milliseconds — ``goodput_audit --cpu8`` uses it to prove measured
+bytes/s reach the evidence. With ``--hlo``/``--import`` the mesh
 model applies to those modules instead. ``run_tier1.sh --smoke`` runs
 ``--mesh dp2x4 --fail-on error`` as the cpu8 cross-rank congruence
-audit: the clean flagships must report zero errors (the expected
-APX203 warnings on the flat DDP sync over the 2-slice model are the
-ROADMAP item-2 feeder, not failures).
+audit: the flagships must report zero errors and no APX203.
 
 Output: the finding table on stdout; ``--jsonl FILE`` streams
 ``lint_report``/``lint_finding`` events through the
@@ -185,18 +190,32 @@ FLAGSHIP_GROUPS = {"both": ("resnet", "bert"),
                    "all": ("resnet", "bert", "guarded", "ckpt")}
 
 
-def _build_mesh_flagship_resnet(mesh):
+def _mesh_comm_plan(mesh_model, grad_bytes):
+    """The hierarchical ``CommPlan`` for a multi-slice mesh model (the
+    collectives-v2 flagship path: APX203-clean by construction), or
+    None for a single-slice model (the flat path stays the subject)."""
+    from apex_tpu.parallel import hierarchy
+
+    if not any(a.link == "dcn" for a in mesh_model.axes):
+        return None
+    return hierarchy.plan_comm(mesh_model, grad_bytes=grad_bytes)
+
+
+def _build_mesh_flagship_resnet(mesh, mesh_model=None):
     """The flagship O2+DDP step over a device mesh — the exact
     ``pod_comm_budget.build_step`` program (shared definition), at the
     ``--cpu8`` structural scale off-TPU, jitted with donated carried
     state. Linted with a mesh model this is the cross-rank congruence
-    audit target."""
+    audit target; a MULTI-SLICE model makes it the hierarchical
+    compressed-sync flagship (``comm_plan`` from the model — APX203 is
+    expected ABSENT; the flat negative twin lives in
+    ``pod_comm_budget --cpu8`` and tests/test_pod_hlo.py)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     import pod_comm_budget as pcb
-    from apex_tpu import amp, models, parallel
+    from apex_tpu import amp, models
 
     on_tpu = jax.default_backend() == "tpu"
     n = int(np.prod(mesh.devices.shape))
@@ -206,10 +225,18 @@ def _build_mesh_flagship_resnet(mesh):
         model = models.ResNet(stage_sizes=[1, 1], num_classes=10,
                               width=16, dtype=jnp.bfloat16)
         size, per_chip = 32, 4
-    step, model, amp_opt = pcb.build_step(mesh, False, model=model)
+    if model is None:
+        model = models.ResNet(stage_sizes=[3, 4, 6, 3],
+                              num_classes=1000, dtype=jnp.bfloat16)
     x1 = jnp.ones((2, size, size, 3), jnp.float32)
     variables = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0), x1, train=True))
+    n_params = sum(int(np.prod(l.shape)) for l in
+                   jax.tree_util.tree_leaves(variables["params"]))
+    plan = (None if mesh_model is None
+            else _mesh_comm_plan(mesh_model, 4 * n_params))
+    step, model, amp_opt, ddp = pcb.build_step(mesh, False, model=model,
+                                               comm_plan=plan)
     state_s = jax.eval_shape(
         lambda: amp_opt.init(jax.tree_util.tree_map(
             lambda a: jnp.zeros(a.shape, a.dtype),
@@ -219,18 +246,21 @@ def _build_mesh_flagship_resnet(mesh):
     y_s = jax.ShapeDtypeStruct((per_chip * n,), jnp.int32)
     stepped = jax.jit(jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(), P(parallel.DATA_AXIS),
-                  P(parallel.DATA_AXIS)),
+        in_specs=(P(), P(), P(ddp.axis_name), P(ddp.axis_name)),
         out_specs=(P(), P(), P()), check_vma=False),
         donate_argnums=(0, 1))
+    name = ("resnet50_o2_hier_ddp_step" if plan is not None
+            else "resnet50_o2_ddp_step")
     return (stepped,
             (state_s, variables["batch_stats"], x_s, y_s),
-            amp.Policy.from_opt_level("O2"), "resnet50_o2_ddp_step")
+            amp.Policy.from_opt_level("O2"), name)
 
 
-def _build_mesh_flagship_bert(mesh):
+def _build_mesh_flagship_bert(mesh, mesh_model=None):
     """The BERT-LAMB step DDP-wrapped over a device mesh (grad
-    all-reduce under the ``ddp/sync_gradients`` span), donated."""
+    all-reduce under the ``ddp/sync_gradients`` span), donated. A
+    multi-slice mesh model selects the hierarchical ``comm_plan`` like
+    the resnet sibling."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -245,16 +275,27 @@ def _build_mesh_flagship_bert(mesh):
         enc = models.BertEncoder(30000, hidden=128, layers=2, heads=2,
                                  max_len=64)
         per_chip, seq = 1, 64
-    ddp = parallel.DistributedDataParallel(mesh)
+    plan = None
+    if mesh_model is not None:
+        import jax.numpy as jnp
+        e = enc if enc is not None else models.BertLarge()
+        toks_s = jnp.zeros((1, seq), jnp.int32)
+        var_s = jax.eval_shape(
+            lambda: e.init(jax.random.PRNGKey(0), toks_s))
+        n_params = sum(int(np.prod(l.shape)) for l in
+                       jax.tree_util.tree_leaves(var_s["params"]))
+        plan = _mesh_comm_plan(mesh_model, 4 * n_params)
+    ddp = parallel.DistributedDataParallel(mesh, comm_plan=plan)
     step, state, (toks, labels), policy, _enc, _vars = \
         bench._bert_step_builder(per_chip * n, seq, encoder=enc,
                                  ddp=ddp)
     stepped = jax.jit(jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(parallel.DATA_AXIS), P(parallel.DATA_AXIS)),
+        in_specs=(P(), P(ddp.axis_name), P(ddp.axis_name)),
         out_specs=(P(), P()), check_vma=False), donate_argnums=(0,))
-    return (stepped, (state, toks, labels), policy,
-            "bert_lamb_ddp_step")
+    name = ("bert_lamb_hier_ddp_step" if plan is not None
+            else "bert_lamb_ddp_step")
+    return (stepped, (state, toks, labels), policy, name)
 
 
 MESH_FLAGSHIPS = {"resnet": _build_mesh_flagship_resnet,
@@ -275,12 +316,14 @@ def _import_builder(spec):
     return fn, args, policy, spec
 
 
-def _mesh_for_model(mm):
-    """A flat-data-axis device mesh matching the mesh model's device
-    count — the program's LOGICAL axis; the model describes the
-    physical topology its flattened device ids map onto (the flat DDP
-    sync over a multi-slice model is exactly what APX203 exists to
-    call out)."""
+def _mesh_for_model(mm, flat_sync=False):
+    """A device mesh matching the mesh model: factored by the model's
+    own axes (row-major, the same layout the model's coordinate
+    arithmetic assumes), so a multi-slice model yields the factored
+    mesh the hierarchical ``comm_plan`` runs on — the program axes ARE
+    the physical axes. ``flat_sync`` keeps the historical flat
+    single-``data``-axis view (the flat DDP sync over a multi-slice
+    model is then exactly what APX203 exists to call out)."""
     import jax
     from jax.sharding import Mesh
 
@@ -290,7 +333,12 @@ def _mesh_for_model(mm):
     if len(devs) < mm.n_devices:
         raise SystemExit(f"mesh model {mm!r} needs {mm.n_devices} "
                          f"devices, have {len(devs)}")
-    return Mesh(np.array(devs[:mm.n_devices]), (parallel.DATA_AXIS,))
+    if flat_sync or len(mm.axes) == 1:
+        return Mesh(np.array(devs[:mm.n_devices]),
+                    (parallel.DATA_AXIS,))
+    sizes = [a.size for a in mm.axes]
+    return Mesh(np.array(devs[:mm.n_devices]).reshape(sizes),
+                mm.axis_names)
 
 
 def main(argv=None) -> int:
@@ -299,7 +347,7 @@ def main(argv=None) -> int:
     imports, hlo_files = [], []
     baseline_path = write_baseline = jsonl_path = mesh_spec = None
     fail_on = "error"
-    as_json = False
+    as_json = flat_sync = False
     it = iter(argv)
     for a in it:
         if a in ("-h", "--help"):
@@ -307,6 +355,9 @@ def main(argv=None) -> int:
             return 2
         elif a == "--json":
             as_json = True
+            continue
+        elif a == "--flat-sync":
+            flat_sync = True
             continue
         elif a not in ("--flagship", "--import", "--hlo", "--baseline",
                        "--write-baseline", "--jsonl", "--fail-on",
@@ -404,8 +455,12 @@ def main(argv=None) -> int:
             report = lint.lint_hlo_file(what, mesh_model=mesh_model)
         else:
             if kind == "flagship" and mesh_model is not None:
-                mesh = _mesh_for_model(mesh_model)
-                fn, args, policy, name = MESH_FLAGSHIPS[what](mesh)
+                mesh = _mesh_for_model(mesh_model, flat_sync=flat_sync)
+                # --flat-sync: the builder sees no model, so no
+                # comm_plan — the flat sync is the lint subject (the
+                # model still judges it below)
+                fn, args, policy, name = MESH_FLAGSHIPS[what](
+                    mesh, None if flat_sync else mesh_model)
             else:
                 fn, args, policy, name = (FLAGSHIPS[what]()
                                           if kind == "flagship"

@@ -241,9 +241,13 @@ def audit_link_calibration(tmp: str) -> None:
         "measured dcn bytes/s indistinguishable from the default"
 
     lint_jsonl = os.path.join(tmp, "lint_measured.jsonl")
+    # --flat-sync: the flagship default is now the hierarchical
+    # comm_plan (APX203-clean by design — docs/linting.md#apx203-clean);
+    # this leg needs the FLAT twin precisely so APX203 fires and its
+    # hop-ms evidence can be checked against the measured bytes/s
     r = subprocess.run(
         [sys.executable, os.path.join(_REPO, "scripts", "apexlint.py"),
-         "--flagship", "resnet", "--mesh", model_path,
+         "--flagship", "resnet", "--mesh", model_path, "--flat-sync",
          "--fail-on", "error", "--jsonl", lint_jsonl],
         capture_output=True, text=True, env=env, cwd=_REPO)
     assert r.returncode == 0, \

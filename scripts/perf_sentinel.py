@@ -91,12 +91,18 @@ def main(argv=None) -> int:
     try:
         waivers = sentinel.load_baseline(baseline_path) if baseline_path \
             else {}
+        # the baseline may also DECLARE extra judged metrics (the
+        # "metrics" section — e.g. ddp_wire_bytes over the hierarchical
+        # sync row), direction-aware and waiverable like the built-ins
+        extra = (sentinel.metric_specs_from_baseline(baseline_path)
+                 if baseline_path else [])
     except ValueError as e:
         # a corrupt committed waiver file is a config error (exit 2),
         # not an "unwaived regression" (exit 1)
         print(f"perf_sentinel: {baseline_path}: {e}", file=sys.stderr)
         return 2
-    rows = sentinel.load_rows(files)
+    specs = tuple(sentinel.METRICS) + tuple(extra)
+    rows = sentinel.load_rows(files, specs=specs)
 
     # a gate that judged NOTHING must not report clean: unreadable
     # inputs (a moved trajectory, an unexpanded glob passed literally)
@@ -117,7 +123,8 @@ def main(argv=None) -> int:
         return 2
 
     if replay:
-        reports = sentinel.replay_trajectory(rows, waivers=waivers)
+        reports = sentinel.replay_trajectory(rows, waivers=waivers,
+                                             specs=specs)
         bad = [r for r in reports if not r.ok]
         for rep in reports:
             tag = "ok" if rep.ok else "REGRESSED"
@@ -133,7 +140,8 @@ def main(argv=None) -> int:
         # exit code judges, not only in the final row's verdicts
         events = [ev for rep in reports for ev in rep.to_events()]
     else:
-        report = sentinel.check_trajectory(rows, waivers=waivers)
+        report = sentinel.check_trajectory(rows, waivers=waivers,
+                                           specs=specs)
         bad = [] if report.ok else [report]
         print(f"-- judging {report.subject} against "
               f"{sum(1 for r in rows if r['metrics']) - 1} prior rows")

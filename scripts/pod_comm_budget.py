@@ -121,21 +121,24 @@ def stablehlo_collectives(text: str):
 
 
 def build_step(mesh, delay_allreduce, model=None, *,
-               bucket_allreduce=False, message_size=None, compress=None):
+               bucket_allreduce=False, message_size=None, compress=None,
+               comm_plan=None):
     """The flagship O2+DDP step — ONE definition shared by this
     script's v5e-64 audit and tests/test_pod_hlo.py's CI assertions,
     so what CI pins is exactly what the pod evidence compiled.
     ``bucket_allreduce``/``message_size``/``compress`` select the
-    overlapped/compressed sync modes (apex_tpu.parallel.comm)."""
-    from jax.sharding import PartitionSpec as P
-
+    overlapped/compressed sync modes (apex_tpu.parallel.comm);
+    ``comm_plan`` (a ``parallel.hierarchy.CommPlan``) the hierarchical
+    per-hop schedule over a factored mesh — the loss mean then also
+    goes hierarchical (``ddp.pmean``), so no scalar flat reduce crosses
+    the DCN boundary either."""
     from apex_tpu import amp, models, ops, parallel
     from apex_tpu.optim import FusedSGD
 
     ddp = parallel.DistributedDataParallel(
         mesh, delay_allreduce=delay_allreduce,
         bucket_allreduce=bucket_allreduce, message_size=message_size,
-        compress=compress)
+        compress=compress, comm_plan=comm_plan)
     if model is None:
         model = models.ResNet(stage_sizes=[3, 4, 6, 3],
                               num_classes=1000, dtype=jnp.bfloat16)
@@ -152,7 +155,7 @@ def build_step(mesh, delay_allreduce, model=None, *,
             # registered scope (parallel.registry "ddp/loss_pmean") —
             # a bare pmean here is an APX102 finding in the --mesh audit
             with span("ddp/loss_pmean", kind="collective"):
-                loss = jax.lax.pmean(loss, parallel.DATA_AXIS)
+                loss = ddp.pmean(loss)
             return loss, mut["batch_stats"]
 
         (loss, new_bs), grads, state, finite = amp_opt.backward(
@@ -161,23 +164,22 @@ def build_step(mesh, delay_allreduce, model=None, *,
         state = amp_opt.apply_gradients(state, grads, finite)
         return state, new_bs, loss
 
-    return step, model, amp_opt
+    return step, model, amp_opt, ddp
 
 
 def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=256,
                    model=None, image_size=224, bucket_allreduce=False,
-                   message_size=None, compress=None):
+                   message_size=None, compress=None, comm_plan=None):
     """Lower the full ResNet-50 O2+DDP step over ``mesh`` using only
-    avals (no real arrays — works on abstract topology devices)."""
+    avals (no real arrays — works on abstract topology devices). With
+    ``comm_plan`` the batch splits over the plan's (inter, intra) axis
+    tuple instead of the flat data axis."""
     from jax.sharding import PartitionSpec as P
 
-    from apex_tpu import parallel
-
-    step, model, amp_opt = build_step(mesh, delay_allreduce,
-                                      model=model,
-                                      bucket_allreduce=bucket_allreduce,
-                                      message_size=message_size,
-                                      compress=compress)
+    step, model, amp_opt, ddp = build_step(
+        mesh, delay_allreduce, model=model,
+        bucket_allreduce=bucket_allreduce, message_size=message_size,
+        compress=compress, comm_plan=comm_plan)
 
     # shape-only init on the default backend (tiny arrays, real mesh
     # not needed): we just need the state/batch_stats avals
@@ -194,10 +196,10 @@ def lower_flagship(mesh, n, *, delay_allreduce, per_chip_batch=256,
                                jnp.float32)
     y_s = jax.ShapeDtypeStruct((batch,), jnp.int32)
 
+    batch_axes = ddp.axis_name    # flat name, or the plan's axis tuple
     stepped = jax.jit(jax.shard_map(
         step, mesh=mesh,
-        in_specs=(P(), P(), P(parallel.DATA_AXIS),
-                  P(parallel.DATA_AXIS)),
+        in_specs=(P(), P(), P(batch_axes), P(batch_axes)),
         out_specs=(P(), P(), P()),
         check_vma=False))
     return stepped.lower(state_s, bs_s, x_s, y_s), params_s
@@ -331,6 +333,100 @@ def print_overlap(hlo, leaves, message_size):
               f"compute-between={p['compute_between']}")
 
 
+# --- hierarchical-schedule audit ---------------------------------------------
+
+def _hier_model(override=None):
+    """The 2-slice mesh model the hierarchical audit judges against:
+    the ``dp2x4`` cpu8 topology, upgraded with a ``--mesh model.json``
+    override — a multi-slice override replaces it outright, a
+    single-slice measured model (what ``link_probe --cpu8`` emits on a
+    flat mesh) contributes its measured budgets/calibration so the
+    plan rests on measurements where we have them."""
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+
+    mm = parse_mesh_spec("dp2x4")
+    if override is not None:
+        if any(a.link == "dcn" for a in override.axes):
+            return override
+        mm.link_bytes_per_s.update(override.link_bytes_per_s)
+        mm.calibration.update(override.calibration)
+    return mm
+
+
+def hierarchical_mesh_for_model(mesh_model, devices):
+    """The factored device mesh a multi-slice model describes: devices
+    reshaped row-major by the model's OWN axis sizes under its own
+    axis names (a ``--mesh`` override with a different factorization
+    than dp2x4 gets the mesh it declares, not a hardcoded 2x(n/2)).
+    Refuses a device-count mismatch with a clear message instead of a
+    reshape traceback."""
+    from jax.sharding import Mesh
+
+    devices = np.asarray(devices).reshape(-1)
+    if devices.size != mesh_model.n_devices:
+        raise SystemExit(
+            f"mesh model {mesh_model!r} wants {mesh_model.n_devices} "
+            f"devices, have {devices.size} — pass a model matching "
+            "the audit mesh")
+    sizes = [a.size for a in mesh_model.axes]
+    return Mesh(devices.reshape(sizes), mesh_model.axis_names)
+
+
+def hierarchical_structure_audit(hlo: str, mesh_model):
+    """Assert the hierarchical collective structure of a compiled
+    module against a mesh model — the standing APX203 gate:
+
+    - every collective scoped under a ``dcn`` hop sub-span has replica
+      groups with EXACTLY one member per slice (the hierarchical
+      shape), and both hop classes are present;
+    - every ``ici``-hop collective stays inside one slice;
+    - ``dcn_flat_findings`` (apexlint APX203) is EMPTY over the whole
+      module — a regression to a flat DCN-crossing reduce fails here
+      before it costs a pod.
+
+    Returns ``(dcn_instrs, ici_instrs)`` for reporting. Raises
+    AssertionError on violation (``--cpu8`` exit status carries it)."""
+    from apex_tpu.lint.spmd_pass import (dcn_flat_findings,
+                                         extract_collective_schedule)
+    from apex_tpu.monitor.collectives import scope_hop
+
+    sched = extract_collective_schedule(hlo)
+    hops = {"dcn": [], "ici": []}
+    for i in sched:
+        hops.setdefault(scope_hop(i.scope), []).append(i)
+    assert hops["dcn"], ("no DCN-hop collectives in the module — the "
+                         "hierarchical schedule did not compile")
+    assert hops["ici"], "no ICI-hop collectives in the module"
+    n_slices = 1
+    for a in mesh_model.axes:
+        if a.link == "dcn":
+            n_slices *= a.size
+    # empty replica_groups means ONE implicit whole-mesh group for
+    # either hop class — a slice-crossing shape the ICI assertion must
+    # see, not skip
+    def _groups(instr):
+        return instr.replica_groups or (
+            tuple(range(mesh_model.n_devices)),)
+
+    for instr in hops["dcn"]:
+        for g in _groups(instr):
+            slices = [mesh_model.slice_id(m) for m in g]
+            assert len(g) == n_slices and len(set(slices)) == len(g), (
+                f"DCN-hop {instr.describe()} group {g} is not "
+                f"one-member-per-slice over {n_slices} slices")
+    for instr in hops["ici"]:
+        for g in _groups(instr):
+            slices = {mesh_model.slice_id(m) for m in g}
+            assert len(slices) == 1, (
+                f"ICI-hop {instr.describe()} group {g} crosses slices "
+                f"{sorted(slices)}")
+    findings = dcn_flat_findings(sched, mesh_model)
+    assert not findings, (
+        "APX203 reappeared on the hierarchical path:\n"
+        + "\n".join(f.message for f in findings))
+    return hops["dcn"], hops["ici"]
+
+
 def _flagship_modes():
     """(label, lower_flagship kwargs) per audited DDP mode."""
     return [
@@ -361,8 +457,9 @@ def main():
     n = len(topo.devices)
     mesh = Mesh(np.array(topo.devices), (parallel.DATA_AXIS,))
     print(f"AOT target: {topology} ({n} chips)")
-    ici_bps, _ = _mesh_override(sys.argv)
+    ici_bps, override = _mesh_override(sys.argv)
 
+    params_s = None
     for label, kw in _flagship_modes():
         print(f"\nDDP {label}:")
         lowered, params_s = lower_flagship(mesh, n, **kw)
@@ -371,6 +468,38 @@ def main():
         if kw.get("bucket_allreduce"):
             leaves = jax.tree_util.tree_leaves(params_s)
             print_overlap(hlo, leaves, kw["message_size"])
+
+    # hierarchical: factor the pod as 2 slices over DCN and audit the
+    # per-hop schedule (ICI reduce-scatter, one-member-per-slice DCN
+    # reduce, ICI all-gather) from the scheduled HLO
+    if n >= 4 and n % 2 == 0:
+        from apex_tpu.lint.mesh_model import MeshAxis, MeshModel
+        from apex_tpu.parallel import hierarchy
+
+        mm = _hier_model(override)
+        if mm.n_devices != n:
+            mm = MeshModel((MeshAxis("data_inter", 2, "dcn"),
+                            MeshAxis("data_intra", n // 2, "ici")),
+                           link_bytes_per_s=mm.link_bytes_per_s,
+                           calibration=mm.calibration,
+                           name=f"dp2x{n // 2}")
+        mesh_h = hierarchical_mesh_for_model(mm, topo.devices)
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(params_s))
+        plan = hierarchy.plan_comm(mm, grad_bytes=4 * n_params)
+        print(f"\nDDP hierarchical ({plan.describe()}):")
+        pred = plan.predicted_seconds()
+        print("  predicted hop ms: "
+              + ", ".join(f"{k} {v * 1e3:.2f}" for k, v in pred.items()))
+        lowered, params_s = lower_flagship(
+            mesh_h, n, delay_allreduce=False,
+            message_size=10_000_000, comm_plan=plan)
+        hlo = lowered.compile().as_text()
+        report(hlo, params_s, n, ici_bytes_per_s=ici_bps)
+        dcn_i, ici_i = hierarchical_structure_audit(hlo, mm)
+        print(f"  hierarchical structure ok: {len(ici_i)} ICI-hop + "
+              f"{len(dcn_i)} one-per-slice DCN-hop collectives, "
+              f"APX203 absent")
 
 
 def main_cpu8():
@@ -392,8 +521,9 @@ def main_cpu8():
     model = models.ResNet(stage_sizes=[1, 1], num_classes=10, width=16,
                           dtype=jnp.bfloat16)
     message_size = 30_000
-    _mesh_override(sys.argv)      # prints the measured budget if given
+    _, override = _mesh_override(sys.argv)  # measured budget if given
 
+    flat_hlo = None
     print("overlap audit, 8-device CPU mesh (structural variant)")
     for label, kw in (
             ("bucketed", dict(bucket_allreduce=True,
@@ -405,6 +535,8 @@ def main_cpu8():
             mesh, 8, delay_allreduce=False, model=model, image_size=32,
             per_chip_batch=4, **kw)
         hlo = lowered.compile().as_text()
+        if kw.get("compress") is None:
+            flat_hlo = hlo     # the APX203 negative twin's subject
         leaves = jax.tree_util.tree_leaves(params_s)
         plan = comm.bucket_plan(leaves, message_size)
         colls = collectives(hlo)
@@ -430,6 +562,63 @@ def main_cpu8():
                 f"bf16 mode did not halve wire bytes: {wire} vs "
                 f"{logical}")
         print_overlap(hlo, leaves, message_size)
+
+    # --- hierarchical schedule: the standing APX203 gate -----------------
+    # The factored (2-slice x 4-chip) mesh judged against the dp2x4
+    # model (measured link budgets folded in when --mesh gives them):
+    # one-member-per-slice DCN groups, within-slice ICI hops, APX203
+    # ABSENT — plus the committed negative twin: the flat module above
+    # must still FIRE APX203 against the same model, or the gate rotted.
+    from apex_tpu import monitor
+    from apex_tpu.lint.spmd_pass import dcn_flat_findings
+    from apex_tpu.parallel import hierarchy
+
+    mm = _hier_model(override)
+    mesh_h = hierarchical_mesh_for_model(mm, jax.devices())
+    n_params = sum(int(np.prod(l.shape)) for l in leaves)
+    plan = hierarchy.plan_comm(mm, grad_bytes=4 * n_params)
+    print(f"\nmode hierarchical: {plan.describe()}")
+    lowered, params_s = lower_flagship(
+        mesh_h, 8, delay_allreduce=False, model=model, image_size=32,
+        per_chip_batch=4, message_size=message_size, comm_plan=plan)
+    hlo = lowered.compile().as_text()
+    leaves = jax.tree_util.tree_leaves(params_s)
+    bplan = comm.bucket_plan(leaves, message_size)
+    print(comm.bucket_table(bplan, plan))
+    wire = comm.wire_bytes(bplan, plan)
+    logical = comm.wire_bytes(bplan, None)
+    print(f"  wire {wire} B vs logical {logical} B (all-reduce-equiv "
+          f"ratio {wire / logical:.3f})")
+    assert wire <= logical * 0.45, (
+        f"hierarchical plan did not compress: {wire} vs {logical}")
+
+    dcn_i, ici_i = hierarchical_structure_audit(hlo, mm)
+    print(f"  structure ok: {len(ici_i)} ICI-hop + {len(dcn_i)} "
+          f"one-per-slice DCN-hop collectives, APX203 absent")
+
+    # per-hop per-dtype wire split (monitor.wire_report) — int8 payload
+    # survives CPU optimization (no float-normalization on s8), so the
+    # split is assertable wherever the plan put int8 on a hop
+    by_hop = monitor.wire_report(hlo_text=hlo)["by_hop"]
+    print("  per-hop wire split: "
+          + "; ".join(f"{h} {{" + ", ".join(
+              f"{dt}: {b}" for dt, b in sorted(per.items())) + "}"
+              for h, per in sorted(by_hop.items())))
+    assert "ici" in by_hop and "dcn" in by_hop, by_hop
+    expect = {None: "f32", "bf16": "bf16", "int8": "s8"}
+    for hop_name, hop in (("ici", plan.intra), ("dcn", plan.inter)):
+        if hop.dtype == "bf16":
+            continue     # CPU float-normalization promotes bf16 wires
+        assert expect[hop.dtype] in by_hop[hop_name], (
+            hop_name, hop.dtype, by_hop)
+
+    assert flat_hlo is not None
+    neg = dcn_flat_findings(flat_hlo, mm)
+    assert neg, ("negative twin broken: the flat bucketed sync no "
+                 "longer trips APX203 against the 2-slice model — the "
+                 "hierarchical gate would pass vacuously")
+    print(f"  negative twin ok: flat path still fires APX203 "
+          f"({len(neg)} finding(s))")
     print("\ncpu8 overlap audit ok")
 
 

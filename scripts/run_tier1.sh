@@ -5,7 +5,12 @@
 #   scripts/run_tier1.sh --smoke    # fast pre-flight: schema validators
 #                                   # + a 3-step traced bench.py --trace run
 #                                   # + the DDP overlap audit (8-device
-#                                   #   CPU variant of pod_comm_budget)
+#                                   #   CPU variant of pod_comm_budget,
+#                                   #   incl. the hierarchical-schedule
+#                                   #   gate: one-member-per-slice DCN
+#                                   #   groups, per-hop dtype split,
+#                                   #   APX203 ABSENT + the flat
+#                                   #   negative twin still firing)
 #                                   # + the memory-budget audit (--cpu8)
 #                                   # + the ckpt save->kill->elastic-
 #                                   #   restore roundtrip (--cpu8)
@@ -105,6 +110,11 @@ assert isinstance(ct.get("traceEvents"), list) and ct["traceEvents"], \
 EOF
 
     echo "== smoke: DDP overlap audit (8-device CPU variant)"
+    # includes the hierarchical compressed-sync gate: the factored
+    # (2-slice x 4) mesh compiles int8 ICI reduce-scatter ->
+    # one-member-per-slice DCN reduce -> ICI all-gather, APX203 stays
+    # ABSENT on that module (exit 1 on reappearance), and the flat
+    # negative twin still fires it — the ROADMAP item-2 done-state.
     JAX_PLATFORMS=cpu python scripts/pod_comm_budget.py --cpu8
 
     echo "== smoke: memory-budget audit (8-device CPU variant)"
@@ -159,11 +169,12 @@ EOF
 
     echo "== smoke: apexlint cross-rank congruence audit (cpu8, dp2x4)"
     # the SPMD pass over the DDP flagship steps compiled on the
-    # 8-device CPU mesh, judged against the 2-slice x 4-chip topology
-    # model: asserts zero APX201 deadlock/divergence and zero
-    # error-severity findings. The APX203 warnings it prints (the flat
-    # ddp/sync_gradients all-reduce crossing the modeled DCN boundary)
-    # are the ROADMAP item-2 hierarchical-collective feeder, by design.
+    # FACTORED 2-slice x 4-chip mesh with the hierarchical comm_plan
+    # (collectives v2): asserts zero APX201 deadlock/divergence and
+    # zero error-severity findings. APX203-clean is now the EXPECTED
+    # flagship state (docs/linting.md) — the flat negative twin that
+    # proves the rule still fires lives in pod_comm_budget --cpu8 and
+    # tests/test_pod_hlo.py.
     JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship both \
         --mesh dp2x4 --baseline scripts/apexlint_baseline.json \
         --fail-on error --jsonl "$tmp/lint_mesh.jsonl"

@@ -40,3 +40,16 @@ def mesh4x2(devices):
     import numpy as np
     from jax.sharding import Mesh
     return Mesh(np.array(devices).reshape(4, 2), ("data", "model"))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(devices):
+    """The factored data mesh of the hierarchical gradient sync:
+    2 slices over (modeled) DCN x 4 chips over ICI — the dp2x4 mesh
+    model's axis names, so plans/models/meshes line up."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from apex_tpu.parallel import DATA_INTER_AXIS, DATA_INTRA_AXIS
+    return Mesh(np.array(devices).reshape(2, 4),
+                (DATA_INTER_AXIS, DATA_INTRA_AXIS))

@@ -281,6 +281,260 @@ class TestInt8Compress:
                                      ("a", "b"), compress="int8")
 
 
+class TestQuantizerBlocks:
+    def test_pad_and_mask_non_divisible_length(self):
+        """Lengths not divisible by compress_block: the quantizer pads
+        with zeros to the next block boundary and the pad is EXACTLY
+        invisible — same payload as quantizing the manually padded
+        buffer, zero error on the pad, and dequantize(n=) masks it."""
+        rng = np.random.RandomState(11)
+        n, block = 1000, 256
+        x = jnp.asarray(rng.randn(n) * 3.0, jnp.float32)
+        q, s = comm._quantize_int8(x, block)
+        assert q.shape[0] == 1024 and s.shape[0] == 4
+        xp = jnp.pad(x, (0, 1024 - n))
+        q_ref, s_ref = comm._quantize_int8(xp, block)
+        np.testing.assert_array_equal(np.asarray(q), np.asarray(q_ref))
+        np.testing.assert_array_equal(np.asarray(s), np.asarray(s_ref))
+        back = comm._dequantize_int8(q, s, block, n=n)
+        assert back.shape[0] == n
+        # pad region dequantizes to exact zeros (never perturbs scales)
+        full = comm._dequantize_int8(q, s, block)
+        np.testing.assert_array_equal(np.asarray(full[n:]), 0.0)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        bound = np.repeat(np.asarray(s), block)[:n] / 2 + 1e-7
+        assert np.all(err <= bound)
+
+    def test_divisible_length_unchanged(self):
+        x = jnp.asarray(np.random.RandomState(3).randn(512), jnp.float32)
+        q, s = comm._quantize_int8(x, 256)
+        assert q.shape[0] == 512 and s.shape[0] == 2
+        assert comm._dequantize_int8(q, s, 256).shape[0] == 512
+
+
+def _dp2x4(calibration=None):
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    mm = parse_mesh_spec("dp2x4")
+    if calibration:
+        mm.calibration.update(calibration)
+    return mm
+
+
+class TestCommPlan:
+    def test_defaults_plan_is_int8_hierarchical(self):
+        from apex_tpu.parallel import hierarchy
+        plan = hierarchy.plan_comm(_dp2x4(), grad_bytes=100 * 2 ** 20)
+        assert plan.is_hierarchical and plan.source == "defaults"
+        ops = [(h.op, h.link, h.dtype) for h in plan.hops]
+        assert ops == [("reduce_scatter", "ici", "int8"),
+                       ("all_reduce", "dcn", "int8"),
+                       ("all_gather", "ici", "int8")]
+        assert plan.dtype_by_link() == {"ici": "int8", "dcn": "int8"}
+        assert plan.world == 8
+        assert plan.axis_names == ("data_intra", "data_inter")
+
+    def test_measured_model_changes_the_plan(self):
+        """The acceptance-criteria unit: a measured (calibrated) model
+        derives a DIFFERENT plan than the defaults. int8's two-phase
+        DCN decomposition pays 4 per-collective latencies vs bf16's
+        one, so a latency-dominated measured DCN link (large α) keeps
+        the DCN hop at bf16 — and the provenance is recorded."""
+        from apex_tpu.parallel import hierarchy
+        nbytes = 100 * 2 ** 20
+        cal = {"dcn": {"alpha_us": 2000.0, "bytes_per_s": 2.5e10,
+                       "residual": 0.0, "n_samples": 8,
+                       "axis": "data_inter"}}
+        measured = hierarchy.plan_comm(_dp2x4(cal), grad_bytes=nbytes)
+        default = hierarchy.plan_comm(_dp2x4(), grad_bytes=nbytes)
+        assert measured.source == "measured"
+        assert default.source == "defaults"
+        assert measured.inter.dtype == "bf16"
+        assert default.inter.dtype == "int8"
+        assert measured.inter.calibrated
+        assert not default.inter.calibrated
+        assert measured.inter.alpha_us == 2000.0
+
+    def test_plan_reproducible(self):
+        from apex_tpu.parallel import hierarchy
+        a = hierarchy.plan_comm(_dp2x4(), grad_bytes=1 << 20)
+        b = hierarchy.plan_comm(_dp2x4(), grad_bytes=1 << 20)
+        assert a == b
+
+    def test_flat_plan_for_single_slice_model(self):
+        from apex_tpu.lint.mesh_model import parse_mesh_spec
+        from apex_tpu.parallel import hierarchy
+        plan = hierarchy.plan_comm(parse_mesh_spec("ici8"),
+                                   grad_bytes=1 << 20)
+        assert not plan.is_hierarchical
+        assert plan.hops[0].op == "all_reduce"
+        assert plan.world == 8
+
+    def test_wire_bytes_mixed_hops(self):
+        """comm.wire_bytes with a CommPlan accounts the per-hop dtype
+        mix — the all-reduce-equivalent ratio sits near the int8
+        hierarchical prediction, NOT the single-mode int8 figure."""
+        from apex_tpu.parallel import hierarchy
+        plan = hierarchy.plan_comm(_dp2x4(), grad_bytes=1 << 22)
+        leaves = [jax.ShapeDtypeStruct((1 << 20,), jnp.float32)]
+        bplan = comm.bucket_plan(leaves, None)
+        flat = comm.wire_bytes(bplan, None)
+        hier = comm.wire_bytes(bplan, plan)
+        assert 0.15 < hier / flat < 0.35, hier / flat
+        # bucket_table grows the wire column without error
+        table = comm.bucket_table(bplan, plan)
+        assert "wire MiB" in table
+
+    def test_predicted_seconds_cover_both_links(self):
+        from apex_tpu.parallel import hierarchy
+        plan = hierarchy.plan_comm(_dp2x4(), grad_bytes=100 * 2 ** 20)
+        pred = plan.predicted_seconds()
+        assert set(pred) == {"ici", "dcn"} and all(
+            v > 0 for v in pred.values())
+        js = plan.to_json()
+        assert js["source"] == "defaults" and len(js["hops"]) == 3
+
+
+AX2 = ("data_inter", "data_intra")
+
+
+class TestHierarchicalSync:
+    def _plan(self, **kw):
+        from apex_tpu.parallel import hierarchy
+        return hierarchy.plan_comm(_dp2x4(), grad_bytes=1 << 20, **kw)
+
+    def test_close_to_exact_mean(self, mesh2x4):
+        from apex_tpu.parallel import hierarchy
+        tree = _grad_tree()
+        plan = self._plan()
+
+        def step(x):
+            s = (jax.lax.axis_index("data_inter") * 4
+                 + jax.lax.axis_index("data_intra")).astype(jnp.float32)
+            g = {"a": tree["a"] * (s + 1), "b": tree["b"],
+                 "n": tree["n"]}
+            return hierarchy.hierarchical_sync(g, plan,
+                                               message_size=600)
+
+        out = jax.shard_map(step, mesh=mesh2x4, in_specs=(P(AX2),),
+                            out_specs=P(), check_vma=False)(
+            jnp.zeros(8))
+        ref = np.asarray(tree["a"]) * 4.5
+        np.testing.assert_allclose(np.asarray(out["a"]), ref,
+                                   rtol=5e-2, atol=5e-2)
+        np.testing.assert_array_equal(out["n"], tree["n"])
+
+    def test_ef_trajectory_converges_to_fp32(self, mesh2x4):
+        """The acceptance trajectory: data-parallel GD on a quadratic
+        over the 2-slice x 4-chip mesh, every gradient crossing both
+        hops as int8 with error feedback, lands within tolerance of
+        the fp32 (exact sync) optimum."""
+        from apex_tpu.parallel import hierarchy
+        dim, lr, steps = 512, 0.4, 30
+        rng = np.random.RandomState(7)
+        targets = jnp.asarray(rng.randn(8, dim) * 3.0, jnp.float32)
+        t_mean = np.mean(np.asarray(targets), axis=0)
+        plan = hierarchy.plan_comm(_dp2x4(), grad_bytes=dim * 4,
+                                   compress_block=64)
+
+        def mk(hier):
+            def step(w, r, t):
+                g = {"w": w - t[0]}
+                if hier:
+                    out, r2 = hierarchy.hierarchical_sync(
+                        g, plan, residual={"w": r[0]})
+                    return w - lr * out["w"], r2["w"][None]
+                out = parallel.sync_gradients(g, AX2)
+                return w - lr * out["w"], r
+            return jax.jit(jax.shard_map(
+                step, mesh=mesh2x4,
+                in_specs=(P(), P(AX2), P(AX2)),
+                out_specs=(P(), P(AX2)), check_vma=False))
+
+        def run(hier):
+            w = jnp.zeros((dim,), jnp.float32)
+            r = jnp.zeros((8, dim), jnp.float32)
+            f = mk(hier)
+            for _ in range(steps):
+                w, r = f(w, r, targets)
+            return np.asarray(w)
+
+        w_exact = run(False)
+        w_ef = run(True)
+        scale = float(np.linalg.norm(t_mean))
+        assert np.linalg.norm(w_exact - t_mean) < 1e-3 * scale
+        assert np.linalg.norm(w_ef - t_mean) < 0.02 * scale
+
+    def test_bf16_dcn_hop_variant(self, mesh2x4):
+        """The measured-model plan shape (int8 ICI / bf16 DCN) also
+        sums correctly."""
+        from apex_tpu.parallel import hierarchy
+        cal = {"dcn": {"alpha_us": 2000.0, "bytes_per_s": 2.5e10,
+                       "residual": 0.0, "n_samples": 8,
+                       "axis": "data_inter"}}
+        # 100 MiB payload: the wire term is big enough that bf16's
+        # halving beats α, but int8's 4-collective α cost is not
+        plan = hierarchy.plan_comm(_dp2x4(cal), grad_bytes=100 * 2 ** 20)
+        assert plan.inter.dtype == "bf16"
+        tree = {"a": _grad_tree()["a"]}
+
+        def step(x):
+            s = (jax.lax.axis_index("data_inter") * 4
+                 + jax.lax.axis_index("data_intra")).astype(jnp.float32)
+            g = {"a": tree["a"] * (s + 1)}
+            return hierarchy.hierarchical_sync(g, plan)
+
+        out = jax.shard_map(step, mesh=mesh2x4, in_specs=(P(AX2),),
+                            out_specs=P(), check_vma=False)(
+            jnp.zeros(8))
+        ref = np.asarray(tree["a"]) * 4.5
+        np.testing.assert_allclose(np.asarray(out["a"]), ref,
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_ddp_comm_plan_wiring(self, mesh2x4):
+        ddp = parallel.DistributedDataParallel(
+            mesh2x4, comm_plan=self._plan())
+        assert ddp.world_size == 8
+        assert set(ddp.axis_name) == {"data_inter", "data_intra"}
+        vals = jnp.linspace(0.1, 1.7, 640, dtype=jnp.float32)
+
+        def step(x):
+            g = {"w": vals}              # identical on every device
+            r = ddp.init_residual(g)
+            out, r2 = ddp.sync(g, residual=r)
+            return out["w"], r2["w"]
+
+        out, r2 = jax.shard_map(
+            step, mesh=mesh2x4, in_specs=(P(AX2),),
+            out_specs=(P(), P()), check_vma=False)(jnp.zeros(8))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(vals),
+                                   rtol=2e-2, atol=2e-2)
+        assert r2.shape == (640,)
+
+    def test_ddp_comm_plan_validation(self, mesh8, mesh2x4):
+        plan = self._plan()
+        with pytest.raises(ValueError):       # axes not in the mesh
+            parallel.DistributedDataParallel(mesh8, comm_plan=plan)
+        with pytest.raises(ValueError):       # does not compose
+            parallel.DistributedDataParallel(
+                mesh2x4, comm_plan=plan, compress="bf16")
+        with pytest.raises(ValueError):
+            parallel.DistributedDataParallel(
+                mesh2x4, comm_plan=plan, delay_allreduce=True)
+
+    def test_hierarchical_pmean_matches_flat(self, mesh2x4):
+        from apex_tpu.parallel import hierarchy
+        plan = self._plan()
+
+        def step(x):
+            return (hierarchy.hierarchical_pmean(x[0], plan),
+                    jax.lax.pmean(x[0], AX2))
+
+        h, f = jax.shard_map(step, mesh=mesh2x4, in_specs=(P(AX2),),
+                             out_specs=(P(), P()), check_vma=False)(
+            jnp.arange(1.0, 9.0))
+        np.testing.assert_allclose(float(h), float(f), rtol=1e-6)
+
+
 class TestDDPWiring:
     def test_sync_bucketed_matches_default(self, mesh8):
         ddp_b = parallel.DistributedDataParallel(

@@ -264,6 +264,52 @@ def test_save_and_load_baseline_roundtrip(tmp_path):
     assert rep2.ok
 
 
+def test_baseline_declared_metric_judged_and_preserved(tmp_path):
+    """The committed perf-baseline's "metrics" section declares extra
+    judged columns (the ddp_wire_bytes gate): parsed into MetricSpecs,
+    extracted from rows, direction-aware flagged on regression, quiet
+    on no-change — and --write-baseline refreshes must not drop the
+    section."""
+    path = str(tmp_path / "perf_baseline.json")
+    with open(path, "w") as f:
+        json.dump({"version": 1, "waivers": {}, "metrics": [
+            {"name": "ddp_wire_bytes",
+             "path": ["extra", "ddp_comm_modes", "modes", "hier_int8",
+                      "wire_bytes"],
+             "direction": "lower", "rel_floor": 0.02}]}, f)
+    extra = sentinel.metric_specs_from_baseline(path)
+    assert [s.name for s in extra] == ["ddp_wire_bytes"]
+    specs = tuple(sentinel.METRICS) + tuple(extra)
+
+    def row(w):
+        return {"metrics": sentinel.extract_metrics(
+            {"value": 100.0, "extra": {"batch": 8, "ddp_comm_modes": {
+                "modes": {"hier_int8": {"wire_bytes": w}}}}}, specs)}
+
+    base = [row(25_000_000), row(25_100_000), row(24_900_000)]
+    rep = sentinel.check_trajectory(base + [row(99_000_000)],
+                                    specs=specs)
+    bad = [v for v in rep.verdicts if v.metric == "ddp_wire_bytes"]
+    assert bad and bad[0].regressed
+    rep_ok = sentinel.check_trajectory(base + [row(25_000_000)],
+                                       specs=specs)
+    ok = [v for v in rep_ok.verdicts if v.metric == "ddp_wire_bytes"]
+    assert ok and not ok[0].regressed
+    # write-baseline keeps the metrics section alongside new waivers
+    sentinel.save_baseline(path, rep, reason="accepted")
+    assert sentinel.metric_specs_from_baseline(path) == extra
+    assert "regress|ddp_wire_bytes" in sentinel.load_baseline(path)
+    # malformed entries are loud, not silently dropped
+    with open(path, "w") as f:
+        json.dump({"metrics": [{"name": "x", "direction": "lower"}]}, f)
+    with pytest.raises(ValueError):
+        sentinel.metric_specs_from_baseline(path)
+    with pytest.raises(ValueError):
+        sentinel.metric_specs_from_baseline(
+            {"metrics": [{"name": "x", "path": ["v"],
+                          "direction": "sideways"}]})
+
+
 # --- schema negative twins ---------------------------------------------------
 
 def test_roofline_schema_rejects_bad_streams():
