@@ -287,7 +287,14 @@ class Amp:
         the committed params between backward and apply, and the commit
         predicate becomes ``finite AND no skip-class anomaly`` — the
         loss scaler's overflow skip generalized to loss spikes, grad
-        explosions and nonfinite state (docs/resilience.md). The
+        explosions and nonfinite state (docs/resilience.md). An
+        optional third element ``guard=(gs, gcfg, replica_ok)`` feeds
+        the cross-replica integrity verdict
+        (:func:`apex_tpu.guard.integrity_ok` of this step's fingerprint
+        check, docs/resilience.md#integrity) into the same observe +
+        commit path, so a silently diverged replica's polluted update
+        is vetoed by the unified select and counted in the
+        ``replica_divergence`` class. The
         guard's LR-backoff rung applies as **gradient scaling**: grads
         are multiplied by ``gs.lr_scale`` before the optimizer (exact
         LR-equivalence for the SGD family; adaptive optimizers like
@@ -303,12 +310,17 @@ class Amp:
             state = self.apply_gradients(state, grads, finite)
             return state, out, finite
         from apex_tpu.guard import guard_observe, guard_ok
-        gs, gcfg = guard
+        if len(guard) == 3:
+            gs, gcfg, replica_ok = guard
+        else:
+            gs, gcfg = guard
+            replica_ok = None
         loss_val = out[0] if has_aux else out
         true_norm = global_norm(grads)
         gs = guard_observe(gs, gcfg, loss=loss_val,
                            grad_norm=true_norm,
-                           params=state.params, grads_finite=finite)
+                           params=state.params, grads_finite=finite,
+                           replica_ok=replica_ok)
         grads = jax.tree_util.tree_map(
             lambda g: g * gs.lr_scale.astype(g.dtype)
             if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,

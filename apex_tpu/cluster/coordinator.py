@@ -116,14 +116,19 @@ class RecoveryCoordinator:
     # -- intents ---------------------------------------------------------------
 
     def propose(self, *, action: str, step: int,
-                good_step: Optional[int]) -> str:
+                good_step: Optional[int],
+                what: str = "guard") -> str:
         """Post this rank's signed intent for the current generation.
 
         ``good_step`` is the newest checkpoint step this rank verified
         restorable (:meth:`apex_tpu.guard.GuardPolicy.probe_good_step`)
         — None when it has none, which forces the decision to
-        escalate. Re-posting (a retried round) atomically replaces the
-        previous intent."""
+        escalate. ``what`` names the subsystem whose verdict triggered
+        the round (``"guard"`` for the anomaly ladder,
+        ``"integrity"`` for a silent-divergence fall-through with no
+        repairable majority) — forensic attribution in the event
+        stream, not part of the decision. Re-posting (a retried round)
+        atomically replaces the previous intent."""
         if action not in ACTIONS:
             raise ValueError(f"action must be one of {ACTIONS}, "
                              f"got {action!r}")
@@ -132,6 +137,7 @@ class RecoveryCoordinator:
                    "action": action, "step": int(step),
                    "good_step": (None if good_step is None
                                  else int(good_step)),
+                   "what": str(what),
                    "wall_time": time.time()}
         payload["mac"] = sign_payload(self._token, payload)
         path = intent_path(self.directory, gen, self.rank)
@@ -143,7 +149,7 @@ class RecoveryCoordinator:
         os.replace(tmp, path)
         self._emit({"kind": "cluster_coord", "action": "propose",
                     "generation": gen, "proposed": action,
-                    "step": int(step),
+                    "step": int(step), "what": str(what),
                     "good_step": payload["good_step"]})
         return path
 
@@ -312,7 +318,7 @@ class RecoveryCoordinator:
     def run_round(self, policy, step: int, like, source, *,
                   action: str = "rewind",
                   expect_ranks: Optional[List[int]] = None,
-                  reason: str = ""):
+                  reason: str = "", what: str = "guard"):
         """One full recovery round driven through a
         :class:`~apex_tpu.guard.GuardPolicy`: vote (this rank's newest
         restorable step), resolve, and apply the cluster decision —
@@ -324,10 +330,17 @@ class RecoveryCoordinator:
         healthy rank that noticed :meth:`peer_requested` calls it with
         the default ``action="rewind"`` — its healthy vote still
         matters, because its good step bounds the target from above.
+        The integrity rung falls through here too
+        (``what="integrity"``): a divergence with no repairable
+        majority means no single replica can be trusted as a broadcast
+        source, and the only consistent state every rank can reach is
+        a committed checkpoint — the same oldest-good-step-wins
+        resolution, now repairing a *silent* fault.
         """
         good = policy.probe_good_step(like)
         try:
-            self.propose(action=action, step=int(step), good_step=good)
+            self.propose(action=action, step=int(step), good_step=good,
+                         what=what)
             dec = self.resolve(expect_ranks=expect_ranks)
         except BaseException:
             # no rewind will consume the probe's cached restored tree

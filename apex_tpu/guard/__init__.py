@@ -9,9 +9,19 @@ Three layers close the detect→recover→prove loop (docs/resilience.md):
   pure ``jnp`` with zero extra dispatches (the
   ``guard/no-extra-dispatch`` compile-check case); skip-class anomalies
   never commit (:func:`guard_commit`, amp's overflow skip generalized).
+- **silent-divergence defense** (:mod:`~apex_tpu.guard.integrity`):
+  cross-replica integrity fingerprints — each replica folds its
+  committed params into one order-independent uint32 scalar, compared
+  across the dp axis with pmin/pmax inside the jitted step — catch the
+  fault class every loud probe misses (a finite flipped bit, a buggy
+  compressed collective); a quorum vote names the minority and the
+  policy repairs it IN PLACE with a bit-exact broadcast from the
+  majority (``scripts/integrity_audit.py --cpu8`` is the asserted
+  proof; docs/resilience.md#integrity).
 - **the policy ladder** (:mod:`~apex_tpu.guard.policy`):
   :class:`GuardPolicy` escalates per anomaly class with hysteresis and
-  budgets — in-graph skip/backoff → **rewind** to the last good
+  budgets — in-graph skip/backoff → in-place **repair** of a
+  quorum-named diverged replica → **rewind** to the last good
   :mod:`apex_tpu.ckpt` snapshot with the :mod:`apex_tpu.data` cursor
   fast-forwarded past the offending window (bitwise-equal to a run that
   never saw those batches) → hand-off to
@@ -24,16 +34,26 @@ Three layers close the detect→recover→prove loop (docs/resilience.md):
   ``scripts/chaos_audit.py --cpu8`` soak.
 """
 
-from apex_tpu.guard import chaos
+from apex_tpu.guard import chaos, integrity
 from apex_tpu.guard.chaos import (ChaosHarness, Fault, FaultPlan,
                                   inject_activation, inject_grads)
 from apex_tpu.guard.detect import (A_GRAD_EXPLOSION, A_LOSS_SPIKE,
                                    A_NONFINITE_GRAD, A_NONFINITE_LOSS,
-                                   A_NONFINITE_PARAM, ANOMALY_CLASSES,
+                                   A_NONFINITE_PARAM,
+                                   A_REPLICA_DIVERGENCE,
+                                   ANOMALY_CLASSES,
                                    LR_BACKOFF_MASK, REWIND_MASK,
                                    SKIP_MASK, GuardConfig, GuardState,
                                    anomaly_classes, guard_commit,
                                    guard_init, guard_observe, guard_ok)
+from apex_tpu.guard.integrity import (IntegrityConfig, IntegrityState,
+                                      IntegrityVote, absorb_verify,
+                                      fingerprint_tree,
+                                      integrity_check, integrity_commit,
+                                      integrity_init, integrity_ok,
+                                      integrity_resize,
+                                      make_repair_fn, make_verify_fn,
+                                      vote)
 from apex_tpu.guard.policy import (GuardAction, GuardEscalation,
                                    GuardPolicy)
 
@@ -41,9 +61,14 @@ __all__ = [
     "GuardConfig", "GuardState", "guard_init", "guard_observe",
     "guard_ok", "guard_commit", "anomaly_classes", "ANOMALY_CLASSES",
     "A_LOSS_SPIKE", "A_GRAD_EXPLOSION", "A_NONFINITE_GRAD",
-    "A_NONFINITE_LOSS", "A_NONFINITE_PARAM",
+    "A_NONFINITE_LOSS", "A_NONFINITE_PARAM", "A_REPLICA_DIVERGENCE",
     "SKIP_MASK", "REWIND_MASK", "LR_BACKOFF_MASK",
     "GuardPolicy", "GuardAction", "GuardEscalation",
+    "IntegrityConfig", "IntegrityState", "IntegrityVote",
+    "integrity_init", "integrity_check", "integrity_ok",
+    "integrity_commit", "integrity_resize", "fingerprint_tree",
+    "vote", "absorb_verify",
+    "make_repair_fn", "make_verify_fn", "integrity",
     "FaultPlan", "Fault", "ChaosHarness", "chaos",
     "inject_grads", "inject_activation",
 ]

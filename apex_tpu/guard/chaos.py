@@ -18,9 +18,18 @@ batch      nan, inf, corrupt, overflow  host: poison the input batch
 grads      nan, inf                     in-graph (`inject_grads` + the
                                         per-step ``fault_code`` input)
 activations nan                         in-graph (`inject_activation`)
-params     nan, bitflip                 host: corrupt committed state
-                                        AFTER the step (silent-DMA /
-                                        bit-flip model)
+params     nan, bitflip,                host: corrupt committed state
+           bitflip_mantissa             AFTER the step (silent-DMA /
+                                        bit-flip model);
+                                        ``bitflip_mantissa`` flips a
+                                        mantissa bit only (``arg``
+                                        selects which, mod the dtype's
+                                        mantissa width) so the
+                                        corrupted value is guaranteed
+                                        FINITE — silent to the
+                                        nonfinite-param probe, the
+                                        exact class the integrity
+                                        fingerprints exist for
 collective stall                        host: sleep — a peer wedged in a
                                         collective (watchdog territory)
 proc       sigkill                      host: SIGKILL this process
@@ -74,7 +83,7 @@ SITES: Dict[str, Tuple[str, ...]] = {
     "batch": ("nan", "inf", "corrupt", "overflow"),
     "grads": ("nan", "inf"),
     "activations": ("nan",),
-    "params": ("nan", "bitflip"),
+    "params": ("nan", "bitflip", "bitflip_mantissa"),
     "collective": ("stall",),
     "proc": ("sigkill",),
     "ckpt": ("truncate",),
@@ -254,9 +263,19 @@ class ChaosHarness:
     ``plan.seed ^ step``, never from consumed global RNG.
     """
 
-    def __init__(self, plan: FaultPlan, *, rank: int = 0):
+    def __init__(self, plan: FaultPlan, *, rank: int = 0,
+                 replica: Optional[int] = None):
         self.plan = plan
         self.rank = int(rank)
+        #: dp-axis replica index whose device buffers a ``params``
+        #: fault corrupts. ``None`` (legacy) corrupts the LOGICAL value
+        #: — the device_put round-trip re-replicates the corruption to
+        #: every replica identically, which can never diverge the dp
+        #: axis. Set a replica index to model the real silent-SDC
+        #: fault: one replica's buffer flips while the sharding still
+        #: claims replication (the class
+        #: :mod:`apex_tpu.guard.integrity` defends).
+        self.replica = replica
         #: host log of injections performed: (step, site, kind)
         self.injected: list = []
 
@@ -319,7 +338,7 @@ class ChaosHarness:
                 os.kill(os.getpid(), signal.SIGSTOP)
         f = self.plan.at(step, self.rank, "params")
         if f is not None:
-            state = self._corrupt_params(state, f)
+            state = self._corrupt_params(state, f, replica=self.replica)
             self._note(step, f)
         f = self.plan.at(step, self.rank, "collective")
         if f is not None:
@@ -341,11 +360,32 @@ class ChaosHarness:
     # -- host corruption mechanics --------------------------------------------
 
     @staticmethod
-    def _corrupt_params(state, f: Fault):
+    def _mantissa_bits(dtype) -> Optional[int]:
+        """The dtype's mantissa width (f32: 23, f16: 10, bf16: 7 —
+        asked of np.finfo so bf16's narrow mantissa is never confused
+        with f16's by item size). Bits 0..m-1 never touch the
+        exponent, so a finite value STAYS finite."""
+        try:
+            return int(np.finfo(dtype).nmant)
+        except Exception:
+            return None
+
+    @classmethod
+    def _corrupt_params(cls, state, f: Fault, replica=None):
         """Poison element 0 of the FIRST float leaf (deterministic under
-        a fixed tree structure): NaN, or a real bit flip of the float32
+        a fixed tree structure): NaN, a real bit flip of the float32
         representation (``arg`` = bit index, default 30 — the top
-        exponent bit, turning a weight into ~1e38)."""
+        exponent bit, turning a weight into ~1e38), or a MANTISSA-only
+        flip (``bitflip_mantissa``: ``arg`` selects the bit, taken mod
+        the dtype's mantissa width, so the corrupted value is
+        guaranteed finite — a high-bit flip can yield NaN/Inf and get
+        caught by the loud nonfinite-param probe, which never
+        exercises the silent path the integrity fingerprints defend).
+
+        ``replica`` targets ONE dp replica's device buffers (the
+        sharding still claims replication — the silent-divergence
+        model); ``None`` corrupts the logical value on every replica
+        identically."""
         import jax
         leaves, treedef = jax.tree_util.tree_flatten(state)
         for i, leaf in enumerate(leaves):
@@ -355,6 +395,19 @@ class ChaosHarness:
             flat = arr.reshape(-1)
             if f.kind == "nan":
                 flat[0] = np.nan
+            elif f.kind == "bitflip_mantissa":
+                m = cls._mantissa_bits(arr.dtype)
+                uint = {4: np.uint32, 2: np.uint16,
+                        1: np.uint8}.get(arr.dtype.itemsize)
+                if m is None or uint is None:   # f64 etc: scale the
+                    flat[0] = flat[0] * (1.0 + 2.0 ** -12) \
+                        if flat[0] != 0 else 2.0 ** -24  # mantissa
+                else:
+                    bit = int(f.arg) % m
+                    iv = flat[:1].view(uint)
+                    iv[0] ^= uint(1 << bit)
+                assert np.isfinite(flat[0]), \
+                    "mantissa flip produced a non-finite value"
             else:
                 bit = int(f.arg) or 30
                 if arr.dtype == np.float32:
@@ -363,12 +416,46 @@ class ChaosHarness:
                 else:
                     flat[0] = -flat[0] * 3.4e38
             new = arr.reshape(np.shape(leaf))
-            if hasattr(leaf, "sharding"):
-                new = jax.device_put(new, leaf.sharding)
             leaves = list(leaves)
-            leaves[i] = new
+            if replica is not None and hasattr(leaf, "sharding"):
+                leaves[i] = cls._poison_replica(leaf, new, replica)
+            else:
+                if hasattr(leaf, "sharding"):
+                    new = jax.device_put(new, leaf.sharding)
+                leaves[i] = new
             return jax.tree_util.tree_unflatten(treedef, leaves)
         return state
+
+    @staticmethod
+    def _poison_replica(leaf, corrupted, replica: int):
+        """Rebuild a replicated array with ONE replica's buffer holding
+        ``corrupted`` bits and every other replica keeping the original
+        — the sharding is unchanged, so downstream code still believes
+        the array is replicated (``np.asarray`` keeps reading replica
+        0). The exact lie a silent DMA/bit-flip fault tells."""
+        import jax
+        if not leaf.sharding.is_fully_replicated:
+            # on a multi-axis (dp x mp) mesh a flat device index is
+            # NOT a dp replica id, and a sharded leaf's per-device
+            # buffers are not full copies — refuse loudly rather than
+            # corrupt the wrong shard with the wrong shape
+            raise ValueError(
+                "ChaosHarness(replica=...) corrupts one replica of a "
+                "FULLY-REPLICATED leaf (replica = flat device index "
+                "of an all-data-parallel mesh); this leaf's sharding "
+                f"is {leaf.sharding} — target it via an explicit "
+                "per-shard fault instead")
+        mesh = leaf.sharding.mesh
+        devices = list(mesh.devices.flat)
+        if not 0 <= int(replica) < len(devices):
+            raise ValueError(f"replica {replica} out of range for a "
+                             f"{len(devices)}-device mesh")
+        orig = np.array(np.asarray(leaf), copy=True)
+        bufs = [jax.device_put(corrupted if i == int(replica) else orig,
+                               d)
+                for i, d in enumerate(devices)]
+        return jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, bufs)
 
     @staticmethod
     def truncate_latest_checkpoint(root: str) -> Optional[str]:

@@ -53,7 +53,7 @@ __all__ = [
     "GuardConfig", "GuardState", "guard_init", "guard_observe",
     "guard_ok", "guard_commit", "anomaly_classes",
     "A_LOSS_SPIKE", "A_GRAD_EXPLOSION", "A_NONFINITE_GRAD",
-    "A_NONFINITE_LOSS", "A_NONFINITE_PARAM",
+    "A_NONFINITE_LOSS", "A_NONFINITE_PARAM", "A_REPLICA_DIVERGENCE",
     "SKIP_MASK", "REWIND_MASK", "LR_BACKOFF_MASK", "ANOMALY_CLASSES",
 ]
 
@@ -64,6 +64,10 @@ A_GRAD_EXPLOSION = 2    #: grad norm >> rolling median grad norm
 A_NONFINITE_GRAD = 4    #: NaN/Inf gradients (amp overflow generalized)
 A_NONFINITE_LOSS = 8    #: NaN/Inf loss value
 A_NONFINITE_PARAM = 16  #: NaN/Inf *committed parameters* — state corruption
+A_REPLICA_DIVERGENCE = 32  #: cross-replica integrity fingerprints
+                           #: disagree — "replicated" state silently
+                           #: diverged (guard.integrity's verdict,
+                           #: fed in via ``replica_ok``)
 
 ANOMALY_CLASSES = {
     A_LOSS_SPIKE: "loss_spike",
@@ -71,14 +75,19 @@ ANOMALY_CLASSES = {
     A_NONFINITE_GRAD: "nonfinite_grad",
     A_NONFINITE_LOSS: "nonfinite_loss",
     A_NONFINITE_PARAM: "nonfinite_param",
+    A_REPLICA_DIVERGENCE: "replica_divergence",
 }
 
 #: classes whose step is vetoed in-graph (commit-or-keep select). Note
 #: nonfinite params are NOT here: the corruption already lives in the
 #: committed state, so refusing this step's update cannot help — that
-#: class is the host policy's rewind trigger instead.
+#: class is the host policy's rewind trigger instead. Replica
+#: divergence IS here: the diverged state predates this step too, but
+#: its gradients entered the psum — the update is polluted on EVERY
+#: replica and must not commit while the host decides repair vs rewind
+#: (``GuardPolicy.update_integrity``).
 SKIP_MASK = (A_LOSS_SPIKE | A_GRAD_EXPLOSION | A_NONFINITE_GRAD
-             | A_NONFINITE_LOSS)
+             | A_NONFINITE_LOSS | A_REPLICA_DIVERGENCE)
 
 #: classes that mean the committed state itself is bad — skip/backoff
 #: cannot recover; the host policy rewinds to the last good snapshot.
@@ -151,6 +160,7 @@ class GuardState(NamedTuple):
     nonfinite_grad_count: jax.Array
     nonfinite_loss_count: jax.Array
     nonfinite_param_count: jax.Array
+    replica_divergence_count: jax.Array
     skip_count: jax.Array         # i32 cumulative in-graph vetoed steps
 
 
@@ -169,7 +179,8 @@ def guard_init(cfg: GuardConfig = GuardConfig()) -> GuardState:
         lr_scale=jnp.float32(1.0), lr_tracker=z0, consecutive=z0,
         spike_count=z0, grad_explosion_count=z0,
         nonfinite_grad_count=z0, nonfinite_loss_count=z0,
-        nonfinite_param_count=z0, skip_count=z0,
+        nonfinite_param_count=z0, replica_divergence_count=z0,
+        skip_count=z0,
     )
 
 
@@ -187,7 +198,7 @@ def _robust_z(loss, window, cfg: GuardConfig):
 
 def guard_observe(gs: GuardState, cfg: GuardConfig, *, loss,
                   grads=None, grad_norm=None, params=None,
-                  grads_finite=None) -> GuardState:
+                  grads_finite=None, replica_ok=None) -> GuardState:
     """Observe one step: compute this step's anomaly bitmask against the
     PRE-update windows, advance windows/counters/LR schedule. Pure
     ``jnp``; rides the existing step dispatch.
@@ -197,7 +208,12 @@ def guard_observe(gs: GuardState, cfg: GuardConfig, *, loss,
     ``grads_finite`` (a precomputed flag — e.g. amp's) substitutes for
     the finiteness traversal. ``params`` enables the nonfinite-param
     probe (pass the *committed* params the step started from — the probe
-    exists to catch corruption that is already state).
+    exists to catch corruption that is already state). ``replica_ok``
+    (the :func:`apex_tpu.guard.integrity_ok` verdict of this step's
+    cross-replica fingerprint check) raises the skip-class
+    ``A_REPLICA_DIVERGENCE`` anomaly when False — the polluted update
+    then never commits through :func:`guard_commit`, and the anomalous
+    (pmean-polluted) loss never enters the rolling window.
     """
     loss = jnp.asarray(loss, jnp.float32)
     armed = gs.count >= cfg.min_history
@@ -236,6 +252,11 @@ def guard_observe(gs: GuardState, cfg: GuardConfig, *, loss,
     else:
         p_fin = jnp.bool_(True)
 
+    if replica_ok is not None:
+        r_ok = jnp.asarray(replica_ok, jnp.bool_)
+    else:
+        r_ok = jnp.bool_(True)
+
     def _bit(cond, bit):
         return jnp.where(cond, jnp.int32(bit), jnp.int32(0))
 
@@ -243,7 +264,8 @@ def guard_observe(gs: GuardState, cfg: GuardConfig, *, loss,
                + _bit(explosion, A_GRAD_EXPLOSION)
                + _bit(jnp.logical_not(g_fin), A_NONFINITE_GRAD)
                + _bit(jnp.logical_not(loss_finite), A_NONFINITE_LOSS)
-               + _bit(jnp.logical_not(p_fin), A_NONFINITE_PARAM))
+               + _bit(jnp.logical_not(p_fin), A_NONFINITE_PARAM)
+               + _bit(jnp.logical_not(r_ok), A_REPLICA_DIVERGENCE))
     if not cfg.skip_on_spike:
         skip_mask = SKIP_MASK & ~A_LOSS_SPIKE
     else:
@@ -309,6 +331,8 @@ def guard_observe(gs: GuardState, cfg: GuardConfig, *, loss,
                               + _cnt(jnp.logical_not(loss_finite))),
         nonfinite_param_count=(gs.nonfinite_param_count
                                + _cnt(jnp.logical_not(p_fin))),
+        replica_divergence_count=(gs.replica_divergence_count
+                                  + _cnt(jnp.logical_not(r_ok))),
         skip_count=gs.skip_count + _cnt(skipped),
     )
 

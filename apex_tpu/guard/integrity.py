@@ -1,0 +1,431 @@
+"""Cross-replica integrity fingerprints: prove the replication invariant.
+
+Data parallelism's core invariant — post-sync gradients and committed
+parameters are **bitwise identical** on every replica of the dp axis —
+is assumed everywhere (the checkpoint layer saves one replica, apexlint
+reasons over one program, the compressed collectives requantize against
+it) and verified nowhere at runtime. The guard ladder only fires on
+*loud* faults: a flipped mantissa bit that still reads as a plausible
+finite number, or a subtle bug in a compressed multi-hop sync, silently
+diverges one replica's "replicated" state — and every later step,
+checkpoint and bench number is quietly wrong.
+
+This module is the runtime proof, built from three pieces:
+
+- **the fold** (:func:`fingerprint_tree`): each replica reduces its
+  committed params (and optionally its post-sync grads) to one uint32
+  scalar — every element's bit pattern is seeded with its (leaf,
+  position) identity, avalanched through a 32-bit mix, and the hashed
+  terms are summed mod 2³² (integer wraparound addition is exactly
+  associative and commutative, so the fold is *reduction-order*-
+  independent, while the avalanche makes every bit of every element
+  matter: single flips, swapped elements, and compensating
+  same-significance pairs — including opposite sign-bit flips, which
+  a linear weighted sum provably misses — all change it);
+- **the in-graph compare** (:func:`integrity_check`): every
+  ``check_every`` steps the fold runs inside the jitted step and the
+  scalar is compared across the dp axis with ``pmin``/``pmax`` (equal ⇔
+  all replicas agree), plus an ``all_gather`` of the per-replica
+  fingerprints so the host can *name* the diverged minority without
+  another dispatch. Off-steps take the empty ``lax.cond`` branch —
+  no fold, no collective, no host op (the
+  ``integrity/no-extra-dispatch`` compile-check case pins it). The
+  result is an :class:`IntegrityState` pytree carried next to
+  ``GuardState``: checkpointable, donate-able, scan-carryable.
+- **the repair** (:func:`make_repair_fn` over
+  :func:`apex_tpu.parallel.replica_broadcast`): the policy rung *below*
+  rewind — re-broadcast the majority's parameters to the diverged
+  minority over the existing DDP comm (a psum of the where-selected
+  **bit pattern**, integer-exact, so ``-0.0`` signs and NaN payloads
+  survive), re-verify the fingerprint, leave the data cursor untouched.
+  Falling through to a coordinated rewind only when no majority exists
+  (all replicas disagree — the collective itself is broken, not one
+  replica) or the repair re-fails.
+
+Detection feeds :func:`apex_tpu.guard.guard_observe` via ``replica_ok``:
+a failed check raises the ``A_REPLICA_DIVERGENCE`` anomaly class, which
+is skip-class — the step's update (polluted through the gradient psum by
+the diverged replica) never commits, on ANY replica, while the host
+decides. The decision itself lives in
+:meth:`apex_tpu.guard.GuardPolicy.update_integrity`.
+
+Cadence is the knob (docs/resilience.md#integrity): ``check_every=1``
+catches a divergence before any gradient sync can smear it across the
+healthy majority — repair is then **bitwise-exact** (the audit's oracle
+claim). A coarser cadence amortizes the scalar collectives but lets up
+to ``check_every - 1`` polluted updates commit first — repair still
+restores replica agreement, but the pollution stays in the trajectory;
+prefer rewind there if bitwise history matters.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = [
+    "IntegrityConfig", "IntegrityState", "IntegrityVote",
+    "integrity_init", "integrity_check", "integrity_ok",
+    "integrity_commit", "integrity_resize", "fingerprint_tree",
+    "vote", "absorb_verify", "make_repair_fn", "make_verify_fn",
+]
+
+#: golden-ratio odd constant for the per-leaf mixers (any odd multiplier
+#: is a bijection mod 2^32; distinct per-leaf odds keep two equal leaves
+#: at different positions from folding to the same contribution)
+_MIX = 0x9E3779B1
+
+
+class IntegrityConfig(NamedTuple):
+    """Static fingerprint configuration (hashable; safe to close over in
+    jit)."""
+
+    check_every: int = 1    #: fingerprint-compare cadence in steps.
+                            #: 1 = every step (repair stays bitwise-
+                            #: exact); N amortizes the scalar
+                            #: collectives at up to N-1 steps of
+                            #: detection latency
+
+
+class IntegrityState(NamedTuple):
+    """The in-graph integrity monitor: all device scalars plus one
+    ``uint32[world]`` vector of per-replica fingerprints — carried
+    through the jitted step next to ``GuardState`` (checkpointable,
+    donate-able, ``lax.scan``-carryable). ``divergent`` describes THIS
+    step only (False on off-steps); ``mismatch_count`` is cumulative
+    and never reset, so a host poll at any cadence recovers every
+    missed event by differencing.
+    """
+
+    step: jax.Array            # i32 observed (attempted) steps
+    check_count: jax.Array     # i32 cumulative checks executed
+    mismatch_count: jax.Array  # i32 cumulative checks that diverged
+    divergent: jax.Array       # bool: this step's check found mismatch
+    fingerprint: jax.Array     # u32 this replica's fp at the last check
+    fp_min: jax.Array          # u32 cross-replica min at the last check
+    fp_max: jax.Array          # u32 cross-replica max at the last check
+    rank_fps: jax.Array        # u32[world] per-replica fps, last check
+    last_check_step: jax.Array  # i32 step of the last executed check
+
+
+def integrity_init(cfg: IntegrityConfig = IntegrityConfig(), *,
+                   world: int) -> IntegrityState:
+    """Fresh integrity state for a dp axis of ``world`` replicas —
+    thread through the step like ``GuardState``."""
+    if int(cfg.check_every) < 1:
+        raise ValueError(f"IntegrityConfig.check_every must be >= 1, "
+                         f"got {cfg.check_every}")
+    if int(world) < 2:
+        raise ValueError(f"integrity fingerprints compare across a dp "
+                         f"axis — world must be >= 2, got {world}")
+    z = jnp.int32(0)
+    u = jnp.uint32(0)
+    return IntegrityState(
+        step=z, check_count=z, mismatch_count=z,
+        divergent=jnp.bool_(False),
+        fingerprint=u, fp_min=u, fp_max=u,
+        rank_fps=jnp.zeros((int(world),), jnp.uint32),
+        last_check_step=jnp.int32(-1),
+    )
+
+
+def _leaf_bits(x: jax.Array) -> jax.Array:
+    """A leaf's raw bit pattern as (flattened-compatible) uint — the
+    fold's unit. Floats AND 8-byte integers bitcast through the shared
+    :func:`apex_tpu.utils.uint_view_dtype` table (8-byte types land as
+    a trailing pair of uint32 lanes — both halves enter the sum, so
+    int64/f64 flips in the high bits are seen); narrower ints/bools
+    reinterpret into uint32 (injective for every ≤ 32-bit width)."""
+    from apex_tpu.utils import uint_view_dtype
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        bits = lax.bitcast_convert_type(x, uint_view_dtype(x.dtype))
+        return bits.astype(jnp.uint32)
+    if x.dtype == jnp.bool_:
+        return x.astype(jnp.uint32)
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        if jnp.dtype(x.dtype).itemsize > 4:
+            # astype would silently truncate bits 32-63 — the exact
+            # blindness a fingerprint must not have
+            return lax.bitcast_convert_type(x, jnp.uint32)
+        return x.astype(jnp.uint32)
+    # an uncovered dtype (complex, ...) silently excluded would be a
+    # hole in the very guarantee this module sells — refuse loudly
+    raise TypeError(
+        f"fingerprint_tree cannot fold dtype {x.dtype} bit-exactly — "
+        f"a leaf this fold skipped would be undetectable (and "
+        f"replica_broadcast unrepaired); exclude it from the "
+        f"fingerprinted subtree explicitly or extend _leaf_bits")
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """An avalanche finalizer (lowbias32 constants): every input bit
+    flips ~half the output bits. This is what makes the fold's SUM
+    safe: a weighted-linear fold has *structural* blind spots (a pair
+    of opposite sign-bit flips contributes ±2³¹·Δw, which is ≡ 0 mod
+    2³² for every even weight difference — exactly the multi-bit SDC
+    class the module defends); hashing each term first leaves only
+    generic ~2⁻³² collisions, no class of corruption that cancels by
+    construction."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x
+
+
+def fingerprint_tree(tree) -> jax.Array:
+    """Reduction-order-independent, position-sensitive uint32
+    fingerprint of a pytree's bit content.
+
+    Each element's raw bit pattern is XOR-seeded with its GLOBAL lane
+    position across the whole flattened tree (an injective identity —
+    a per-leaf (index, offset) pair would form overlapping arithmetic
+    progressions whose aliased seeds let an exact cross-leaf element
+    exchange cancel), avalanched through :func:`_mix32`, and the
+    hashed terms are summed with uint32 wraparound — addition mod 2³²
+    is exactly associative/commutative, so the *reduction order*
+    cannot change the result (the property that makes the scalar
+    comparable across replicas regardless of per-device scheduling),
+    while the per-term avalanche makes every bit of every element
+    matter: single flips, swapped elements (within or across leaves),
+    and compensating flips at any significance (including opposite
+    sign-bit pairs, which a linear weighted sum provably misses) all
+    change the fold. Identities stay injective up to 2³² lanes (≈4.3 B
+    fp32 params per fingerprinted tree — shard the fold before that);
+    what remains below the bound is the generic ~2⁻³² hash-collision
+    floor, with no corruption class that cancels by construction.
+    Pure local ``jnp`` — the cross-replica compare is
+    :func:`integrity_check`'s job."""
+    fp = jnp.uint32(0)
+    offset = 0            # global lane offset — static at trace time
+    for leaf in jax.tree_util.tree_leaves(tree):
+        bits = _leaf_bits(leaf)
+        if bits.size == 0:
+            continue
+        flat = jnp.reshape(bits, (-1,))
+        gpos = (jnp.arange(flat.size, dtype=jnp.uint32)
+                + jnp.uint32(offset & 0xFFFFFFFF))
+        # odd multiplier = bijection: distinct lanes, distinct seeds
+        fp = fp + jnp.sum(_mix32(flat ^ (gpos * jnp.uint32(_MIX))),
+                          dtype=jnp.uint32)
+        offset += int(flat.size)
+    return fp
+
+
+def integrity_check(ist: IntegrityState, cfg: IntegrityConfig, params,
+                    *, axis_name, grads=None) -> IntegrityState:
+    """Observe one step: fold + cross-replica compare every
+    ``cfg.check_every`` steps, advance counters. Call inside the
+    ``shard_map``-ped step, on the COMMITTED params the step started
+    from (divergence is a property of state, not of this update);
+    pass the post-sync ``grads`` too to additionally prove the
+    gradient collective (the compressed-sync runtime proof —
+    identical synced grads are part of the invariant).
+
+    Off-steps take the empty ``lax.cond`` branch: no fold, no
+    collective (``check_every=1`` skips the cond entirely). The
+    collectives run under the registered ``guard/integrity_check``
+    scope, so apexlint APX102/APX202 stay clean.
+    """
+    from apex_tpu.trace.spans import span as _span
+
+    world = ist.rank_fps.shape[0]
+    subject = params if grads is None else (params, grads)
+
+    def _do(s: IntegrityState) -> IntegrityState:
+        with _span("guard/integrity_check", kind="collective"):
+            fp = fingerprint_tree(subject)
+            mn = lax.pmin(fp, axis_name)
+            mx = lax.pmax(fp, axis_name)
+            fps = jnp.reshape(lax.all_gather(fp, axis_name), (world,))
+        div = mn != mx
+        return s._replace(
+            fingerprint=fp, fp_min=mn, fp_max=mx, rank_fps=fps,
+            divergent=div,
+            check_count=s.check_count + 1,
+            mismatch_count=(s.mismatch_count
+                            + jnp.where(div, 1, 0).astype(jnp.int32)),
+            last_check_step=s.step)
+
+    def _skip(s: IntegrityState) -> IntegrityState:
+        return s._replace(divergent=jnp.bool_(False))
+
+    if int(cfg.check_every) <= 1:
+        new = _do(ist)
+    else:
+        new = lax.cond((ist.step % cfg.check_every) == 0, _do, _skip,
+                       ist)
+    return new._replace(step=ist.step + 1)
+
+
+def integrity_ok(ist: IntegrityState) -> jax.Array:
+    """Commit predicate: True unless THIS step's check found a
+    divergence. Feed it to ``guard_observe(replica_ok=...)`` (the
+    ``A_REPLICA_DIVERGENCE`` skip class then vetoes the commit through
+    ``guard_commit``), or to :func:`integrity_commit` on guard-less
+    steps."""
+    return jnp.logical_not(ist.divergent)
+
+
+def integrity_commit(ist: IntegrityState, new_tree, old_tree):
+    """Commit ``new_tree`` unless this step's integrity check failed —
+    the polluted update (the diverged replica's gradients entered the
+    psum) must not commit anywhere while the host decides repair vs
+    rewind. Redundant when the step already routes the veto through
+    ``guard_observe(replica_ok=...)`` + ``guard_commit``."""
+    from apex_tpu.utils import tree_select
+    return tree_select(integrity_ok(ist), new_tree, old_tree)
+
+
+# -- the host half: quorum vote + repair programs ------------------------------
+
+class IntegrityVote(NamedTuple):
+    """The host-side quorum verdict over one check's gathered
+    per-replica fingerprints (replicated by construction — every host
+    computes the same vote from the same vector)."""
+
+    has_majority: bool            #: a strict majority (> world/2)
+                                  #: agrees on one fingerprint
+    source_rank: Optional[int]    #: lowest-numbered majority replica
+                                  #: (the repair broadcast source), or
+                                  #: None without a majority
+    minority: Tuple[int, ...]     #: replicas whose fingerprint differs
+                                  #: from the majority's (empty without
+                                  #: a majority — nobody can be named)
+    majority_fp: Optional[int]    #: the agreed fingerprint, or None
+    n_ranks: int                  #: electorate size (the dp world)
+
+
+def vote(rank_fps) -> IntegrityVote:
+    """Name the diverged minority from the gathered fingerprints.
+
+    A strict majority (> world/2 replicas sharing one fingerprint)
+    distinguishes "one bad replica" (repairable in place) from "the
+    collective itself is broken" (every replica disagrees, or a tie —
+    there is no trustworthy source to broadcast from, fall through to
+    the coordinated rewind). Ranks here are dp-axis replica indices
+    (``lax.axis_index`` order), not process ranks."""
+    import numpy as np
+    fps = [int(v) for v in np.asarray(rank_fps).reshape(-1)]
+    n = len(fps)
+    counts: dict = {}
+    for fp in fps:
+        counts[fp] = counts.get(fp, 0) + 1
+    best_fp, best_n = max(counts.items(), key=lambda kv: (kv[1], -kv[0]))
+    if best_n * 2 <= n:
+        return IntegrityVote(False, None, (), None, n)
+    minority = tuple(r for r, fp in enumerate(fps) if fp != best_fp)
+    source = min(r for r, fp in enumerate(fps) if fp == best_fp)
+    return IntegrityVote(True, source, minority, best_fp, n)
+
+
+def _axis_world(mesh, axis_name) -> int:
+    names = (axis_name,) if isinstance(axis_name, str) else \
+        tuple(axis_name)
+    world = 1
+    for a in names:
+        world *= mesh.shape[a]
+    return world
+
+
+def make_verify_fn(mesh, axis_name):
+    """A jitted ``tree -> (fp_min, fp_max, rank_fps)`` over ``mesh`` —
+    the host's standalone fingerprint compare (repair re-verification,
+    post-restore hygiene). Compiled on first use; a rare-path program,
+    never part of the step."""
+    world = _axis_world(mesh, axis_name)
+
+    def _verify(tree):
+        from apex_tpu.trace.spans import span as _span
+        with _span("guard/integrity_check", kind="collective"):
+            fp = fingerprint_tree(tree)
+            return (lax.pmin(fp, axis_name), lax.pmax(fp, axis_name),
+                    jnp.reshape(lax.all_gather(fp, axis_name), (world,)))
+
+    return jax.jit(jax.shard_map(
+        _verify, mesh=mesh, in_specs=(P(),),
+        out_specs=(P(), P(), P()), check_vma=False))
+
+
+def integrity_resize(ist: IntegrityState, *,
+                     world: int) -> IntegrityState:
+    """Re-shape a (restored) IntegrityState for a different dp world —
+    the elastic-resume hook. A checkpointed state bakes the old
+    electorate into ``rank_fps``; resumed onto a different mesh size
+    (the :mod:`apex_tpu.ckpt` elastic flow), the first
+    :func:`integrity_check` would gather a different-length vector and
+    fail at trace time — and a stale vector would vote with the wrong
+    electorate even if it didn't. Cumulative counters are *history*
+    and survive; the per-replica vector and the last-check transients
+    describe replicas that no longer exist and are re-initialized.
+    Same-world states pass through untouched, so the call is safe
+    unconditionally after every restore::
+
+        restored, mf = mgr.restore(like)
+        ist = guard.integrity_resize(restored["ist"],
+                                     world=mesh.shape["data"])
+    """
+    if int(world) < 2:
+        raise ValueError(f"integrity fingerprints compare across a dp "
+                         f"axis — world must be >= 2, got {world}")
+    if int(world) == int(ist.rank_fps.shape[0]):
+        return ist
+    u = jnp.uint32(0)
+    return ist._replace(
+        rank_fps=jnp.zeros((int(world),), jnp.uint32),
+        divergent=jnp.bool_(False),
+        fingerprint=u, fp_min=u, fp_max=u,
+        last_check_step=jnp.int32(-1))
+
+
+def absorb_verify(ist: IntegrityState, fp_min, fp_max,
+                  rank_fps) -> IntegrityState:
+    """Fold a host-side re-verification result (the
+    :func:`make_verify_fn` output a successful
+    :meth:`~apex_tpu.guard.GuardPolicy.repair` produced — kept on
+    ``policy.last_verify``) back into the carried state::
+
+        params, ok = policy.repair(step, params, repair_fn=rf,
+                                   verify_fn=vf)
+        ist = guard.absorb_verify(ist, *policy.last_verify)
+
+    Without this, a checkpoint taken on the repair step freezes the
+    DETECTION-time fields — disagreeing ``rank_fps`` next to a nonzero
+    cumulative ``mismatch_count`` — and a restart's fresh policy would
+    replay the stale vote and fire a spurious repair on
+    already-agreeing replicas. Counters are cumulative history and
+    stay untouched."""
+    fps = jnp.asarray(rank_fps, jnp.uint32)
+    return ist._replace(
+        divergent=jnp.bool_(False),
+        fingerprint=jnp.asarray(fp_min, jnp.uint32),
+        fp_min=jnp.asarray(fp_min, jnp.uint32),
+        fp_max=jnp.asarray(fp_max, jnp.uint32),
+        rank_fps=jnp.reshape(fps, ist.rank_fps.shape))
+
+
+def make_repair_fn(mesh, axis_name):
+    """A jitted ``(tree, source_rank) -> tree`` over ``mesh`` — the
+    in-place repair: every replica's buffers are overwritten with the
+    ``source_rank`` replica's exact bits via
+    :func:`apex_tpu.parallel.replica_broadcast` (a psum of the
+    where-selected bit pattern over the existing DDP comm, under the
+    registered ``guard/integrity_repair`` scope). The majority's
+    buffers are rewritten with their own bits — a no-op there, the fix
+    on the minority. The data cursor is untouched by construction:
+    repair is state surgery, not time travel."""
+    def _repair(tree, src):
+        from apex_tpu.parallel.distributed import replica_broadcast
+        from apex_tpu.trace.spans import span as _span
+        with _span("guard/integrity_repair", kind="collective"):
+            return replica_broadcast(tree, axis_name, source=src)
+
+    return jax.jit(jax.shard_map(
+        _repair, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))

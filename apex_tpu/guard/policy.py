@@ -1,4 +1,4 @@
-"""The guard policy ladder: skip → backoff → rewind → escalate.
+"""The guard policy ladder: skip → backoff → repair → rewind → escalate.
 
 The in-graph half (:mod:`apex_tpu.guard.detect`) already *acted* on the
 common case before the host ever sees it: skip-class anomalies never
@@ -9,6 +9,18 @@ manager and the data pipeline:
 1. **skip / backoff** (in-graph, observed here): each new anomaly is
    emitted as a ``guard_anomaly`` event; the in-graph veto is reported
    as a ``guard_action`` with ``action="skip"``.
+1b. **repair** (`update_integrity` / `repair`) — the rung *below*
+   rewind, for the silent-divergence class
+   (:mod:`apex_tpu.guard.integrity`): when the cross-replica
+   fingerprint check names a diverged minority and a strict majority
+   still agrees, the minority's parameters are re-broadcast in place
+   from the lowest-numbered majority replica (bit-exact, over the
+   registered DDP comm), the fingerprint re-verified, and training
+   continues — **no checkpoint restore, cursor untouched**. Only when
+   no majority exists (every replica disagrees — the collective
+   itself is suspect, not one replica) or the repair re-fails does
+   the incident fall through to rung 2, via the cluster's
+   :class:`~apex_tpu.cluster.RecoveryCoordinator` when one is wired.
 2. **rewind** — when the committed state itself is corrupt
    (nonfinite-param class) or skipping stopped converging (more than
    ``skip_budget`` skips inside a ``skip_window``-step window): restore
@@ -58,9 +70,10 @@ __all__ = ["GuardPolicy", "GuardAction", "GuardEscalation"]
 
 
 class GuardAction(NamedTuple):
-    """One `update` verdict. ``kind`` ∈ none | skip | rewind | escalate
-    (observe-only policies report what they *would* do in ``reason``
-    but always return kind="none")."""
+    """One `update` / `update_integrity` verdict. ``kind`` ∈ none |
+    skip | repair | rewind | escalate (observe-only policies report
+    what they *would* do in ``reason`` but always return
+    kind="none")."""
     kind: str
     step: int
     classes: Tuple[str, ...] = ()
@@ -97,13 +110,27 @@ class GuardPolicy:
 
     def __init__(self, *, manager=None, escalation=None,
                  event_sink: Optional[Callable[[Dict], None]] = None,
+                 integrity_sink: Optional[Callable[[Dict], None]] = None,
                  recorder=None, observe_only: bool = False,
                  rewind_budget: int = 2, skip_budget: int = 4,
                  skip_window: int = 32, cooldown_steps: int = 16,
-                 poll_every: int = 1):
+                 poll_every: int = 1,
+                 generation: Optional[Callable[[], int]] = None):
         self.manager = manager
         self.escalation = escalation
         self.event_sink = event_sink
+        #: the ``integrity`` event channel (kind="integrity_check"/
+        #: "integrity_vote"/"integrity_repair" — validate with
+        #: ``check_metrics_schema.py --kind integrity``); wire
+        #: ``MetricsLogger(integrity_sink=...).record_integrity``.
+        #: Separate from ``event_sink`` because the two channels carry
+        #: different schemas.
+        self.integrity_sink = integrity_sink
+        #: callable returning the cluster's committed generation
+        #: (``membership.refresh``) — integrity events are fenced with
+        #: it when wired (null otherwise), so a zombie's late repair
+        #: claim is attributable in the forensic stream
+        self.generation = generation
         self.recorder = recorder
         self.observe_only = bool(observe_only)
         self.rewind_budget = int(rewind_budget)
@@ -119,6 +146,22 @@ class GuardPolicy:
         self._skip_steps: list = []      # loop steps of recent skips
         self._prev: Optional[Dict[str, int]] = None
         self._last_poll = -1
+        #: in-place repairs performed (the integrity rung's odometer)
+        self.repairs_done = 0
+        #: the last mismatch's quorum verdict (integrity.IntegrityVote)
+        #: — kept for forensics; `repair` consumes the ARMED flag, so
+        #: a stale verdict from a previous incident can never drive a
+        #: broadcast
+        self.last_vote = None
+        self._vote_armed = False
+        #: (fp_min, fp_max, rank_fps) of the last repair's
+        #: re-verification — feed ``guard.absorb_verify(ist,
+        #: *policy.last_verify)`` so the carried IntegrityState (and
+        #: any checkpoint taken this step) reflects the POST-repair
+        #: agreement instead of the detection-time disagreement
+        self.last_verify = None
+        self._iprev: Optional[Dict[str, int]] = None
+        self._last_ipoll = -1
         #: (step, like, tree, manifest) of the last probe_good_step
         #: winner — rewind() reuses it when the agreed target IS this
         #: rank's own good step (the healthy-majority case), halving
@@ -127,11 +170,14 @@ class GuardPolicy:
 
     # -- events ----------------------------------------------------------------
 
-    def _emit(self, event: Dict) -> None:
+    def _emit_to(self, sink, event: Dict) -> None:
+        """The one event-hygiene pipeline both channels share: stamp
+        rank + wall time, null non-finite gauges (strict-JSON contract
+        — the crash-dump ring serializes these verbatim), note the
+        flight recorder, deliver to ``sink`` — and telemetry must
+        never break recovery, so every consumer failure is
+        swallowed."""
         ev = dict(event, rank=self.rank, wall_time=time.time())
-        # strict-JSON contract: non-finite gauges (a NaN-loss anomaly's
-        # z-score) become null before ANY consumer — the crash-dump ring
-        # serializes these verbatim
         for k, v in ev.items():
             if isinstance(v, float) and not np.isfinite(v):
                 ev[k] = None
@@ -140,12 +186,15 @@ class GuardPolicy:
                 self.recorder.note_guard(ev)
             except Exception:
                 pass
-        if self.event_sink is None:
+        if sink is None:
             return
         try:
-            self.event_sink(ev)
+            sink(ev)
         except Exception:
-            pass                  # telemetry must never break recovery
+            pass
+
+    def _emit(self, event: Dict) -> None:
+        self._emit_to(self.event_sink, event)
 
     # -- the per-step poll ------------------------------------------------------
 
@@ -157,11 +206,13 @@ class GuardPolicy:
             gs.anomaly, gs.z, gs.lr_scale, gs.consecutive,
             gs.skip_count, gs.spike_count, gs.grad_explosion_count,
             gs.nonfinite_grad_count, gs.nonfinite_loss_count,
-            gs.nonfinite_param_count, gs.step))
+            gs.nonfinite_param_count, gs.replica_divergence_count,
+            gs.step))
         keys = ("anomaly", "z", "lr_scale", "consecutive", "skip_count",
                 "spike_count", "grad_explosion_count",
                 "nonfinite_grad_count", "nonfinite_loss_count",
-                "nonfinite_param_count", "step")
+                "nonfinite_param_count", "replica_divergence_count",
+                "step")
         return {k: (float(v) if k in ("z", "lr_scale") else int(v))
                 for k, v in zip(keys, vals)}
 
@@ -187,7 +238,8 @@ class GuardPolicy:
                   for k in ("skip_count", "spike_count",
                             "grad_explosion_count", "nonfinite_grad_count",
                             "nonfinite_loss_count",
-                            "nonfinite_param_count")}
+                            "nonfinite_param_count",
+                            "replica_divergence_count")}
         new_any = any(v > 0 for v in deltas.values())
         classes = tuple(
             name for key, name in (
@@ -195,7 +247,8 @@ class GuardPolicy:
                 ("grad_explosion_count", "grad_explosion"),
                 ("nonfinite_grad_count", "nonfinite_grad"),
                 ("nonfinite_loss_count", "nonfinite_loss"),
-                ("nonfinite_param_count", "nonfinite_param"))
+                ("nonfinite_param_count", "nonfinite_param"),
+                ("replica_divergence_count", "replica_divergence"))
             if deltas[key] > 0)
         if not new_any:
             return GuardAction("none", step)
@@ -254,6 +307,192 @@ class GuardPolicy:
                               f"{cur['lr_scale']:.4g}"})
         return GuardAction("none" if self.observe_only else "skip",
                            step, classes)
+
+    # -- the integrity rung: vote + in-place repair ----------------------------
+
+    def _emit_integrity(self, event: Dict) -> None:
+        """Like `_emit`, but onto the integrity channel — every event
+        fenced with the cluster generation when one is wired (null
+        otherwise)."""
+        gen = None
+        if self.generation is not None:
+            try:
+                gen = int(self.generation())
+            except Exception:
+                gen = None
+        self._emit_to(self.integrity_sink, dict(event, generation=gen))
+
+    @staticmethod
+    def _fetch_integrity(ist) -> Dict[str, int]:
+        """One small host fetch of the integrity scalars (the
+        per-replica fingerprint vector is fetched only on mismatch)."""
+        import jax
+        vals = jax.device_get((
+            ist.step, ist.check_count, ist.mismatch_count,
+            ist.last_check_step, ist.fp_min, ist.fp_max))
+        keys = ("step", "check_count", "mismatch_count",
+                "last_check_step", "fp_min", "fp_max")
+        return {k: int(v) for k, v in zip(keys, vals)}
+
+    def update_integrity(self, step: int, ist) -> GuardAction:
+        """Poll the :class:`~apex_tpu.guard.IntegrityState` after loop
+        step ``step`` and decide the silent-divergence response.
+
+        On a new mismatch (cumulative ``mismatch_count`` moved since
+        the last poll — a coarse ``poll_every`` cadence still sees
+        every incident) the gathered per-replica fingerprints are
+        fetched and put to a quorum vote
+        (:func:`apex_tpu.guard.integrity.vote`):
+
+        - a strict majority → ``kind="repair"`` naming the diverged
+          minority and the broadcast source (the caller runs
+          :meth:`repair`, NO checkpoint is touched);
+        - no majority (all replicas disagree, or a tie) → the
+          collective itself is suspect; ``kind="rewind"`` — route it
+          through the :class:`~apex_tpu.cluster.RecoveryCoordinator`
+          (or :meth:`rewind` directly on a single host).
+
+        Like `update`, the policy only *decides*; the caller acts.
+        Every decision lands on the integrity channel
+        (``integrity_check`` + ``integrity_vote`` events)."""
+        step = int(step)
+        if (step - self._last_ipoll) < self.poll_every and step != 0:
+            return GuardAction("none", step)
+        self._last_ipoll = step
+        cur = self._fetch_integrity(ist)
+        prev = self._iprev or {k: 0 for k in cur}
+        self._iprev = cur
+        new_mismatches = cur["mismatch_count"] - prev.get(
+            "mismatch_count", 0)
+        if new_mismatches <= 0:
+            return GuardAction("none", step)
+        # -1 = "no check since init/resize" (the elastic-resume
+        # sentinel) — null on the wire, never a negative counter
+        check_step = (cur["last_check_step"]
+                      if cur["last_check_step"] >= 0 else None)
+
+        import jax
+        from apex_tpu.guard import integrity as _integrity
+        rank_fps = jax.device_get(ist.rank_fps)
+        v = _integrity.vote(rank_fps)
+        if v.has_majority and not v.minority:
+            # the gathered fingerprints all AGREE: the cumulative
+            # counter moved but the divergence is already healed — a
+            # transient incident whose later checks re-converged
+            # before this poll, or the first poll of a fresh policy
+            # over a restored IntegrityState whose mismatch_count
+            # predates the restart. A repair with nobody to repair
+            # would be noise, but the DETECTION is still forensic
+            # record: emit the check event (flagged healed, no vote)
+            # and stay quiet.
+            self._emit_integrity({
+                "kind": "integrity_check", "step": step,
+                "check_step": check_step,
+                "n_ranks": v.n_ranks,
+                "mismatch_count": cur["mismatch_count"],
+                "new_mismatches": int(new_mismatches),
+                "fp_min": cur["fp_min"], "fp_max": cur["fp_max"],
+                "healed": True})
+            return GuardAction("none", step)
+        self.last_vote = v
+        self._emit_integrity({
+            "kind": "integrity_check", "step": step,
+            "check_step": check_step,
+            "n_ranks": v.n_ranks,
+            "mismatch_count": cur["mismatch_count"],
+            "new_mismatches": int(new_mismatches),
+            "fp_min": cur["fp_min"], "fp_max": cur["fp_max"]})
+        classes = ("replica_divergence",)
+        if self.observe_only:
+            reason = ("would repair" if v.has_majority
+                      else "would rewind (no majority)")
+            self._emit_integrity({
+                "kind": "integrity_vote", "step": step,
+                "action": "observe", "n_ranks": v.n_ranks,
+                "minority": list(v.minority),
+                "source_rank": v.source_rank,
+                "majority_fp": v.majority_fp, "reason": reason})
+            return GuardAction("none", step, classes, reason)
+        if v.has_majority:
+            reason = (f"minority {list(v.minority)} diverged from "
+                      f"{v.n_ranks - len(v.minority)}-replica majority")
+            self._emit_integrity({
+                "kind": "integrity_vote", "step": step,
+                "action": "repair", "n_ranks": v.n_ranks,
+                "minority": list(v.minority),
+                "source_rank": v.source_rank,
+                "majority_fp": v.majority_fp, "reason": reason})
+            self._vote_armed = True
+            return GuardAction("repair", step, classes, reason)
+        if self.rewinds_done >= self.rewind_budget:
+            # same terminal rung update() enforces for the guard
+            # ladder's rewind classes: a deterministic fault that
+            # re-diverges after every restore must not loop
+            # restore→diverge forever — hand it to the operator
+            reason = (f"no majority fingerprint across {v.n_ranks} "
+                      f"replicas AND rewind budget exhausted "
+                      f"({self.rewinds_done}/{self.rewind_budget})")
+            self._emit_integrity({
+                "kind": "integrity_vote", "step": step,
+                "action": "escalate", "n_ranks": v.n_ranks,
+                "minority": list(v.minority), "source_rank": None,
+                "majority_fp": None, "reason": reason})
+            return GuardAction("escalate", step, classes, reason)
+        reason = (f"no majority fingerprint across {v.n_ranks} "
+                  f"replicas — the collective itself is suspect; "
+                  f"falling through to coordinated rewind")
+        self._emit_integrity({
+            "kind": "integrity_vote", "step": step,
+            "action": "rewind", "n_ranks": v.n_ranks,
+            "minority": list(v.minority), "source_rank": None,
+            "majority_fp": None, "reason": reason})
+        return GuardAction("rewind", step, classes, reason)
+
+    def repair(self, step: int, tree, *, repair_fn, verify_fn,
+               reason: str = "") -> Tuple[Any, bool]:
+        """Execute the in-place repair `update_integrity` decided.
+
+        ``repair_fn``/``verify_fn`` are the mesh-bound programs from
+        :func:`apex_tpu.guard.integrity.make_repair_fn` /
+        :func:`make_verify_fn` (the policy never owns a mesh). The
+        minority replica's buffers are overwritten with the majority
+        source's exact bits, then the fingerprint is re-verified
+        before anyone trains on the result. Returns
+        ``(repaired_tree, verified)`` — on ``verified=False`` the
+        caller MUST fall through to the rewind rung (the audit pins
+        this ladder), and the repaired tree should be discarded. On
+        success, fold the re-verification into the carried state
+        before the next checkpoint — ``ist = guard.absorb_verify(ist,
+        *policy.last_verify)`` — so a snapshot taken this step records
+        the post-repair agreement, not the detection-time
+        disagreement.
+
+        The checkpoint manager and the data cursor are untouched by
+        construction: repair is state surgery on the current step, not
+        time travel."""
+        import jax
+        import jax.numpy as jnp
+        v = self.last_vote
+        if v is None or not v.has_majority or not self._vote_armed:
+            raise ValueError(
+                "repair called without a FRESH majority vote — "
+                "update_integrity must decide immediately before each "
+                "repair (a stale verdict from a previous incident "
+                "must never choose the broadcast source)")
+        self._vote_armed = False     # one vote drives at most one repair
+        repaired = repair_fn(tree, jnp.int32(v.source_rank))
+        mn, mx, fps = verify_fn(repaired)
+        self.last_verify = (mn, mx, fps)
+        ok = int(jax.device_get(mn)) == int(jax.device_get(mx))
+        if ok:
+            self.repairs_done += 1
+        self._emit_integrity({
+            "kind": "integrity_repair", "step": int(step),
+            "action": "repair" if ok else "repair_failed",
+            "source_rank": v.source_rank,
+            "minority": list(v.minority), "verified": bool(ok),
+            "reason": reason or None})
+        return repaired, ok
 
     # -- rewind -----------------------------------------------------------------
 
@@ -398,11 +637,16 @@ class GuardPolicy:
         # yet re-crossed the stale baseline would difference to <= 0
         # and be silently missed
         import jax
-        for leaf in jax.tree_util.tree_leaves(
-                restored, is_leaf=lambda x: isinstance(x, GuardState)):
+        from apex_tpu.guard.integrity import IntegrityState
+        is_state = lambda x: isinstance(x, (GuardState, IntegrityState))
+        for leaf in jax.tree_util.tree_leaves(restored, is_leaf=is_state):
             if isinstance(leaf, GuardState):
                 self._prev = self._fetch(leaf)
-                break
+            elif isinstance(leaf, IntegrityState):
+                # same baseline resync for the integrity counters — a
+                # restored mismatch_count below the cached high-water
+                # mark would otherwise mask the next real divergence
+                self._iprev = self._fetch_integrity(leaf)
         self._emit({"kind": "guard_rewind", "step": int(step),
                     "from_step": int(step),
                     "to_step": int(manifest["step"]),

@@ -73,6 +73,7 @@ class MetricsLogger:
                  goodput_sink: Optional[Sink] = None,
                  roofline_sink: Optional[Sink] = None,
                  cluster_sink: Optional[Sink] = None,
+                 integrity_sink: Optional[Sink] = None,
                  logical_collective_bytes: Optional[int] = None,
                  donation_safe: bool = False):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
@@ -123,6 +124,15 @@ class MetricsLogger:
         #: record_ckpt: a fence refusal usually precedes the zombie's
         #: exit, and the event must survive the crash it documents.
         self.cluster_sink = cluster_sink
+        #: the ``integrity`` event channel (kind="integrity_check"/
+        #: "integrity_vote"/"integrity_repair" events from the
+        #: silent-divergence defense, apex_tpu.guard.integrity —
+        #: validate with ``check_metrics_schema.py --kind integrity``).
+        #: Wire a GuardPolicy with
+        #: ``integrity_sink=logger.record_integrity``. Unbuffered, like
+        #: record_guard: a divergence verdict is rare and forensic, and
+        #: it may immediately precede the escalation that documents it.
+        self.integrity_sink = integrity_sink
         #: the uncompressed payload one step SEMANTICALLY moves (e.g.
         #: ``4 * n_params`` for an fp32 grad sync) — enables the
         #: per-record ``wire_to_logical`` ratio, same contract as
@@ -467,6 +477,26 @@ class MetricsLogger:
                 rec[k] = None
         self.cluster_sink.emit(rec)
 
+    # -- integrity channel ---------------------------------------------------
+
+    def record_integrity(self, event: Dict) -> None:
+        """Emit one integrity-channel event (``kind="integrity_check"
+        |"integrity_vote"|"integrity_repair"``) — plain-dict
+        pass-through like :meth:`record_guard` (divergence incidents
+        are rare and forensic; NOTHING is buffered — a vote that only
+        landed at flush time could be lost to the rewind/escalation it
+        precedes). Non-finite numbers are nulled to keep the
+        strict-JSON contract. Wire a
+        :class:`apex_tpu.guard.GuardPolicy` with
+        ``integrity_sink=logger.record_integrity``."""
+        if self.integrity_sink is None or self._closed:
+            return
+        rec = dict(event)
+        for k, v in rec.items():
+            if isinstance(v, float) and not math.isfinite(v):
+                rec[k] = None
+        self.integrity_sink.emit(rec)
+
     def attach_roofline_report(self, report,
                                step: Optional[int] = None,
                                top: Optional[int] = None
@@ -507,6 +537,8 @@ class MetricsLogger:
             self.roofline_sink.close()
         if self.cluster_sink is not None:
             self.cluster_sink.close()
+        if self.integrity_sink is not None:
+            self.integrity_sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 

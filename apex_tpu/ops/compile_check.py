@@ -1101,6 +1101,116 @@ def _():
         "observe-only guard observation changed the compiled program"
 
 
+@case("integrity/no-extra-dispatch")
+def _():
+    """The silent-divergence defense's observability contract: (1) the
+    fingerprint fold + cross-replica compare ride the existing step
+    program — the instrumented step compiles to ONE executable with no
+    host traffic (off-steps take the empty ``lax.cond`` branch: no
+    fold, no collective, and the host polls only cumulative counters);
+    (2) attaching the HOST side — a GuardPolicy polling GuardState AND
+    IntegrityState every step into guard/integrity sinks — leaves the
+    compiled HLO BIT-IDENTICAL, donated and undonated (observation is
+    pure host-side reads, never ops). Same guarantee the
+    monitor/guard/goodput/cluster cases pin for their layers."""
+    import io
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import guard, monitor, parallel
+    from apex_tpu.monitor.check import module_count_and_host_ops
+    from apex_tpu.trace.spans import span
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        print("  (skip: <2 local devices — no dp axis to fingerprint "
+              "across)")
+        return
+    mesh = Mesh(np.array(devs), ("data",))
+    world = len(devs)
+    cfg = guard.GuardConfig(window=8, min_history=3)
+    icfg = guard.IntegrityConfig(check_every=4)   # steps 1-3 are OFF
+
+    n = 16 * world
+    x = _rand((n, 32), 0)
+    y = _rand((n, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def body(p, gs, ist, x, y, fingerprinted):
+        if fingerprinted:
+            ist = guard.integrity_check(ist, icfg, p, axis_name="data")
+
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        with span("ddp/sync_gradients", kind="collective"):
+            g = parallel.sync_gradients(g, "data")
+        with span("ddp/loss_pmean", kind="collective"):
+            loss = jax.lax.pmean(loss, "data")
+        gs = guard.guard_observe(
+            gs, cfg, loss=loss, grads=g, params=p,
+            replica_ok=guard.integrity_ok(ist) if fingerprinted
+            else None)
+        new_p = jax.tree_util.tree_map(
+            lambda a, b: a - 0.1 * gs.lr_scale * b, p, g)
+        return guard.guard_commit(gs, new_p, p, cfg), gs, ist, loss
+
+    def build(fingerprinted, donate):
+        fn = functools.partial(body, fingerprinted=fingerprinted)
+        mapped = jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P(), P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P(), P()), check_vma=False)
+        kw = {"donate_argnums": (0, 1, 2)} if donate else {}
+        return jax.jit(mapped, **kw)
+
+    gs0 = guard.guard_init(cfg)
+    ist0 = guard.integrity_init(icfg, world=world)
+
+    # half 1: one executable, no host ops (module-count parity with
+    # the fingerprint-less guarded twin)
+    n_i, host_i = module_count_and_host_ops(build(True, False),
+                                            params, gs0, ist0, x, y)
+    n_g, _ = module_count_and_host_ops(build(False, False),
+                                       params, gs0, ist0, x, y)
+    assert n_i == n_g, (n_i, n_g)
+    assert not host_i, \
+        f"fingerprinted step compiled host traffic: {host_i}"
+
+    # half 2: host polling (guard + integrity, every step — three of
+    # four being off-steps) leaves the program bit-identical, donated
+    # and undonated
+    for donate in (False, True):
+        jitted = build(True, donate)
+        before = jitted.lower(params, gs0, ist0, x, y) \
+            .compile().as_text()
+        logger = monitor.MetricsLogger(
+            sinks=[], guard_sink=monitor.JSONLSink(io.StringIO()),
+            integrity_sink=monitor.JSONLSink(io.StringIO()))
+        policy = guard.GuardPolicy(
+            observe_only=True, event_sink=logger.record_guard,
+            integrity_sink=logger.record_integrity)
+        # fresh unaliased buffers: the zero-scalar counters of a
+        # freshly-init'd GuardState/IntegrityState share one cached
+        # device constant, which a donating jit would refuse to donate
+        # twice
+        p, gs, ist = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), (params, gs0, ist0))
+        for i in range(4):
+            p, gs, ist, loss = jitted(p, gs, ist, x, y)
+            act = policy.update(i, gs)
+            iact = policy.update_integrity(i, ist)
+            assert act.kind == "none" and iact.kind == "none"
+        logger.close()
+        after = jitted.lower(params, gs0, ist0, x, y) \
+            .compile().as_text()
+        assert after == before, (
+            f"integrity observation changed the compiled program "
+            f"(donate={donate})")
+
+
 @case("goodput/no-extra-dispatch")
 def _():
     """The goodput observatory is pure host-side observation: a step

@@ -23,7 +23,7 @@ from apex_tpu.parallel.hierarchy import (
 from apex_tpu.parallel.distributed import (
     DistributedDataParallel, Reducer, sync_gradients, flat_all_reduce,
     flat_tree_all_reduce,
-    replicate,
+    replicate, replica_broadcast,
 )
 from apex_tpu.parallel.larc import LARC, larc_rewrite_grads
 from apex_tpu.parallel.registry import (
@@ -46,6 +46,7 @@ __all__ = [
     "replicated", "batch_sharding", "axis_size", "local_batch",
     "DistributedDataParallel", "Reducer", "sync_gradients",
     "flat_all_reduce", "flat_tree_all_reduce", "replicate",
+    "replica_broadcast",
     "DATA_INTER_AXIS", "DATA_INTRA_AXIS",
     "bucket_plan", "bucket_table", "bucketed_all_reduce",
     "init_residual", "wire_bytes",

@@ -170,6 +170,51 @@ class Reducer:
             if _is_float(x) else x, tree)
 
 
+def replica_broadcast(tree, axis_name=DATA_AXIS, *, source=0):
+    """Bit-exact re-broadcast of a pytree from one replica of
+    ``axis_name`` to all of them (inside shard_map) — the in-place
+    repair collective of the silent-divergence defense
+    (:mod:`apex_tpu.guard.integrity`).
+
+    Every replica receives the ``source`` replica's **exact bits**: the
+    broadcast is a ``psum`` of the where-selected *bit pattern*
+    (integer addition against zeros is exact), never of the float
+    values — a float psum would already lose ``-0.0`` signs, and
+    bit-exactness is the whole point (the repaired replica must equal
+    the majority bitwise, or the fingerprint re-verification fails).
+    ``source`` may be a traced scalar (the quorum vote's choice is a
+    runtime value). Call under a registered collective scope
+    (``guard/integrity_repair``) so apexlint APX102/APX202 stay clean.
+    """
+    me = jax.lax.axis_index(axis_name)
+    src = jnp.asarray(source, me.dtype)
+
+    def _one(x):
+        from apex_tpu.utils import uint_view_dtype
+        x = jnp.asarray(x)
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            bits = jax.lax.bitcast_convert_type(
+                x, uint_view_dtype(x.dtype))
+            sel = jnp.where(me == src, bits, jnp.zeros_like(bits))
+            return jax.lax.bitcast_convert_type(
+                jax.lax.psum(sel, axis_name), x.dtype)
+        if x.dtype == jnp.bool_:
+            sel = jnp.where(me == src, x.astype(jnp.int32), 0)
+            return jax.lax.psum(sel, axis_name) != 0
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            sel = jnp.where(me == src, x, jnp.zeros_like(x))
+            return jax.lax.psum(sel, axis_name)
+        # passing an uncovered dtype through unrepaired would silently
+        # leave the divergence in place — refuse loudly (mirrors
+        # guard.integrity's fold, which refuses to fingerprint it)
+        raise TypeError(
+            f"replica_broadcast cannot re-broadcast dtype {x.dtype} "
+            f"bit-exactly — exclude the leaf from the repaired "
+            f"subtree explicitly")
+
+    return jax.tree_util.tree_map(_one, tree)
+
+
 def replicate(tree, mesh: Mesh):
     """Place a pytree replicated on every device of ``mesh`` — the
     construction-time rank-0 broadcast of the reference DDP

@@ -80,6 +80,11 @@ COLLECTIVE_SCOPES: Tuple[CollectiveScope, ...] = (
                     "zero",
                     "ZeRO gradient reduce-scatter / parameter "
                     "all-gather"),
+    CollectiveScope(r"guard/integrity_(check|repair)", DATA_AXIS,
+                    "guard",
+                    "cross-replica integrity fingerprint compare "
+                    "(pmin/pmax/all-gather of one uint32 scalar) and "
+                    "the in-place repair bit-pattern broadcast"),
     CollectiveScope(r"(^|/)ring_", SEQ_AXIS, "ring_attention",
                     "ring/Ulysses sequence-parallel attention "
                     "permutes and all-to-alls"),

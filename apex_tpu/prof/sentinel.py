@@ -197,7 +197,9 @@ def load_rows(paths: Sequence[str],
             row = obj.get("parsed")
             if row is None:
                 why = obj.get("failure_reason")
+                att = obj.get("attempts")
                 note = (f"no parsed bench row (rc={obj.get('rc')}"
+                        + (f"; {att} probe attempts" if att else "")
                         + (f"; {why}" if why else "") + ") — skipped")
         metrics = extract_metrics(row, specs)
         if row is not None and not metrics and note is None:

@@ -1,4 +1,5 @@
 from apex_tpu.utils.backoff import backoff_sleep
+from apex_tpu.utils.bits import uint_view_dtype
 from apex_tpu.utils.fsio import fsync_dir, write_atomic
 from apex_tpu.utils.tree import (
     tree_cast,
@@ -17,6 +18,7 @@ __all__ = [
     "tree_size",
     "global_norm",
     "backoff_sleep",
+    "uint_view_dtype",
     "write_atomic",
     "fsync_dir",
 ]

@@ -1,0 +1,20 @@
+"""Bit-pattern reinterpretation helpers — the one dtype-width table the
+bit-exact seams share (the integrity fingerprint fold and the repair
+broadcast must agree on which leaves are covered bit-exactly, so the
+dispatch lives once)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["uint_view_dtype"]
+
+
+def uint_view_dtype(dtype):
+    """The unsigned dtype that reinterprets ``dtype``'s bit pattern
+    via ``lax.bitcast_convert_type``: width-matched for 1/2/4-byte
+    types; 8-byte types get ``uint32`` — the bitcast then yields a
+    trailing pair of uint32 lanes (both halves carry bits; the reverse
+    bitcast folds the pair back), still exact."""
+    return {1: jnp.uint8, 2: jnp.uint16}.get(
+        jnp.dtype(dtype).itemsize, jnp.uint32)

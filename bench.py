@@ -1166,22 +1166,42 @@ def main():
 BACKEND_FAILURE_EXIT_CODE = 13
 
 
-def _backend_probe():
+#: bounded attempts for the backend probe — round 5's tunnel failure
+#: was a transient flake, and a single probe turned it into a lost
+#: round; three jittered tries absorb a blip without hiding a dead
+#: backend for more than a few seconds
+BACKEND_PROBE_ATTEMPTS = 3
+
+
+def _backend_probe(attempts: int = BACKEND_PROBE_ATTEMPTS):
     """Force backend initialization NOW, before any measurement —
     jax is lazy, so a dead tunnel otherwise surfaces as an opaque
-    rc=1 deep inside the first dispatch (the round-5 failure mode)."""
-    return jax.devices()
+    rc=1 deep inside the first dispatch (the round-5 failure mode).
+    Retried with bounded jittered backoff (``utils/backoff``): a
+    transient tunnel blip must not cost the trajectory a round."""
+    from apex_tpu.utils.backoff import backoff_sleep
+    last = None
+    for i in range(max(int(attempts), 1)):
+        try:
+            return jax.devices()
+        except Exception as e:
+            last = e
+            if i + 1 < attempts:
+                backoff_sleep(i, base_s=0.5, cap_s=4.0)
+    raise last
 
 
 def run_with_backend_guard(fn, mode: str = "default"):
     """Run one bench mode, degrading a backend-init failure into a
-    STRUCTURED row: ``{"parsed": null, "failure_reason": ...}`` on
-    stdout (the committed BENCH_rNN.json then records a skippable row
-    — ``perf_sentinel`` skips it with a note instead of the
-    trajectory silently losing a round) and exit code
+    STRUCTURED row: ``{"parsed": null, "failure_reason": ...,
+    "attempts": N}`` on stdout (the committed BENCH_rNN.json then
+    records a skippable row — ``perf_sentinel`` skips it with a note
+    naming the reason AND the retry count) and exit code
     :data:`BACKEND_FAILURE_EXIT_CODE`. Only *backend bring-up*
-    failures are absorbed; an exception after devices enumerate is a
-    bench bug and propagates with exit 1 as before."""
+    failures are absorbed — and only after
+    :data:`BACKEND_PROBE_ATTEMPTS` jittered tries; an exception after
+    devices enumerate is a bench bug and propagates with exit 1 as
+    before."""
     try:
         _backend_probe()
     except Exception as e:
@@ -1190,6 +1210,7 @@ def run_with_backend_guard(fn, mode: str = "default"):
             "parsed": None,
             "mode": mode,
             "failure_reason": f"backend init failed: {reason}",
+            "attempts": BACKEND_PROBE_ATTEMPTS,
             "rc": BACKEND_FAILURE_EXIT_CODE,
         }))
         return BACKEND_FAILURE_EXIT_CODE

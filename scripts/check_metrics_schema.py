@@ -94,6 +94,20 @@ it lost to; a ``cluster_coord`` is one recovery-round edge (action in
 target fields are nullable (a resolve that escalated has no rewind
 target).
 
+``--kind integrity`` — the silent-divergence-defense channel
+(``MetricsLogger(integrity_sink=...)``; keep in lockstep with
+``apex_tpu/guard/integrity.py`` and ``guard/policy.py``): ``kind`` in
+{integrity_check, integrity_vote, integrity_repair}. An
+``integrity_check`` records one detected cross-replica fingerprint
+mismatch (the in-graph pmin/pmax disagreed — fp_min/fp_max and the
+cumulative mismatch counter); an ``integrity_vote`` records the quorum
+verdict (action in {repair, rewind, escalate, observe}, the named
+minority rank list, and the broadcast source — nullable, a no-majority
+vote has none); an ``integrity_repair`` records the in-place
+re-broadcast (action in {repair, repair_failed}, the re-verification
+verdict). Every event carries a nullable ``generation`` — the cluster
+fence token when a membership is wired.
+
 ``--kind ckpt`` — the checkpoint event channel
 (``MetricsLogger(ckpt_sink=...)``; keep in lockstep with
 ``apex_tpu/ckpt/manager.py`` and ``escalate.py``): ``kind`` in
@@ -111,7 +125,7 @@ jax. Exit status 0 = valid, 1 = violations (printed one per line),
 
 Usage: python scripts/check_metrics_schema.py
            [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline
-                   |cluster]
+                   |cluster|integrity]
            FILE
 """
 
@@ -322,7 +336,7 @@ def check_cluster_lines(lines) -> List[str]:
                                 and r >= 0 for r in v)):
                     errors.append(f"line {i}: {lk!r} must be a list of "
                                   "non-negative rank ids")
-            for sk in ("proposed", "decided", "collective"):
+            for sk in ("proposed", "decided", "collective", "what"):
                 v = rec.get(sk)
                 if v is not None and sk in rec and not isinstance(v, str):
                     errors.append(f"line {i}: {sk!r} must be a string")
@@ -538,7 +552,8 @@ def check_roofline_lines(lines) -> List[str]:
 GUARD_KINDS = ("guard_anomaly", "guard_action", "guard_rewind")
 GUARD_ACTIONS = ("skip", "rewind", "escalate", "observe")
 GUARD_CLASSES = ("loss_spike", "grad_explosion", "nonfinite_grad",
-                 "nonfinite_loss", "nonfinite_param")
+                 "nonfinite_loss", "nonfinite_param",
+                 "replica_divergence")
 #: required keys per guard-event kind (beyond "kind" itself)
 GUARD_REQUIRED = {
     "guard_anomaly": ("step", "classes"),
@@ -606,6 +621,99 @@ def check_guard_lines(lines) -> List[str]:
                     and not isinstance(ts, bool) and ts > fs):
                 errors.append(f"line {i}: rewind goes forwards "
                               f"(to_step {ts} > from_step {fs})")
+    if n_records == 0:
+        errors.append("no records found")
+    return errors
+
+
+# --- integrity channel schema -------------------------------------------------
+
+INTEGRITY_KINDS = ("integrity_check", "integrity_vote",
+                   "integrity_repair")
+INTEGRITY_VOTE_ACTIONS = ("repair", "rewind", "escalate", "observe")
+INTEGRITY_REPAIR_ACTIONS = ("repair", "repair_failed")
+#: required keys per integrity-event kind (beyond "kind" itself)
+INTEGRITY_REQUIRED = {
+    "integrity_check": ("step", "check_step", "n_ranks",
+                        "mismatch_count"),
+    "integrity_vote": ("step", "action", "n_ranks", "minority"),
+    "integrity_repair": ("step", "action", "source_rank", "minority",
+                         "verified"),
+}
+#: keys that may be null per kind (everything else non-null when
+#: present). "generation" is the cluster fence token — null until a
+#: membership is wired; a no-majority vote has no source/majority_fp.
+INTEGRITY_NULLABLE = {
+    # check_step is null when the counter moved but no check ran under
+    # THIS electorate (the integrity_resize elastic-resume sentinel)
+    "integrity_check": ("generation", "check_step"),
+    "integrity_vote": ("generation", "reason", "source_rank",
+                       "majority_fp"),
+    "integrity_repair": ("generation", "reason"),
+}
+
+
+def check_integrity_lines(lines) -> List[str]:
+    """All integrity-channel violations in an iterable of JSONL lines
+    (empty = ok). Validates mismatch reports, quorum votes (with their
+    minority rank lists) and repair records."""
+    errors: List[str] = []
+    n_records = 0
+    for i, rec in _iter_objects(lines, errors):
+        n_records += 1
+        kind = rec.get("kind")
+        if kind not in INTEGRITY_KINDS:
+            errors.append(f"line {i}: 'kind' must be one of "
+                          f"{INTEGRITY_KINDS}, got {kind!r}")
+            continue
+        for key in INTEGRITY_REQUIRED[kind]:
+            if key not in rec:
+                errors.append(f"line {i}: {kind} event missing required "
+                              f"key {key!r}")
+        nullable = INTEGRITY_NULLABLE[kind]
+        for key, v in rec.items():
+            if v is None and key not in nullable:
+                errors.append(f"line {i}: {kind} key {key!r} is null "
+                              f"(only {nullable} may be)")
+        _check_finite_numbers(i, rec, errors)
+        for key in ("rank", "step", "check_step", "n_ranks",
+                    "mismatch_count", "new_mismatches", "fp_min",
+                    "fp_max", "source_rank", "majority_fp",
+                    "generation"):
+            _check_counter(i, rec, key, errors, what="field")
+        minority = rec.get("minority")
+        if minority is not None:
+            if not (isinstance(minority, list)
+                    and all(isinstance(r, int)
+                            and not isinstance(r, bool)
+                            and r >= 0 for r in minority)):
+                errors.append(f"line {i}: 'minority' must be a list of "
+                              f"non-negative replica ranks, got "
+                              f"{minority!r}")
+        if kind == "integrity_check":
+            h = rec.get("healed")
+            if "healed" in rec and not isinstance(h, bool):
+                errors.append(f"line {i}: 'healed' must be a boolean, "
+                              f"got {h!r}")
+        if kind == "integrity_vote":
+            act = rec.get("action")
+            if act is not None and act not in INTEGRITY_VOTE_ACTIONS:
+                errors.append(f"line {i}: 'action' must be one of "
+                              f"{INTEGRITY_VOTE_ACTIONS}, got {act!r}")
+        if kind == "integrity_repair":
+            act = rec.get("action")
+            if act is not None and act not in INTEGRITY_REPAIR_ACTIONS:
+                errors.append(f"line {i}: 'action' must be one of "
+                              f"{INTEGRITY_REPAIR_ACTIONS}, got "
+                              f"{act!r}")
+            ver = rec.get("verified")
+            if "verified" in rec and not isinstance(ver, bool):
+                errors.append(f"line {i}: 'verified' must be a "
+                              f"boolean, got {ver!r}")
+            if (isinstance(ver, bool) and isinstance(act, str)
+                    and (act == "repair") != ver):
+                errors.append(f"line {i}: action {act!r} contradicts "
+                              f"verified={ver}")
     if n_records == 0:
         errors.append("no records found")
     return errors
@@ -967,7 +1075,8 @@ CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "ckpt": check_ckpt_lines, "guard": check_guard_lines,
             "goodput": check_goodput_lines,
             "roofline": check_roofline_lines,
-            "cluster": check_cluster_lines}
+            "cluster": check_cluster_lines,
+            "integrity": check_integrity_lines}
 
 
 def main(argv=None) -> int:

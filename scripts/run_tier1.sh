@@ -19,6 +19,15 @@
 #                                   #   NaN-spike rewind bitwise vs a
 #                                   #   fault-free oracle, skip-class
 #                                   #   convergence, guard schema
+#                                   # + the integrity audit (--cpu8):
+#                                   #   silent mantissa-bitflip caught
+#                                   #   by cross-replica fingerprints,
+#                                   #   minority named by quorum vote,
+#                                   #   repaired in place bitwise vs
+#                                   #   oracle; no-majority falls
+#                                   #   through to coordinated rewind;
+#                                   #   EF-int8 hierarchical sync
+#                                   #   fingerprint-clean
 #                                   # + the cluster control-plane audit
 #                                   #   (--cpu8): zombie write/delete
 #                                   #   fenced after a generation bump,
@@ -140,6 +149,19 @@ EOF
     # batch faults are skipped in-graph and still converge, (d) the
     # guard event stream passes --kind guard
     JAX_PLATFORMS=cpu python scripts/chaos_audit.py --cpu8
+
+    echo "== smoke: integrity silent-divergence audit (8-device CPU mesh)"
+    # asserts: (a) a fault-free fingerprinted run logs ZERO integrity
+    # events with bit-identical HLO under host polling, (b) a seeded
+    # FINITE mantissa bitflip on replica 1 (silent to the NaN/spike
+    # probes) is detected within check_every steps, the minority named
+    # by quorum vote, and repaired IN PLACE (no rewind, cursor
+    # untouched) bitwise vs a fault-free oracle, (c) a 2-of-2
+    # no-majority divergence falls through to the coordinated-rewind
+    # path with exactly one generation bump, (d) the EF-int8
+    # hierarchical sync runs fingerprint-clean (the collectives-v2
+    # runtime proof), (e) every stream passes --kind integrity
+    JAX_PLATFORMS=cpu python scripts/integrity_audit.py --cpu8
 
     echo "== smoke: cluster control-plane audit (8-device CPU mesh)"
     # asserts: (a) a rank paused through an escalation + relaunch has

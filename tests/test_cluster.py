@@ -1185,6 +1185,57 @@ class TestBenchBackendGuard:
         assert rows[0]["row"] is None
         assert "tunnel down" in rows[0]["note"]
 
+    def test_transient_probe_flake_is_retried_and_absorbed(
+            self, monkeypatch):
+        """Round 5's failure mode: the tunnel blips once — the bounded
+        jittered retry must absorb it instead of losing the round."""
+        import bench
+        from apex_tpu.utils import backoff
+        monkeypatch.setattr(backoff, "backoff_sleep",
+                            lambda *a, **k: 0.0)
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("tunnel blip")
+            return ["cpu"]
+
+        monkeypatch.setattr(bench.jax, "devices", flaky)
+        ran = []
+        assert bench.run_with_backend_guard(lambda: ran.append(1)) == 0
+        assert len(calls) == 3 and ran == [1]
+
+    def test_failure_row_records_probe_attempts(self, monkeypatch,
+                                                capsys):
+        import bench
+        from apex_tpu.utils import backoff
+        monkeypatch.setattr(backoff, "backoff_sleep",
+                            lambda *a, **k: 0.0)
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise RuntimeError("tunnel down for good")
+
+        monkeypatch.setattr(bench.jax, "devices", dead)
+        rc = bench.run_with_backend_guard(lambda: None)
+        assert rc == 13
+        assert len(calls) == bench.BACKEND_PROBE_ATTEMPTS == 3
+        row = json.loads(capsys.readouterr().out.strip())
+        assert row["attempts"] == 3
+
+    def test_sentinel_note_names_the_attempt_count(self, tmp_path):
+        from apex_tpu.prof import sentinel
+        p = str(tmp_path / "BENCH_r07.json")
+        with open(p, "w") as f:
+            json.dump({"parsed": None, "rc": 13, "attempts": 3,
+                       "failure_reason": "backend init failed: "
+                                         "tunnel down"}, f)
+        rows = sentinel.load_rows([p])
+        assert "3 probe attempts" in rows[0]["note"]
+        assert "tunnel down" in rows[0]["note"]
+
 
 # --- acceptance: the SIGSTOP zombie is fenced ---------------------------------
 

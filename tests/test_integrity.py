@@ -1,0 +1,702 @@
+"""The silent-divergence defense: cross-replica integrity fingerprints
+(fold / in-graph compare / veto), the quorum vote naming the minority,
+the bit-exact in-place repair broadcast, the GuardPolicy integrity rung,
+the chaos mantissa-bitflip + replica-targeting sites, and the
+``--kind integrity`` event schema (valid stream + negative twins). The
+full end-to-end claims — repair bitwise vs a fault-free oracle, the
+no-majority coordinated-rewind fall-through, the EF-int8 hierarchical
+fingerprint-clean proof — live in ``scripts/integrity_audit.py --cpu8``.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from apex_tpu import guard
+
+
+def _rep(mesh, tree):
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def _diverge(leaf, replica, bit=12):
+    """One replica's buffer with a mantissa bit of element 0 flipped —
+    the sharding still claims replication."""
+    orig = np.array(np.asarray(leaf), copy=True)
+    bufs = []
+    for i, d in enumerate(leaf.sharding.mesh.devices.flat):
+        v = np.array(orig, copy=True)
+        if i == replica:
+            fv = v.reshape(-1)[:1].view(np.uint32)
+            fv[0] ^= np.uint32(1 << bit)
+        bufs.append(jax.device_put(v, d))
+    return jax.make_array_from_single_device_arrays(
+        leaf.shape, leaf.sharding, bufs)
+
+
+# --- the fold -----------------------------------------------------------------
+
+class TestFingerprint:
+    def test_deterministic_and_bit_sensitive(self):
+        x = {"w": jnp.linspace(0.1, 1.0, 64, dtype=jnp.float32),
+             "b": jnp.zeros((8,), jnp.float32)}
+        a = int(guard.fingerprint_tree(x))
+        assert int(guard.fingerprint_tree(x)) == a
+        v = np.asarray(x["w"]).copy()
+        iv = v[:1].view(np.uint32)
+        iv[0] ^= np.uint32(1)           # the least significant mantissa bit
+        y = {"w": jnp.asarray(v), "b": x["b"]}
+        assert int(guard.fingerprint_tree(y)) != a
+
+    def test_position_sensitive_within_a_leaf(self):
+        """The fold weights each element's bits by a per-position odd
+        constant: two elements swapping values IS a divergence and
+        must change the fingerprint (a plain sum would be blind to
+        it), while the wraparound addition itself stays reduction-
+        order-independent — safe to compare across replicas
+        regardless of per-device scheduling."""
+        rng = np.random.RandomState(0)
+        v = rng.randn(128).astype(np.float32)
+        a = int(guard.fingerprint_tree(jnp.asarray(v)))
+        b = int(guard.fingerprint_tree(jnp.asarray(v[::-1].copy())))
+        assert a != b
+
+    @pytest.mark.parametrize("bit", [12, 31])
+    def test_compensating_flips_detected(self, bit):
+        """Same-significance flips in two elements — one GAINS the
+        bit, one LOSES it — leave a plain bit-sum unchanged, and for
+        the sign bit even a position-WEIGHTED sum cancels exactly
+        (2³¹·Δw ≡ 0 mod 2³² for every even weight gap); the per-term
+        avalanche must still see the divergence."""
+        iv = np.asarray([0x3FC00000 | np.uint32(0 << bit),
+                         0x40200000 | np.uint32(1 << bit)], np.uint32)
+        v = iv.view(np.float32)
+        a = int(guard.fingerprint_tree(jnp.asarray(v)))
+        iw = iv.copy()
+        iw[0] |= np.uint32(1 << bit)                 # element 0 gains
+        iw[1] &= ~np.uint32(1 << bit)                # element 1 loses
+        assert int(iw[0]) + int(iw[1]) == int(iv[0]) + int(iv[1]), \
+            "fixture must be sum-neutral (what a linear fold misses)"
+        b = int(guard.fingerprint_tree(jnp.asarray(iw.view(np.float32))))
+        assert b != a
+
+    def test_leaf_position_sensitive(self):
+        """Swapping two equal-shaped leaves must change the fold (a
+        swap is a real divergence)."""
+        x = jnp.linspace(0.0, 1.0, 16, dtype=jnp.float32)
+        y = jnp.linspace(2.0, 3.0, 16, dtype=jnp.float32)
+        assert (int(guard.fingerprint_tree({"a": x, "b": y}))
+                != int(guard.fingerprint_tree({"a": y, "b": x})))
+
+    def test_cross_leaf_element_exchange_detected(self):
+        """The seed identity must be injective ACROSS leaves: with
+        per-leaf arithmetic-progression seeds, (leaf i, pos k+2) and
+        (leaf i+1, pos k) aliased and an exact two-element exchange
+        at the aliased offsets cancelled — the global-lane-offset
+        identity must see every such transposition."""
+        rng = np.random.RandomState(3)
+        a = rng.randn(8).astype(np.float32)
+        b = rng.randn(8).astype(np.float32)
+        clean = int(guard.fingerprint_tree(
+            {"a": jnp.asarray(a), "b": jnp.asarray(b)}))
+        for ka in range(8):          # every cross-leaf offset pair of
+            for kb in range(0, 8, 3):  # the old aliasing shape + more
+                a2, b2 = a.copy(), b.copy()
+                a2[ka], b2[kb] = b[kb], a[ka]
+                swapped = int(guard.fingerprint_tree(
+                    {"a": jnp.asarray(a2), "b": jnp.asarray(b2)}))
+                assert swapped != clean, (ka, kb)
+
+    def test_uint_view_dtype_is_the_shared_table(self):
+        """The fold and the repair broadcast must agree on bit-exact
+        coverage — both read apex_tpu.utils.uint_view_dtype."""
+        from apex_tpu.utils import uint_view_dtype
+        assert uint_view_dtype(jnp.float32) == jnp.uint32
+        assert uint_view_dtype(jnp.bfloat16) == jnp.uint16
+        assert uint_view_dtype(jnp.float16) == jnp.uint16
+        assert uint_view_dtype(jnp.float64) == jnp.uint32  # lane pair
+
+    def test_mixed_dtypes_fold(self):
+        tree = {"f32": jnp.ones((4,), jnp.float32),
+                "bf16": jnp.ones((4,), jnp.bfloat16),
+                "i32": jnp.arange(4, dtype=jnp.int32),
+                "bool": jnp.asarray([True, False]),
+                "empty": jnp.zeros((0,), jnp.float32)}
+        fp = guard.fingerprint_tree(tree)
+        assert fp.dtype == jnp.uint32
+
+    def test_uncovered_dtype_refused_loudly(self, mesh8):
+        """A dtype the fold cannot cover bit-exactly must raise, not
+        silently skip — a skipped leaf would be an undetectable (and
+        unrepairable) hole in the guarantee."""
+        bad = {"c": jnp.ones((4,), jnp.complex64)}
+        with pytest.raises(TypeError):
+            guard.fingerprint_tree(bad)
+        from apex_tpu.parallel import replica_broadcast
+        with pytest.raises(TypeError):
+            jax.jit(jax.shard_map(
+                lambda t: replica_broadcast(t, "data", source=0),
+                mesh=mesh8, in_specs=(P(),), out_specs=P(),
+                check_vma=False))(_rep(mesh8, bad))
+
+    def test_init_validation(self):
+        with pytest.raises(ValueError):
+            guard.integrity_init(guard.IntegrityConfig(check_every=0),
+                                 world=8)
+        with pytest.raises(ValueError):
+            guard.integrity_init(world=1)
+
+
+# --- the in-graph check -------------------------------------------------------
+
+def _check_step(icfg, mesh):
+    def f(p, ist):
+        return guard.integrity_check(ist, icfg, p, axis_name="data")
+    return jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        check_vma=False))
+
+
+class TestIntegrityCheck:
+    def test_cadence_skips_off_steps(self, mesh8):
+        icfg = guard.IntegrityConfig(check_every=3)
+        ist = guard.integrity_init(icfg, world=8)
+        p = _rep(mesh8, {"w": jnp.ones((16,), jnp.float32)})
+        step = _check_step(icfg, mesh8)
+        for s in range(6):
+            ist = step(p, ist)
+        assert int(ist.step) == 6
+        assert int(ist.check_count) == 2          # steps 0 and 3
+        assert int(ist.mismatch_count) == 0
+        assert int(ist.last_check_step) == 3
+
+    def test_divergence_detected_and_minority_gathered(self, mesh8):
+        icfg = guard.IntegrityConfig(check_every=1)
+        ist = guard.integrity_init(icfg, world=8)
+        p = _rep(mesh8, {"w": jnp.linspace(0.1, 1.0, 32,
+                                           dtype=jnp.float32)})
+        step = _check_step(icfg, mesh8)
+        ist = step(p, ist)
+        assert not bool(ist.divergent)
+        p = {"w": _diverge(p["w"], replica=5)}
+        ist = step(p, ist)
+        assert bool(ist.divergent)
+        assert int(ist.mismatch_count) == 1
+        fps = np.asarray(ist.rank_fps)
+        bad = [i for i in range(8) if fps[i] != fps[0]]
+        assert bad == [5]
+
+    def test_divergent_flag_clears_on_off_step(self, mesh8):
+        icfg = guard.IntegrityConfig(check_every=2)
+        ist = guard.integrity_init(icfg, world=8)
+        p = {"w": _diverge(_rep(mesh8, jnp.ones((8,), jnp.float32)),
+                           replica=1)}
+        step = _check_step(icfg, mesh8)
+        ist = step({"w": p["w"]}, ist)            # step 0: check, diverged
+        assert bool(ist.divergent)
+        ist = step({"w": p["w"]}, ist)            # step 1: off-step
+        assert not bool(ist.divergent)            # transient cleared
+        assert int(ist.mismatch_count) == 1       # cumulative kept
+
+    def test_resize_for_elastic_resume(self):
+        """A checkpointed IntegrityState restored onto a different
+        mesh size: counters (history) survive, the per-replica vector
+        and last-check transients re-init for the new electorate;
+        same-world passes through untouched."""
+        icfg = guard.IntegrityConfig(check_every=1)
+        ist = guard.integrity_init(icfg, world=8)._replace(
+            mismatch_count=jnp.int32(3), check_count=jnp.int32(7),
+            step=jnp.int32(7), divergent=jnp.bool_(True),
+            rank_fps=jnp.arange(8, dtype=jnp.uint32))
+        small = guard.integrity_resize(ist, world=4)
+        assert small.rank_fps.shape == (4,)
+        assert int(small.mismatch_count) == 3    # history preserved
+        assert int(small.check_count) == 7
+        assert not bool(small.divergent)
+        assert guard.integrity_resize(ist, world=8) is ist
+        with pytest.raises(ValueError):
+            guard.integrity_resize(ist, world=1)
+        # a fresh policy's first poll over the resized state: healed
+        # forensic note with the no-check-yet sentinel NULLED, and the
+        # event validates under the integrity schema
+        from apex_tpu.guard.policy import GuardPolicy
+        from scripts.check_metrics_schema import check_integrity_lines
+        iev = []
+        pol = GuardPolicy(integrity_sink=iev.append)
+        assert pol.update_integrity(0, small).kind == "none"
+        assert len(iev) == 1 and iev[0]["healed"] is True
+        assert iev[0]["check_step"] is None
+        assert check_integrity_lines([json.dumps(iev[0])]) == []
+
+    def test_replica_ok_feeds_guard_veto(self, mesh8):
+        """guard_observe(replica_ok=False) raises the skip-class
+        divergence anomaly: the commit is vetoed, the counter moves,
+        and the polluted loss never enters the window."""
+        cfg = guard.GuardConfig(window=8, min_history=2)
+        gs = guard.guard_init(cfg)
+        for i in range(4):
+            gs = guard.guard_observe(gs, cfg, loss=jnp.float32(1.0),
+                                     replica_ok=True)
+        count_before = int(gs.count)
+        gs = guard.guard_observe(gs, cfg, loss=jnp.float32(1.0),
+                                 replica_ok=False)
+        assert int(gs.anomaly) == guard.A_REPLICA_DIVERGENCE
+        assert int(gs.replica_divergence_count) == 1
+        assert int(gs.skip_count) == 1
+        assert int(gs.count) == count_before      # window not polluted
+        assert not bool(guard.guard_ok(gs))
+        new = {"w": jnp.ones((2,), jnp.float32)}
+        old = {"w": jnp.zeros((2,), jnp.float32)}
+        kept = guard.guard_commit(gs, new, old, cfg)
+        np.testing.assert_array_equal(np.asarray(kept["w"]),
+                                      np.asarray(old["w"]))
+        # divergence must NOT back the LR off (not an instability)
+        assert float(gs.lr_scale) == 1.0
+
+
+# --- the vote -----------------------------------------------------------------
+
+class TestVote:
+    def test_single_bad_replica(self):
+        v = guard.vote([7, 7, 9, 7, 7, 7, 7, 7])
+        assert v.has_majority and v.minority == (2,)
+        assert v.source_rank == 0 and v.n_ranks == 8
+
+    def test_source_is_lowest_majority_rank(self):
+        v = guard.vote([3, 7, 7, 7])
+        assert v.minority == (0,) and v.source_rank == 1
+
+    def test_two_of_two_tie_has_no_majority(self):
+        v = guard.vote([1, 2])
+        assert not v.has_majority
+        assert v.source_rank is None and v.minority == ()
+
+    def test_all_disagree_has_no_majority(self):
+        assert not guard.vote([1, 2, 3, 4]).has_majority
+
+    def test_exact_half_is_not_a_majority(self):
+        assert not guard.vote([5, 5, 6, 6]).has_majority
+        assert guard.vote([5, 5, 5, 6]).has_majority
+
+
+# --- the repair broadcast -----------------------------------------------------
+
+class TestRepair:
+    def test_repair_is_bit_exact_on_every_buffer(self, mesh8):
+        tree = _rep(mesh8, {
+            "w": jnp.asarray([-0.0, 1.5, -2.25, 0.0], jnp.float32),
+            "h": jnp.asarray([1.0, -0.5], jnp.bfloat16),
+            "n": jnp.arange(4, dtype=jnp.int32)})
+        orig = {k: np.array(np.asarray(v), copy=True)
+                for k, v in tree.items()}
+        tree = dict(tree, w=_diverge(tree["w"], replica=3))
+        repair = guard.make_repair_fn(mesh8, "data")
+        verify = guard.make_verify_fn(mesh8, "data")
+        mn, mx, _ = verify(tree)
+        assert int(mn) != int(mx)
+        fixed = repair(tree, jnp.int32(0))
+        mn, mx, _ = verify(fixed)
+        assert int(mn) == int(mx)
+        for k in orig:
+            for sh in fixed[k].addressable_shards:
+                got = np.asarray(sh.data)
+                assert got.dtype == orig[k].dtype
+                np.testing.assert_array_equal(got, orig[k])
+        # -0.0 sign survived the broadcast (bit-pattern psum; a float
+        # psum would have collapsed it to +0.0 and failed re-verify)
+        assert np.signbit(np.asarray(fixed["w"])[0])
+
+    def test_repair_from_nonzero_source(self, mesh8):
+        leaf = _rep(mesh8, jnp.linspace(0.0, 1.0, 8, jnp.float32))
+        bad = _diverge(leaf, replica=0)           # replica 0 is the bad one
+        repair = guard.make_repair_fn(mesh8, "data")
+        fixed = repair({"w": bad}, jnp.int32(4))
+        want = np.asarray(leaf)
+        for sh in fixed["w"].addressable_shards:
+            np.testing.assert_array_equal(np.asarray(sh.data), want)
+
+
+# --- the policy rung ----------------------------------------------------------
+
+def _policy_with_sinks(**kw):
+    iev, gev = [], []
+    pol = guard.GuardPolicy(integrity_sink=iev.append,
+                            event_sink=gev.append, **kw)
+    return pol, iev, gev
+
+
+class TestPolicyIntegrity:
+    def _diverged_ist(self, mesh8, replica=2):
+        icfg = guard.IntegrityConfig(check_every=1)
+        ist = guard.integrity_init(icfg, world=8)
+        p = {"w": _diverge(
+            _rep(mesh8, jnp.linspace(0.1, 1.0, 16, jnp.float32)),
+            replica=replica)}
+        return _check_step(icfg, mesh8)(p, ist), p
+
+    def test_clean_state_no_events(self, mesh8):
+        icfg = guard.IntegrityConfig(check_every=1)
+        ist = guard.integrity_init(icfg, world=8)
+        p = _rep(mesh8, {"w": jnp.ones((8,), jnp.float32)})
+        ist = _check_step(icfg, mesh8)(p, ist)
+        pol, iev, _ = _policy_with_sinks()
+        assert pol.update_integrity(0, ist).kind == "none"
+        assert iev == []
+
+    def test_mismatch_votes_repair_and_repairs(self, mesh8):
+        ist, p = self._diverged_ist(mesh8)
+        pol, iev, _ = _policy_with_sinks()
+        act = pol.update_integrity(0, ist)
+        assert act.kind == "repair"
+        assert act.classes == ("replica_divergence",)
+        assert pol.last_vote.minority == (2,)
+        kinds = [e["kind"] for e in iev]
+        assert kinds == ["integrity_check", "integrity_vote"]
+        assert iev[1]["action"] == "repair"
+        assert iev[1]["minority"] == [2]
+        fixed, ok = pol.repair(
+            0, p, repair_fn=guard.make_repair_fn(mesh8, "data"),
+            verify_fn=guard.make_verify_fn(mesh8, "data"))
+        assert ok and pol.repairs_done == 1 and pol.rewinds_done == 0
+        assert iev[-1]["kind"] == "integrity_repair"
+        assert iev[-1]["verified"] is True
+
+    def test_coarse_poll_recovers_missed_mismatch(self, mesh8):
+        ist, _p = self._diverged_ist(mesh8)
+        pol, iev, _ = _policy_with_sinks(poll_every=4)
+        assert pol.update_integrity(1, ist).kind == "none"  # off-poll
+        act = pol.update_integrity(5, ist)    # cumulative delta seen
+        assert act.kind == "repair"
+
+    def test_no_majority_with_exhausted_budget_escalates(self, mesh8):
+        """The integrity rung honors the same rewind_budget terminal
+        as the guard ladder: a deterministic no-majority fault must
+        not loop restore→re-diverge forever."""
+        icfg = guard.IntegrityConfig(check_every=1)
+        ist = guard.integrity_init(icfg, world=8)
+        # every replica diverged differently: no majority
+        leaf = _rep(mesh8, jnp.linspace(0.1, 1.0, 16, jnp.float32))
+        bufs = []
+        orig = np.array(np.asarray(leaf), copy=True)
+        for i, d in enumerate(mesh8.devices.flat):
+            v = np.array(orig, copy=True)
+            fv = v.reshape(-1)[:1].view(np.uint32)
+            fv[0] ^= np.uint32(1 << (5 + i))
+            bufs.append(jax.device_put(v, d))
+        p = {"w": jax.make_array_from_single_device_arrays(
+            leaf.shape, leaf.sharding, bufs)}
+        ist = _check_step(icfg, mesh8)(p, ist)
+        pol, iev, _ = _policy_with_sinks(rewind_budget=2)
+        assert pol.update_integrity(0, ist).kind == "rewind"
+        pol2, iev2, _ = _policy_with_sinks(rewind_budget=2)
+        pol2.rewinds_done = 2                    # budget spent
+        act = pol2.update_integrity(0, ist)
+        assert act.kind == "escalate"
+        votes = [e for e in iev2 if e["kind"] == "integrity_vote"]
+        assert votes and votes[0]["action"] == "escalate"
+
+    def test_observe_only_reports_never_acts(self, mesh8):
+        ist, _p = self._diverged_ist(mesh8)
+        pol, iev, _ = _policy_with_sinks(observe_only=True)
+        act = pol.update_integrity(0, ist)
+        assert act.kind == "none"
+        assert [e["action"] for e in iev
+                if e["kind"] == "integrity_vote"] == ["observe"]
+
+    def test_repair_without_vote_raises(self):
+        pol, _, _ = _policy_with_sinks()
+        with pytest.raises(ValueError):
+            pol.repair(0, {}, repair_fn=None, verify_fn=None)
+
+    def test_stale_vote_cannot_drive_a_second_repair(self, mesh8):
+        """One vote arms at most one repair: a retry without a fresh
+        update_integrity verdict must refuse — a stale source choice
+        from a previous incident must never drive a broadcast."""
+        ist, p = self._diverged_ist(mesh8)
+        pol, _, _ = _policy_with_sinks()
+        assert pol.update_integrity(0, ist).kind == "repair"
+        rf = guard.make_repair_fn(mesh8, "data")
+        vf = guard.make_verify_fn(mesh8, "data")
+        _fixed, ok = pol.repair(0, p, repair_fn=rf, verify_fn=vf)
+        assert ok
+        assert pol.last_vote is not None      # kept for forensics
+        with pytest.raises(ValueError):
+            pol.repair(1, p, repair_fn=rf, verify_fn=vf)
+
+    def test_absorb_verify_prevents_stale_vote_replay(self, mesh8):
+        """A checkpoint taken on the repair step must not freeze the
+        detection-time disagreement: after repair + absorb_verify, a
+        FRESH policy (simulated restart, zero baseline) sees the
+        nonzero cumulative counter but AGREEING rank_fps — healed
+        branch, no verdict, no spurious repair."""
+        ist, p = self._diverged_ist(mesh8)
+        pol, _, _ = _policy_with_sinks()
+        assert pol.update_integrity(0, ist).kind == "repair"
+        fixed, ok = pol.repair(
+            0, p, repair_fn=guard.make_repair_fn(mesh8, "data"),
+            verify_fn=guard.make_verify_fn(mesh8, "data"))
+        assert ok
+        ist = guard.absorb_verify(ist, *pol.last_verify)
+        assert not bool(ist.divergent)
+        fps = np.asarray(ist.rank_fps)
+        assert (fps == fps[0]).all()
+        assert int(ist.mismatch_count) == 1      # history preserved
+        fresh, iev2, _ = _policy_with_sinks()
+        act = fresh.update_integrity(0, ist)
+        assert act.kind == "none"
+        assert [e["kind"] for e in iev2] == ["integrity_check"]
+        assert iev2[0]["healed"] is True
+
+    def test_restored_counter_with_healed_replicas_stays_quiet(self):
+        """A fresh policy's first poll over a RESTORED IntegrityState
+        whose cumulative mismatch_count predates the restart: the
+        gathered fingerprints all agree (the divergence was repaired
+        before the checkpoint), so no verdict and no phantom events —
+        just a baseline resync."""
+        icfg = guard.IntegrityConfig(check_every=1)
+        ist = guard.integrity_init(icfg, world=8)._replace(
+            mismatch_count=jnp.int32(2), check_count=jnp.int32(5),
+            step=jnp.int32(5), last_check_step=jnp.int32(4))
+        pol, iev, _ = _policy_with_sinks()
+        assert pol.update_integrity(0, ist).kind == "none"
+        # the DETECTION stays on the forensic record (flagged healed,
+        # no vote, no repair) — but no phantom verdict
+        assert [e["kind"] for e in iev] == ["integrity_check"]
+        assert iev[0]["healed"] is True
+        assert pol.last_vote is None
+        # and the baseline is synced: the next poll is fully quiet
+        assert pol.update_integrity(1, ist).kind == "none"
+        assert len(iev) == 1
+
+    def test_generation_fences_events(self, mesh8):
+        ist, _p = self._diverged_ist(mesh8)
+        pol, iev, _ = _policy_with_sinks(generation=lambda: 7)
+        pol.update_integrity(0, ist)
+        assert all(e["generation"] == 7 for e in iev)
+
+    def test_unfenced_events_carry_null_generation(self, mesh8):
+        ist, _p = self._diverged_ist(mesh8)
+        pol, iev, _ = _policy_with_sinks()
+        pol.update_integrity(0, ist)
+        assert all(e["generation"] is None for e in iev)
+
+    def test_guard_update_names_the_class(self):
+        """The GuardState counter half: update() reports the
+        divergence skip as a guard_anomaly with the new class."""
+        cfg = guard.GuardConfig(window=8, min_history=2)
+        gs = guard.guard_init(cfg)
+        gs = guard.guard_observe(gs, cfg, loss=jnp.float32(1.0),
+                                 replica_ok=False)
+        pol, _, gev = _policy_with_sinks()
+        act = pol.update(0, gs)
+        assert act.kind == "skip"
+        assert "replica_divergence" in act.classes
+        anom = [e for e in gev if e["kind"] == "guard_anomaly"]
+        assert anom and anom[0]["classes"] == ["replica_divergence"]
+
+
+# --- chaos: the silent-fault injector -----------------------------------------
+
+class TestChaosMantissa:
+    def test_plan_accepts_the_new_kind(self):
+        plan = guard.FaultPlan().add(3, "params", "bitflip_mantissa",
+                                     arg=12)
+        f = plan.at(3, 0, "params")
+        assert f.kind == "bitflip_mantissa"
+        rt = guard.FaultPlan.from_json(plan.to_json())
+        assert rt == plan
+
+    def test_mantissa_flip_is_always_finite(self):
+        """Any arg — including ones that would index exponent/sign
+        bits — lands on a mantissa bit, so the corrupted value is
+        finite by construction (the whole point: silent to the
+        nonfinite probe)."""
+        for arg in (0, 12, 22, 23, 30, 31, 100):
+            state = {"w": jnp.asarray([1.5, 2.0], jnp.float32)}
+            f = guard.Fault(0, "params", "bitflip_mantissa", 0,
+                            float(arg))
+            out = guard.ChaosHarness._corrupt_params(state, f)
+            v = np.asarray(out["w"])
+            assert np.all(np.isfinite(v)), arg
+            assert v[0] != 1.5, arg              # but it DID corrupt
+
+    def test_legacy_bitflip_still_flips_the_exponent(self):
+        """The default bitflip stays LOUD (top exponent bit → a huge
+        or non-finite value the existing probes catch) — the mantissa
+        mode exists precisely because this one is not silent."""
+        state = {"w": jnp.asarray([1.5], jnp.float32)}
+        f = guard.Fault(0, "params", "bitflip", 0, 0.0)
+        out = guard.ChaosHarness._corrupt_params(state, f)
+        v = float(np.asarray(out["w"])[0])
+        assert not np.isfinite(v) or abs(v) > 1e30
+
+    def test_replica_targeting_diverges_one_buffer(self, mesh8):
+        state = _rep(mesh8, {"w": jnp.linspace(0.1, 1.0, 8,
+                                               jnp.float32)})
+        orig = np.array(np.asarray(state["w"]), copy=True)
+        plan = guard.FaultPlan().add(0, "params", "bitflip_mantissa",
+                                     arg=5)
+        h = guard.ChaosHarness(plan, replica=6)
+        out = h.post_step(0, state)
+        shards = list(out["w"].addressable_shards)
+        same = [i for i, sh in enumerate(shards)
+                if np.array_equal(np.asarray(sh.data), orig)]
+        assert len(same) == 7 and 6 not in same
+        # the logical (device-0) view still reads clean — the lie a
+        # silent fault tells every host-side consumer
+        np.testing.assert_array_equal(np.asarray(out["w"]), orig)
+
+    def test_replica_out_of_range_refused(self, mesh8):
+        state = _rep(mesh8, {"w": jnp.ones((4,), jnp.float32)})
+        plan = guard.FaultPlan().add(0, "params", "bitflip_mantissa")
+        h = guard.ChaosHarness(plan, replica=11)
+        with pytest.raises(ValueError):
+            h.post_step(0, state)
+
+    def test_sharded_leaf_refused(self, mesh8):
+        """replica= promises a dp replica index — on a sharded leaf a
+        flat device index is neither a replica nor shape-compatible;
+        the harness must refuse loudly instead of corrupting the
+        wrong shard."""
+        state = {"w": jax.device_put(
+            jnp.ones((16,), jnp.float32),
+            NamedSharding(mesh8, P("data")))}
+        plan = guard.FaultPlan().add(0, "params", "bitflip_mantissa")
+        h = guard.ChaosHarness(plan, replica=2)
+        with pytest.raises(ValueError):
+            h.post_step(0, state)
+
+
+# --- event schema -------------------------------------------------------------
+
+def _lines(events):
+    return [json.dumps(e) for e in events]
+
+
+_CHECK_EV = {"kind": "integrity_check", "rank": 0, "step": 4,
+             "check_step": 4, "n_ranks": 8, "mismatch_count": 1,
+             "new_mismatches": 1, "fp_min": 100, "fp_max": 200,
+             "generation": None, "wall_time": 1.0}
+_VOTE_EV = {"kind": "integrity_vote", "rank": 0, "step": 4,
+            "action": "repair", "n_ranks": 8, "minority": [1],
+            "source_rank": 0, "majority_fp": 100, "generation": None,
+            "reason": "minority [1] diverged", "wall_time": 1.0}
+_REPAIR_EV = {"kind": "integrity_repair", "rank": 0, "step": 4,
+              "action": "repair", "source_rank": 0, "minority": [1],
+              "verified": True, "generation": None, "reason": None,
+              "wall_time": 1.0}
+
+
+class TestIntegritySchema:
+    def _check(self, lines):
+        from scripts.check_metrics_schema import check_integrity_lines
+        return check_integrity_lines(lines)
+
+    def test_valid_stream(self):
+        assert self._check(_lines([_CHECK_EV, _VOTE_EV,
+                                   _REPAIR_EV])) == []
+
+    def test_no_majority_vote_nullable_source(self):
+        ev = dict(_VOTE_EV, action="rewind", source_rank=None,
+                  majority_fp=None, minority=[])
+        assert self._check(_lines([ev])) == []
+
+    def test_unknown_kind_rejected(self):
+        errs = self._check(_lines([dict(_CHECK_EV,
+                                        kind="integrity_meow")]))
+        assert errs and "kind" in errs[0]
+
+    def test_missing_required_key_rejected(self):
+        ev = dict(_VOTE_EV)
+        del ev["minority"]
+        assert any("minority" in e for e in self._check(_lines([ev])))
+
+    def test_bad_action_rejected(self):
+        assert self._check(_lines([dict(_VOTE_EV, action="reboot")]))
+        assert self._check(_lines([dict(_REPAIR_EV, action="rewind",
+                                        verified=False)]))
+
+    def test_negative_minority_rank_rejected(self):
+        assert self._check(_lines([dict(_VOTE_EV, minority=[-1])]))
+
+    def test_nonbool_verified_rejected(self):
+        assert self._check(_lines([dict(_REPAIR_EV, verified=1)]))
+
+    def test_action_verified_contradiction_rejected(self):
+        assert self._check(_lines([dict(_REPAIR_EV, action="repair",
+                                        verified=False)]))
+
+    def test_null_step_rejected(self):
+        assert self._check(_lines([dict(_CHECK_EV, step=None)]))
+
+    def test_healed_flag_validates(self):
+        assert self._check(_lines([dict(_CHECK_EV, healed=True)])) == []
+        assert self._check(_lines([dict(_CHECK_EV, healed="yes")]))
+
+    def test_post_resize_null_check_step_validates(self):
+        """The elastic-resume sentinel: a healed first poll after
+        integrity_resize has no check under THIS electorate —
+        check_step must be null on the wire, and the validator must
+        accept exactly that shape (the library's own emission)."""
+        ev = dict(_CHECK_EV, check_step=None, healed=True)
+        assert self._check(_lines([ev])) == []
+        assert self._check(_lines([dict(_CHECK_EV, check_step=-1)]))
+
+    def test_guard_classes_enum_grew(self):
+        from scripts.check_metrics_schema import GUARD_CLASSES
+        assert "replica_divergence" in GUARD_CLASSES
+
+    def test_logger_channel_round_trip(self, tmp_path):
+        from apex_tpu import monitor
+        out = tmp_path / "integrity.jsonl"
+        logger = monitor.MetricsLogger(
+            sinks=[], integrity_sink=monitor.JSONLSink(str(out)))
+        logger.record_integrity(dict(_VOTE_EV))
+        logger.close()
+        with open(out) as f:
+            assert self._check(f) == []
+
+
+# --- the amp hook -------------------------------------------------------------
+
+class TestAmpIntegration:
+    def test_amp_step_threads_replica_ok(self):
+        """``amp_opt.step(guard=(gs, cfg, replica_ok))`` — the 3-tuple
+        feeds the integrity verdict into amp's unified observe+commit:
+        replica_ok=False vetoes the commit and counts the class; the
+        legacy 2-tuple stays untouched."""
+        import optax
+        from apex_tpu import amp
+        params = {"w": jnp.ones((4, 2), jnp.float32)}
+        cfg = guard.GuardConfig(window=8, min_history=2)
+        amp_opt, state = amp.initialize(params, optax.sgd(0.1), "O2",
+                                        half_dtype=jnp.bfloat16)
+
+        def lf(mp):
+            return jnp.mean(jnp.square(mp["w"]))
+
+        gs = guard.guard_init(cfg)
+        s2, _loss, committed, gs = amp_opt.step(
+            state, lf, guard=(gs, cfg, jnp.bool_(False)))
+        assert not bool(committed)
+        assert int(gs.replica_divergence_count) == 1
+        np.testing.assert_array_equal(np.asarray(s2.params["w"]),
+                                      np.asarray(state.params["w"]))
+        s3, _loss, committed, gs = amp_opt.step(
+            state, lf, guard=(gs, cfg))          # legacy 2-tuple
+        assert bool(committed)
+        assert int(gs.replica_divergence_count) == 1
+        assert not np.array_equal(np.asarray(s3.params["w"]),
+                                  np.asarray(state.params["w"]))
+
+
+# --- the compile-check case ---------------------------------------------------
+
+class TestCompileCheck:
+    def test_integrity_case_runs_green(self):
+        from apex_tpu.ops import compile_check as cc
+        assert cc.run(pattern="integrity")
