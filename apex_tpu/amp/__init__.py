@@ -27,6 +27,13 @@ from apex_tpu.amp.api import (
     float_function,
     promote_function,
 )
+from apex_tpu.amp.scale_history import (
+    ScaleHistoryConfig,
+    ScaleHistoryState,
+    scale_history_init,
+    scale_history_update,
+    scale_update_events,
+)
 from apex_tpu.amp.interceptor import auto_cast, make_interceptor
 from apex_tpu.amp.opt import OptimWrapper
 from apex_tpu.amp.lists import (
@@ -43,6 +50,8 @@ __all__ = [
     "LossScaleConfig", "LossScaleState", "loss_scale_init",
     "loss_scale_update", "scale_loss", "select_if_finite", "unscale_grads",
     "unscale_grads_with_stashed", "value_and_scaled_grad",
+    "ScaleHistoryConfig", "ScaleHistoryState", "scale_history_init",
+    "scale_history_update", "scale_update_events",
     "Amp", "AmpState", "initialize",
     "half_function", "float_function", "promote_function",
     "auto_cast", "make_interceptor", "OptimWrapper",

@@ -276,9 +276,22 @@ class Amp:
         return state._replace(step=new_step, params=committed_params,
                               opt_state=committed_opt, metrics=metrics)
 
+    def numerics_sites(self, params) -> tuple:
+        """The stable site tuple :meth:`step`'s ``numerics=`` hook
+        observes for ``params``-shaped state: one site per leaf for
+        each of the three amp observation points — ``amp/cast`` (the
+        model-dtype forward copy, the tensors an fp8 rollout would
+        narrow first), ``amp/grads`` (the unscaled fp32 grads) and
+        ``amp/update`` (the committed optimizer delta, with the weight
+        itself as the update-to-weight companion). Feed it to
+        :func:`apex_tpu.monitor.numerics.numerics_init`."""
+        from apex_tpu.monitor.numerics import site_names
+        return site_names({"amp/cast": params, "amp/grads": params,
+                           "amp/update": params})
+
     def step(self, state: AmpState, loss_fn: Callable, *args,
              loss_id: int = 0, has_aux: bool = False, guard=None,
-             **kwargs):
+             numerics=None, **kwargs):
         """backward + apply in one call. Returns (state', out, finite).
 
         ``guard=(guard_state, guard_config)`` threads an
@@ -303,33 +316,74 @@ class Amp:
         return grows a fourth element:
         ``(state', out, committed, guard_state')``. All of it is
         in-graph arithmetic riding the existing dispatch (the
-        ``guard/no-extra-dispatch`` compile-check case)."""
+        ``guard/no-extra-dispatch`` compile-check case).
+
+        ``numerics=(numerics_state, numerics_config)`` additionally
+        folds the numerics observatory
+        (:func:`apex_tpu.monitor.numerics.numerics_observe`) over the
+        three amp observation points — the model-dtype cast copy
+        (``amp/cast``), the unscaled fp32 grads (``amp/grads``) and
+        the committed update delta with its update-to-weight ratio
+        (``amp/update``) — under the sites
+        :meth:`numerics_sites` names. Observation is read-only: the
+        trajectory is bit-identical with it on or off at every opt
+        level (the parity sweep in tests/test_numerics.py), and the
+        return grows a FINAL element ``numerics_state'`` (after the
+        guard state, when both are threaded)."""
         out, grads, state, finite = self.backward(
             state, loss_fn, *args, loss_id=loss_id, has_aux=has_aux, **kwargs)
+        old_params = state.params
+        # the numerics fold observes the UNSCALED fp32 grads — the
+        # guard's lr_scale damping below is a response, not a property
+        # of the gradients, and telemetry must read the same with or
+        # without a guard threaded
+        obs_grads = grads
         if guard is None:
-            state = self.apply_gradients(state, grads, finite)
-            return state, out, finite
-        from apex_tpu.guard import guard_observe, guard_ok
-        if len(guard) == 3:
-            gs, gcfg, replica_ok = guard
+            new_state = self.apply_gradients(state, grads, finite)
+            ret = (new_state, out, finite)
         else:
-            gs, gcfg = guard
-            replica_ok = None
-        loss_val = out[0] if has_aux else out
-        true_norm = global_norm(grads)
-        gs = guard_observe(gs, gcfg, loss=loss_val,
-                           grad_norm=true_norm,
-                           params=state.params, grads_finite=finite,
-                           replica_ok=replica_ok)
-        grads = jax.tree_util.tree_map(
-            lambda g: g * gs.lr_scale.astype(g.dtype)
-            if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating) else g,
-            grads)
-        committed = jnp.logical_and(jnp.asarray(finite, jnp.bool_),
-                                    guard_ok(gs, gcfg))
-        state = self.apply_gradients(state, grads, committed,
-                                     metrics_grad_norm=true_norm)
-        return state, out, committed, gs
+            from apex_tpu.guard import guard_observe, guard_ok
+            if len(guard) == 3:
+                gs, gcfg, replica_ok = guard
+            else:
+                gs, gcfg = guard
+                replica_ok = None
+            loss_val = out[0] if has_aux else out
+            true_norm = global_norm(grads)
+            gs = guard_observe(gs, gcfg, loss=loss_val,
+                               grad_norm=true_norm,
+                               params=state.params, grads_finite=finite,
+                               replica_ok=replica_ok)
+            grads = jax.tree_util.tree_map(
+                lambda g: g * gs.lr_scale.astype(g.dtype)
+                if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)
+                else g, grads)
+            committed = jnp.logical_and(jnp.asarray(finite, jnp.bool_),
+                                        guard_ok(gs, gcfg))
+            new_state = self.apply_gradients(state, grads, committed,
+                                             metrics_grad_norm=true_norm)
+            ret = (new_state, out, committed, gs)
+        if numerics is None:
+            return ret
+        from apex_tpu.monitor.numerics import numerics_observe
+        ns, ncfg = numerics
+
+        def _trees():
+            # built INSIDE the fold's lax.cond branch (numerics_observe
+            # calls the thunk there), so the cast copy and the fp32
+            # update delta cost nothing on off-steps — the off-step
+            # no-fold contract covers the observation inputs too
+            update = jax.tree_util.tree_map(
+                lambda n, o: (n.astype(jnp.float32)
+                              - o.astype(jnp.float32))
+                if jnp.issubdtype(jnp.asarray(n).dtype, jnp.floating)
+                else n, new_state.params, old_params)
+            return {"amp/cast": self.policy.cast_params(old_params),
+                    "amp/grads": obs_grads, "amp/update": update}
+
+        ns = numerics_observe(ns, ncfg, _trees,
+                              weights={"amp/update": old_params})
+        return ret + (ns,)
 
     # -- memory accounting ---------------------------------------------------
 

@@ -1,0 +1,245 @@
+"""Per-tensor delayed scaling: the loss scaler generalized per site.
+
+ROADMAP item 5 names this verbatim: "delayed/dynamic scaling as an
+AmpState extension (the loss-scale machinery generalizes to per-tensor
+scale histories)". The dynamic loss scaler
+(:mod:`apex_tpu.amp.scaler`) keeps ONE scalar for the whole backward;
+fp8 needs one scale per cast site, derived from that site's *measured*
+amax history rather than from overflow trial-and-error — the standard
+delayed-scaling recipe. This module lands the state machine as pure
+state machinery (no fp8 kernels yet — those are item 5's second half):
+
+- :class:`ScaleHistoryState` carries, per site, a rolling **amax
+  window** (``f32[S, window]``), the current scale, a growth tracker
+  and a cumulative overflow counter — a pytree next to
+  ``LossScaleState`` in the train state: checkpointable, donate-able,
+  ``lax.scan``-carryable;
+- :func:`scale_history_update` folds one step's per-site amax (the
+  :class:`~apex_tpu.monitor.numerics.NumericsState` ``amax`` row, or a
+  directly-computed ``jnp.max(jnp.abs(x))``) and derives the
+  **next-step scale**:
+
+  ``scale = 2 ** floor(log2(fmt.max_finite / (margin · max(window))))``
+
+  clamped to ``[min_scale, max_scale]`` — always a power of two, so
+  scaling is exact (exponent shift, zero rounding);
+- the **growth/backoff semantics are the loss scaler's**
+  (`scaler.py` parity): a nonfinite amax this step = an overflow event
+  — ``scale *= backoff_factor`` immediately, tracker reset, the window
+  slot records the previous window max (a poisoned measurement must
+  not enter the history); upward moves are rate-limited to
+  ``growth_factor`` per ``growth_interval`` consecutive clean steps,
+  so a transiently small window cannot leap the scale and saturate on
+  the next real activation.
+
+The update is deterministic arithmetic: a synthetic amax ramp tracks a
+pure-numpy oracle **exactly** (``scripts/numerics_audit.py --cpu8``
+claim (c) asserts it; tests/test_numerics.py carries the unit twin).
+Scale *changes* are reported as ``kind="scale_update"`` events
+(:func:`scale_update_events`) on the numerics channel.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.monitor.numerics import FORMAT_TABLE
+
+__all__ = ["ScaleHistoryConfig", "ScaleHistoryState",
+           "scale_history_init", "scale_history_update",
+           "scale_update_events"]
+
+
+class ScaleHistoryConfig(NamedTuple):
+    """Static per-tensor delayed-scaling configuration (hashable; safe
+    to close over in jit). Defaults mirror
+    :class:`~apex_tpu.amp.scaler.LossScaleConfig` where the semantics
+    are shared (growth ×2, backoff ×0.5)."""
+
+    fmt: str = "fp8_e4m3"          #: target format (FORMAT_TABLE key)
+    window: int = 16               #: amax history length in updates
+    margin: float = 2.0            #: headroom divisor under max_finite
+    growth_factor: float = 2.0     #: max upward scale move per interval
+    backoff_factor: float = 0.5    #: overflow response (shared w/ loss
+                                   #: scaler)
+    growth_interval: int = 1       #: clean updates per upward move
+    min_scale: float = 2.0 ** -64
+    max_scale: float = 2.0 ** 64
+
+
+class ScaleHistoryState(NamedTuple):
+    """Per-site delayed-scaling state — ``[S]``-row device arrays, one
+    row per site in the companion :func:`site_names
+    <apex_tpu.monitor.numerics.site_names>` tuple's order."""
+
+    amax_history: jax.Array    # f32[S, window] rolling amax window
+    cursor: jax.Array          # i32 next window slot (shared; updates
+                               #   are lockstep across sites)
+    scale: jax.Array           # f32[S] the NEXT step's scale
+    growth_tracker: jax.Array  # i32[S] consecutive clean updates
+    overflow_count: jax.Array  # i32[S] cumulative nonfinite-amax events
+    step: jax.Array            # i32 updates folded
+
+
+def scale_history_init(cfg: ScaleHistoryConfig = ScaleHistoryConfig(),
+                       *, n_sites: int) -> ScaleHistoryState:
+    """Fresh per-site scale state — thread through the step like
+    ``LossScaleState``. Scales start at 1.0 and converge to the
+    window-derived value within ``window`` updates (the delayed-
+    scaling warmup; docs/numerics.md#delayed-scaling)."""
+    if cfg.fmt not in FORMAT_TABLE:
+        raise ValueError(f"ScaleHistoryConfig.fmt must be one of "
+                         f"{tuple(FORMAT_TABLE)}, got {cfg.fmt!r}")
+    if int(cfg.window) < 1:
+        raise ValueError(f"window must be >= 1, got {cfg.window}")
+    if int(n_sites) < 1:
+        raise ValueError(f"n_sites must be >= 1, got {n_sites}")
+    if not 0.0 < float(cfg.backoff_factor) < 1.0:
+        raise ValueError("backoff_factor must be in (0, 1)")
+    if float(cfg.growth_factor) < 1.0:
+        raise ValueError("growth_factor must be >= 1")
+    import math as _math
+    for name in ("growth_factor", "backoff_factor", "min_scale",
+                 "max_scale"):
+        v = float(getattr(cfg, name))
+        if not (v > 0 and _math.frexp(v)[0] == 0.5):
+            # every factor the scale is ever multiplied or clipped by
+            # must itself be a power of two, or the "scaling is an
+            # exact exponent shift" invariant (and the schema's
+            # power-of-two-gauge claim) silently breaks on the first
+            # backoff
+            raise ValueError(f"ScaleHistoryConfig.{name} must be a "
+                             f"power of two — scales stay exact "
+                             f"exponent shifts — got {v}")
+    s = int(n_sites)
+    return ScaleHistoryState(
+        amax_history=jnp.zeros((s, int(cfg.window)), jnp.float32),
+        cursor=jnp.int32(0),
+        scale=jnp.ones((s,), jnp.float32),
+        growth_tracker=jnp.zeros((s,), jnp.int32),
+        overflow_count=jnp.zeros((s,), jnp.int32),
+        step=jnp.int32(0))
+
+
+def _pow2_floor(x: jax.Array) -> jax.Array:
+    """2**floor(log2(x)) elementwise for positive finite x — exact
+    power-of-two quantization of the derived scale. Derived from the
+    float's own exponent field (``frexp``: x = m·2^e, m ∈ [0.5, 1), so
+    floor(log2 x) = e−1) and rebuilt with ``ldexp`` — bit-exact, where
+    an ``exp2(floor(log2 x))`` chain rounds through the transcendental
+    lowering (observed: 131072.06 on the CPU backend)."""
+    _m, e = jnp.frexp(x)
+    return jnp.ldexp(jnp.ones_like(x), e - 1)
+
+
+def scale_history_update(sh: ScaleHistoryState,
+                         cfg: ScaleHistoryConfig,
+                         amax: jax.Array) -> ScaleHistoryState:
+    """Fold one step's per-site amax (``f32[S]``, the measured
+    ``max|x|`` of each site's tensor at its CURRENT precision — from
+    the numerics observatory use
+    :func:`apex_tpu.monitor.numerics.scale_amax`, NOT ``ns.amax``:
+    the state's amax is the finite max by design and alone never
+    carries the nonfinite overflow signal the backoff keys on) and
+    derive the next-step scales. Pure ``jnp`` — rides the existing
+    dispatch (the ``numerics/no-extra-dispatch`` compile-check case
+    drives it inside the instrumented step).
+
+    Semantics per site, in loss-scaler terms (`scaler.py` parity):
+
+    - **overflow** (amax nonfinite): ``scale *= backoff_factor``
+      (clamped at ``min_scale``), tracker reset, the window records
+      the previous window max instead of the poisoned measurement;
+    - **clean**: the window records amax; the window-derived target
+      ``2**floor(log2(max_finite / (margin · window_max)))`` applies
+      immediately when it moves the scale DOWN (saturation danger is
+      never rate-limited), and upward only after ``growth_interval``
+      consecutive clean updates and by at most ``growth_factor`` per
+      update (then the tracker resets) — growth interval 1 with a
+      large factor reproduces plain delayed scaling.
+    """
+    amax = jnp.asarray(amax, jnp.float32)
+    if amax.shape != sh.scale.shape:
+        raise ValueError(f"amax shape {amax.shape} != n_sites "
+                         f"{sh.scale.shape}")
+    fmt = FORMAT_TABLE[cfg.fmt]
+    finite = jnp.isfinite(amax)
+    prev_max = jnp.max(sh.amax_history, axis=1)
+    recorded = jnp.where(finite, amax, prev_max)
+    hist = sh.amax_history.at[:, sh.cursor % cfg.window].set(recorded)
+    window_max = jnp.max(hist, axis=1)
+
+    # the delayed-scaling target from the measured window
+    target = jnp.where(
+        window_max > 0,
+        _pow2_floor(fmt.max_finite / (cfg.margin * window_max)),
+        sh.scale)
+    target = jnp.clip(target, cfg.min_scale, cfg.max_scale)
+
+    tracker = jnp.where(finite, sh.growth_tracker + 1,
+                        jnp.int32(0))
+    may_grow = tracker >= cfg.growth_interval
+    grown = jnp.minimum(target,
+                        jnp.minimum(sh.scale * cfg.growth_factor,
+                                    cfg.max_scale))
+    clean_scale = jnp.where(target < sh.scale, target,
+                            jnp.where(may_grow, grown, sh.scale))
+    backed_off = jnp.maximum(sh.scale * cfg.backoff_factor,
+                             cfg.min_scale)
+    new_scale = jnp.where(finite, clean_scale,
+                          backed_off).astype(jnp.float32)
+    new_tracker = jnp.where(
+        finite,
+        jnp.where(jnp.logical_and(may_grow, grown > sh.scale),
+                  jnp.int32(0), tracker),
+        jnp.int32(0)).astype(jnp.int32)
+    return ScaleHistoryState(
+        amax_history=hist,
+        cursor=(sh.cursor + 1) % jnp.int32(cfg.window),
+        scale=new_scale,
+        growth_tracker=new_tracker,
+        overflow_count=(sh.overflow_count
+                        + jnp.where(finite, 0, 1).astype(jnp.int32)),
+        step=sh.step + 1)
+
+
+def scale_update_events(prev: ScaleHistoryState,
+                        new: ScaleHistoryState,
+                        sites: Sequence[str], *,
+                        rank: int = 0,
+                        include_holds: bool = False) -> List[Dict]:
+    """Host-side diff of two consecutive states into
+    ``kind="scale_update"`` events — one per site whose scale MOVED
+    (action ``grow``/``backoff``; ``include_holds`` adds ``hold`` rows
+    for the rest). Fetches both states once; wire through
+    ``MetricsLogger(numerics_sink=…)``. Under a donating step, fetch
+    ``prev`` (``jax.device_get``) BEFORE the next dispatch — donation
+    invalidates its buffers, the same hazard
+    ``MetricsLogger(donation_safe=)`` covers for metrics."""
+    import numpy as np
+    p, n = jax.device_get((prev, new))
+    ps, nsc = np.asarray(p.scale), np.asarray(n.scale)
+    over = np.asarray(n.overflow_count) - np.asarray(p.overflow_count)
+    amax = np.asarray(
+        n.amax_history[:, int(np.asarray(p.cursor)) % p.amax_history.shape[1]])
+    step = int(np.asarray(n.step))
+    events: List[Dict] = []
+    for i, site in enumerate(sites):
+        if nsc[i] > ps[i]:
+            action = "grow"
+        elif nsc[i] < ps[i]:
+            action = "backoff" if over[i] > 0 else "shrink"
+        else:
+            if not include_holds:
+                continue
+            action = "hold"
+        a: Optional[float] = float(amax[i])
+        events.append({"kind": "scale_update", "rank": rank,
+                       "step": step, "site": site, "action": action,
+                       "scale": float(nsc[i]),
+                       "prev_scale": float(ps[i]),
+                       "amax": a if np.isfinite(a) else None})
+    return events

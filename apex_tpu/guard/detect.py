@@ -38,6 +38,16 @@ Design rules (the :mod:`apex_tpu.monitor` zero-extra-dispatch pattern):
 Escalation beyond skip/backoff (rewind to a checkpoint, hand-off to the
 exit-75 path) is inherently host-side — see
 :class:`apex_tpu.guard.GuardPolicy`.
+
+Forensic cross-link: the nonfinite probes here are *tree-level* — they
+answer "did anything go nonfinite" cheaply enough to run every step and
+veto the commit. The numerics observatory
+(:mod:`apex_tpu.monitor.numerics`) carries the per-*site* complement:
+``nonfinite_frac`` per tracked tensor, with
+:func:`apex_tpu.monitor.numerics.nonfinite_sites` naming WHICH tensor
+(and what fraction) after the guard has already stopped the damage —
+the same that-vs-where split as integrity's pmin/pmax compare vs its
+gathered per-replica fingerprints (docs/resilience.md, docs/numerics.md).
 """
 
 from __future__ import annotations

@@ -24,7 +24,14 @@ Plus the runtime performance observatory (docs/monitoring.md#goodput):
 - :mod:`~apex_tpu.monitor.linkbench` — α–β link calibration sweeping
   collectives per mesh axis into a MEASURED
   :class:`apex_tpu.lint.mesh_model.MeshModel`
-  (``scripts/link_probe.py``).
+  (``scripts/link_probe.py``);
+- :mod:`~apex_tpu.monitor.numerics` — the numerics observatory
+  (docs/numerics.md): per-tensor dynamic-range telemetry
+  (:class:`NumericsState` carried through the step like GuardState),
+  a format table pricing fp32/bf16/fp16/fp8 exponent coverage against
+  the measured histograms, and :func:`precision_report` /
+  :func:`placement_advisor` — the fp8 candidate generator ROADMAP
+  items 2 and 5 consult (``scripts/numerics_audit.py``).
 """
 
 from apex_tpu.monitor.check import module_count_and_host_ops
@@ -39,15 +46,24 @@ from apex_tpu.monitor.goodput import (BUCKETS, GoodputLedger, StepLedger,
 from apex_tpu.monitor.linkbench import (LinkFit, LinkSample, calibrate,
                                         fit_alpha_beta, linkfit_events,
                                         sweep_axis)
-from apex_tpu.monitor.logger import MetricsLogger
+from apex_tpu.monitor.logger import CHANNELS, ChannelSpec, MetricsLogger
 from apex_tpu.monitor.metrics import (METRIC_FIELDS, Metrics, metrics_init,
                                       metrics_snapshot, metrics_to_dict)
+from apex_tpu.monitor.numerics import (FORMAT_LADDER, FORMAT_TABLE,
+                                       NumericsConfig, NumericsReport,
+                                       NumericsState, SiteVerdict,
+                                       numerics_init, numerics_observe,
+                                       placement_advisor,
+                                       precision_report, site_names)
 from apex_tpu.monitor.sinks import CSVSink, JSONLSink, Sink, StdoutSink
 
 __all__ = [
     "Metrics", "metrics_init", "metrics_to_dict", "metrics_snapshot",
     "METRIC_FIELDS",
-    "MetricsLogger",
+    "MetricsLogger", "CHANNELS", "ChannelSpec",
+    "FORMAT_TABLE", "FORMAT_LADDER", "NumericsConfig", "NumericsState",
+    "NumericsReport", "SiteVerdict", "numerics_init", "numerics_observe",
+    "precision_report", "placement_advisor", "site_names",
     "Sink", "StdoutSink", "JSONLSink", "CSVSink",
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
     "collective_bytes_by_dtype", "collective_bytes_by_hop", "scope_hop",

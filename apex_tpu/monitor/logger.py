@@ -39,14 +39,130 @@ import atexit
 import collections
 import math
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 
 from apex_tpu.monitor.metrics import Metrics, metrics_to_dict
 from apex_tpu.monitor.sinks import Sink, StdoutSink
 
-__all__ = ["MetricsLogger"]
+__all__ = ["MetricsLogger", "ChannelSpec", "CHANNELS"]
+
+
+class ChannelSpec(NamedTuple):
+    """One declarative row of the event-channel registry: adding a
+    channel is adding a row here (ctor kwarg ``{name}_sink=``, the
+    ``record_*`` method, close handling and non-finite nulling all
+    derive from it) — not another 30-line clone of the previous
+    channel's plumbing."""
+
+    name: str                 #: channel name; ctor kwarg = f"{name}_sink"
+    kinds: Tuple[str, ...]    #: event kinds on this channel (the
+                              #: ``check_metrics_schema.py --kind`` enum)
+    method: str               #: the logger's record-method name
+    null_nonfinite: bool      #: null Infinity/NaN before emit (the
+                              #: strict-JSON contract); channels whose
+                              #: emitters never produce non-finite
+                              #: numbers skip the walk
+    nested_null: bool = False  #: also null one level of nested dicts
+                               #: (goodput's buckets_ms)
+    why_unbuffered: str = ""  #: one line: why this channel must never
+                              #: buffer (every record_* channel is
+                              #: unbuffered; the buffered path is the
+                              #: Metrics pytree via record()/flush())
+
+
+#: the event-channel registry. Every channel is UNBUFFERED (events are
+#: rare and forensic — a record that only landed at flush time could be
+#: lost to the very crash/escalation it documents); the per-channel
+#: ``why_unbuffered`` line carries the channel-specific version of that
+#: argument. Validate a channel's stream with
+#: ``check_metrics_schema.py --kind <name>`` (``trace`` events use
+#: ``--kind trace``; the registry rows and the validator's tables are
+#: kept in lockstep — scripts/check_metrics_schema.py names each
+#: emitter module).
+CHANNELS: Tuple[ChannelSpec, ...] = (
+    ChannelSpec("trace", ("span", "step", "crash", "watchdog"),
+                "record_event", False,
+                why_unbuffered="host-side span/step/crash events from "
+                "apex_tpu.trace; losing them to a crash would defeat "
+                "the point"),
+    ChannelSpec("memory", ("memory", "memory_report", "retrace",
+                           "compile"), "record_memory", True,
+                why_unbuffered="retrace warnings and allocator samples "
+                "are rare; an OOM dump must not wait on a flush"),
+    ChannelSpec("lint", ("lint_report", "lint_finding"),
+                "record_lint", False,
+                why_unbuffered="lint runs are rare AOT audits"),
+    ChannelSpec("ckpt", ("ckpt_save", "ckpt_restore",
+                         "ckpt_escalation"), "record_ckpt", True,
+                why_unbuffered="an escalation record buffered to flush "
+                "time would be lost to the very crash it documents"),
+    ChannelSpec("guard", ("guard_anomaly", "guard_action",
+                          "guard_rewind"), "record_guard", True,
+                why_unbuffered="a rewind record could be lost to the "
+                "escalation it precedes; a NaN-loss anomaly's z is "
+                "non-finite by construction"),
+    ChannelSpec("goodput", ("goodput", "straggler", "linkfit"),
+                "record_goodput", True, nested_null=True,
+                why_unbuffered="per-step attribution and straggler "
+                "warnings are forensic; a zero-wall warmup step has "
+                "no finite goodput fraction (nested buckets nulled)"),
+    ChannelSpec("roofline", ("roofline", "regress"),
+                "record_roofline", True,
+                why_unbuffered="roofline joins and sentinel verdicts "
+                "are rare AOT/offline audits"),
+    ChannelSpec("cluster", ("cluster_lease", "cluster_generation",
+                            "cluster_fence", "cluster_coord"),
+                "record_cluster", True,
+                why_unbuffered="a fence refusal usually precedes the "
+                "zombie exit it documents"),
+    ChannelSpec("integrity", ("integrity_check", "integrity_vote",
+                              "integrity_repair"), "record_integrity",
+                True,
+                why_unbuffered="a divergence vote could be lost to "
+                "the rewind/escalation it precedes"),
+    ChannelSpec("numerics", ("numerics_check", "scale_update",
+                             "precision_verdict"), "record_numerics",
+                True,
+                why_unbuffered="scale backoffs and precision verdicts "
+                "are rare and may immediately precede the overflow "
+                "skip they explain"),
+)
+
+def _null_nonfinite(rec: Dict, nested: bool) -> None:
+    """Null non-finite numbers in place (Infinity/NaN are not valid
+    strict JSON; the schema contract is finite-or-null — the *event*
+    behind a non-finite gauge is already counted elsewhere)."""
+    for k, v in rec.items():
+        if isinstance(v, float) and not math.isfinite(v):
+            rec[k] = None
+        elif nested and isinstance(v, dict):
+            rec[k] = {kk: (None if isinstance(vv, float)
+                           and not math.isfinite(vv) else vv)
+                      for kk, vv in v.items()}
+
+
+def _channel_method(spec: ChannelSpec):
+    def _record(self, event: Dict) -> None:
+        sink = getattr(self, f"{spec.name}_sink")
+        if sink is None or self._closed:
+            return
+        rec = dict(event)
+        if spec.null_nonfinite:
+            _null_nonfinite(rec, spec.nested_null)
+        sink.emit(rec)
+
+    _record.__name__ = spec.method
+    _record.__doc__ = (
+        f"Emit one {spec.name}-channel event (``kind`` in "
+        f"{spec.kinds}) — a plain-dict pass-through, no device "
+        f"access, NOTHING buffered: {spec.why_unbuffered}. "
+        + ("Non-finite numbers are nulled to keep the strict-JSON "
+           "contract. " if spec.null_nonfinite else "")
+        + f"Validate the stream with ``check_metrics_schema.py "
+        f"--kind {spec.name}``.")
+    return _record
 
 
 class MetricsLogger:
@@ -54,10 +170,16 @@ class MetricsLogger:
     registers itself with ``atexit``, so a crashed run never loses its
     buffered tail: ``__exit__`` flushes on exceptions too, and an
     un-``close()``d logger (hard ``sys.exit``, unhandled error above the
-    ``with``) is flushed at interpreter exit. ``trace_sink`` is the
-    trace-event channel — host-side span/step/crash events from
-    :mod:`apex_tpu.trace` pass straight through ``record_event`` to it,
-    never mixing with the metrics wire format.
+    ``with``) is flushed at interpreter exit.
+
+    Beyond the buffered metrics stream, the logger carries one
+    **unbuffered event channel per** :data:`CHANNELS` **row** — pass
+    ``{name}_sink=`` (``trace_sink=``, ``guard_sink=``, …,
+    ``numerics_sink=``) and feed events through the matching
+    ``record_*`` method; each channel's stream validates under
+    ``check_metrics_schema.py --kind {name}``. Events never mix with
+    the metrics wire format. Adding a channel is one registry row, not
+    another clone of this plumbing.
     """
 
     def __init__(self, sinks: Optional[Sequence[Sink]] = None, *,
@@ -65,74 +187,30 @@ class MetricsLogger:
                  peak_flops: Optional[float] = None,
                  flops_per_step: Optional[float] = None,
                  collective_bytes_per_step: Optional[int] = None,
-                 trace_sink: Optional[Sink] = None,
-                 memory_sink: Optional[Sink] = None,
-                 lint_sink: Optional[Sink] = None,
-                 ckpt_sink: Optional[Sink] = None,
-                 guard_sink: Optional[Sink] = None,
-                 goodput_sink: Optional[Sink] = None,
-                 roofline_sink: Optional[Sink] = None,
-                 cluster_sink: Optional[Sink] = None,
-                 integrity_sink: Optional[Sink] = None,
                  logical_collective_bytes: Optional[int] = None,
-                 donation_safe: bool = False):
+                 donation_safe: bool = False,
+                 **channel_sinks: Optional[Sink]):
         self.sinks: List[Sink] = (list(sinks) if sinks is not None
                                   else [StdoutSink()])
         self.flush_every = max(int(flush_every), 1)
         self.flops_per_step = flops_per_step
         self.collective_bytes_per_step = collective_bytes_per_step
-        self.trace_sink = trace_sink
-        #: the ``memory`` event channel (kind="memory"/"memory_report"/
-        #: "retrace"/"compile" events — validate with
-        #: ``check_metrics_schema.py --kind memory``)
-        self.memory_sink = memory_sink
+        # the event channels: one ``{name}_sink`` attribute + one
+        # ``record_*`` method per CHANNELS row (the registry is the
+        # single source of truth — docstrings, nulling policy and
+        # close() all derive from it)
+        valid = {f"{c.name}_sink" for c in CHANNELS}
+        unknown = set(channel_sinks) - valid
+        if unknown:
+            raise TypeError(
+                f"MetricsLogger got unknown channel sink(s) "
+                f"{sorted(unknown)}; known channels: {sorted(valid)}")
+        for spec in CHANNELS:
+            setattr(self, f"{spec.name}_sink",
+                    channel_sinks.get(f"{spec.name}_sink"))
         self.memory_report = None      # last attached prof.MemoryReport
-        #: the ``lint`` event channel (kind="lint_report"/"lint_finding"
-        #: events from apex_tpu.lint — validate with
-        #: ``check_metrics_schema.py --kind lint``)
-        self.lint_sink = lint_sink
         self.lint_report = None        # last attached lint.Report
-        #: the ``ckpt`` event channel (kind="ckpt_save"/"ckpt_restore"/
-        #: "ckpt_escalation" events from apex_tpu.ckpt — validate with
-        #: ``check_metrics_schema.py --kind ckpt``). Wire a
-        #: CheckpointManager with ``event_sink=logger.record_ckpt``.
-        self.ckpt_sink = ckpt_sink
-        #: the ``guard`` event channel (kind="guard_anomaly"/
-        #: "guard_action"/"guard_rewind" events from apex_tpu.guard —
-        #: validate with ``check_metrics_schema.py --kind guard``). Wire
-        #: a GuardPolicy with ``event_sink=logger.record_guard``.
-        self.guard_sink = guard_sink
-        #: the ``goodput`` event channel (kind="goodput"/"straggler"/
-        #: "linkfit" events from apex_tpu.monitor.goodput /
-        #: trace.straggler / monitor.linkbench — validate with
-        #: ``check_metrics_schema.py --kind goodput``). Wire a
-        #: GoodputLedger with ``ledger.subscribe(logger.record_goodput)``.
-        self.goodput_sink = goodput_sink
-        #: the ``roofline`` event channel (kind="roofline"/"regress"
-        #: events from apex_tpu.prof.roofline / prof.sentinel —
-        #: validate with ``check_metrics_schema.py --kind roofline``).
-        #: Attach a report with ``attach_roofline_report``; stream
-        #: sentinel verdicts with ``record_roofline``.
-        self.roofline_sink = roofline_sink
         self.roofline_report = None    # last attached RooflineReport
-        #: the ``cluster`` event channel (kind="cluster_lease"/
-        #: "cluster_generation"/"cluster_fence"/"cluster_coord" events
-        #: from apex_tpu.cluster — validate with
-        #: ``check_metrics_schema.py --kind cluster``). Wire a
-        #: ClusterMembership / RecoveryCoordinator with
-        #: ``event_sink=logger.record_cluster``. Unbuffered, like
-        #: record_ckpt: a fence refusal usually precedes the zombie's
-        #: exit, and the event must survive the crash it documents.
-        self.cluster_sink = cluster_sink
-        #: the ``integrity`` event channel (kind="integrity_check"/
-        #: "integrity_vote"/"integrity_repair" events from the
-        #: silent-divergence defense, apex_tpu.guard.integrity —
-        #: validate with ``check_metrics_schema.py --kind integrity``).
-        #: Wire a GuardPolicy with
-        #: ``integrity_sink=logger.record_integrity``. Unbuffered, like
-        #: record_guard: a divergence verdict is rare and forensic, and
-        #: it may immediately precede the escalation that documents it.
-        self.integrity_sink = integrity_sink
         #: the uncompressed payload one step SEMANTICALLY moves (e.g.
         #: ``4 * n_params`` for an fp32 grad sync) — enables the
         #: per-record ``wire_to_logical`` ratio, same contract as
@@ -288,35 +366,20 @@ class MetricsLogger:
             for sink in self.sinks:
                 sink.emit(rec)
 
-    # -- trace-event channel -------------------------------------------------
-
-    def record_event(self, event: Dict) -> None:
-        """Emit one host-side trace event (``kind="span"|"step"|...``)
-        through the trace-event channel — a plain-dict pass-through, no
-        device access, no buffering (events are rare and forensic;
-        losing them to a crash would defeat the point). Wire a Tracer
-        with ``tracer.subscribe(lambda st: logger.record_event(
-        st.to_event(rank)))`` to stream the step timeline live."""
-        if self.trace_sink is not None and not self._closed:
-            self.trace_sink.emit(dict(event))
-
-    # -- memory channel ------------------------------------------------------
-
-    def record_memory(self, event: Dict) -> None:
-        """Emit one memory/compile event (``kind="memory"|"memory_report"
-        |"retrace"|"compile"``) through the memory channel — plain-dict
-        pass-through like :meth:`record_event`. Wire a
-        :class:`apex_tpu.prof.CompileWatcher` with
-        ``watcher.subscribe(logger.record_memory)`` to stream retrace
-        warnings; non-finite numbers are nulled to keep the strict-JSON
-        contract."""
-        if self.memory_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-        self.memory_sink.emit(rec)
+    # -- event channels ------------------------------------------------------
+    # record_event / record_memory / record_lint / record_ckpt /
+    # record_guard / record_goodput / record_roofline / record_cluster /
+    # record_integrity / record_numerics are generated from the CHANNELS
+    # registry after the class body — one declarative row per channel,
+    # not one 30-line clone. Typical wirings (see each subsystem's
+    # docs): ``tracer.subscribe(lambda st: logger.record_event(
+    # st.to_event(rank)))``, ``CompileWatcher.subscribe(
+    # logger.record_memory)``, ``CheckpointManager(event_sink=
+    # logger.record_ckpt)``, ``GuardPolicy(event_sink=
+    # logger.record_guard, integrity_sink=logger.record_integrity)``,
+    # ``GoodputLedger.subscribe(logger.record_goodput)``,
+    # ``ClusterMembership(event_sink=logger.record_cluster)``, and the
+    # numerics observatory's host poll feeding ``record_numerics``.
 
     def sample_memory(self, step: Optional[int] = None, *,
                       device=None, **extra) -> Optional[Dict]:
@@ -357,16 +420,6 @@ class MetricsLogger:
             self.record_memory(report.to_event(rank=rank))
         return self
 
-    # -- lint channel --------------------------------------------------------
-
-    def record_lint(self, event: Dict) -> None:
-        """Emit one lint event (``kind="lint_report"|"lint_finding"``)
-        through the lint channel — plain-dict pass-through like
-        :meth:`record_event` (lint runs are rare AOT audits; nothing is
-        buffered)."""
-        if self.lint_sink is not None and not self._closed:
-            self.lint_sink.emit(dict(event))
-
     def attach_lint_report(self, report,
                            step: Optional[int] = None) -> "MetricsLogger":
         """Attach an :class:`apex_tpu.lint.Report`: emits its
@@ -378,124 +431,6 @@ class MetricsLogger:
             for ev in report.to_events(step=step):
                 self.record_lint(ev)
         return self
-
-    # -- ckpt channel --------------------------------------------------------
-
-    def record_ckpt(self, event: Dict) -> None:
-        """Emit one checkpoint event (``kind="ckpt_save"|"ckpt_restore"
-        |"ckpt_escalation"``) through the ckpt channel — plain-dict
-        pass-through like :meth:`record_event` (saves are rare and the
-        escalation path must never buffer: a record that only lands at
-        flush time would be lost to the very crash it documents).
-        Non-finite numbers are nulled to keep the strict-JSON
-        contract."""
-        if self.ckpt_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-        self.ckpt_sink.emit(rec)
-
-    # -- guard channel -------------------------------------------------------
-
-    def record_guard(self, event: Dict) -> None:
-        """Emit one guard event (``kind="guard_anomaly"|"guard_action"
-        |"guard_rewind"``) through the guard channel — plain-dict
-        pass-through like :meth:`record_ckpt` (interventions are rare
-        and forensic; nothing is buffered — a rewind record that only
-        landed at flush time could be lost to the very escalation it
-        precedes). Non-finite numbers are nulled to keep the
-        strict-JSON contract (a NaN-loss anomaly's z-score is NaN by
-        construction)."""
-        if self.guard_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-        self.guard_sink.emit(rec)
-
-    # -- goodput channel -----------------------------------------------------
-
-    def record_goodput(self, event: Dict) -> None:
-        """Emit one goodput-channel event (``kind="goodput"|"straggler"
-        |"linkfit"``) — plain-dict pass-through like
-        :meth:`record_guard` (per-step attribution and straggler
-        warnings are forensic; nothing is buffered). Non-finite
-        numbers are nulled to keep the strict-JSON contract (a
-        zero-wall warmup step has no finite goodput fraction). Wire a
-        :class:`apex_tpu.monitor.GoodputLedger` with
-        ``ledger.subscribe(logger.record_goodput)`` and a
-        :class:`apex_tpu.trace.StragglerWatch` with
-        ``event_sink=logger.record_goodput``."""
-        if self.goodput_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-            elif isinstance(v, dict):
-                rec[k] = {kk: (None if isinstance(vv, float)
-                               and not math.isfinite(vv) else vv)
-                          for kk, vv in v.items()}
-        self.goodput_sink.emit(rec)
-
-    # -- roofline channel ----------------------------------------------------
-
-    def record_roofline(self, event: Dict) -> None:
-        """Emit one roofline-channel event (``kind="roofline"|
-        "regress"``) — plain-dict pass-through like
-        :meth:`record_goodput` (roofline joins and sentinel verdicts
-        are rare AOT/offline audits; nothing is buffered). Non-finite
-        numbers are nulled to keep the strict-JSON contract."""
-        if self.roofline_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-        self.roofline_sink.emit(rec)
-
-    # -- cluster channel -----------------------------------------------------
-
-    def record_cluster(self, event: Dict) -> None:
-        """Emit one cluster-control-plane event (``kind=
-        "cluster_lease"|"cluster_generation"|"cluster_fence"|
-        "cluster_coord"``) — plain-dict pass-through like
-        :meth:`record_ckpt` (membership edges, generation bumps, fence
-        refusals and coordination rounds are rare and forensic;
-        NOTHING is buffered — a ``cluster_fence`` refusal that only
-        landed at flush time would be lost to the zombie exit it
-        precedes). Non-finite numbers are nulled to keep the
-        strict-JSON contract."""
-        if self.cluster_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-        self.cluster_sink.emit(rec)
-
-    # -- integrity channel ---------------------------------------------------
-
-    def record_integrity(self, event: Dict) -> None:
-        """Emit one integrity-channel event (``kind="integrity_check"
-        |"integrity_vote"|"integrity_repair"``) — plain-dict
-        pass-through like :meth:`record_guard` (divergence incidents
-        are rare and forensic; NOTHING is buffered — a vote that only
-        landed at flush time could be lost to the rewind/escalation it
-        precedes). Non-finite numbers are nulled to keep the
-        strict-JSON contract. Wire a
-        :class:`apex_tpu.guard.GuardPolicy` with
-        ``integrity_sink=logger.record_integrity``."""
-        if self.integrity_sink is None or self._closed:
-            return
-        rec = dict(event)
-        for k, v in rec.items():
-            if isinstance(v, float) and not math.isfinite(v):
-                rec[k] = None
-        self.integrity_sink.emit(rec)
 
     def attach_roofline_report(self, report,
                                step: Optional[int] = None,
@@ -521,24 +456,10 @@ class MetricsLogger:
         self.flush()
         for sink in self.sinks:
             sink.close()
-        if self.trace_sink is not None:
-            self.trace_sink.close()
-        if self.memory_sink is not None:
-            self.memory_sink.close()
-        if self.lint_sink is not None:
-            self.lint_sink.close()
-        if self.ckpt_sink is not None:
-            self.ckpt_sink.close()
-        if self.guard_sink is not None:
-            self.guard_sink.close()
-        if self.goodput_sink is not None:
-            self.goodput_sink.close()
-        if self.roofline_sink is not None:
-            self.roofline_sink.close()
-        if self.cluster_sink is not None:
-            self.cluster_sink.close()
-        if self.integrity_sink is not None:
-            self.integrity_sink.close()
+        for spec in CHANNELS:
+            sink = getattr(self, f"{spec.name}_sink")
+            if sink is not None:
+                sink.close()
         self._closed = True
         atexit.unregister(self._atexit_close)
 
@@ -555,3 +476,11 @@ class MetricsLogger:
         # flushes buffered rows on the exception path too — the tail of
         # a crashed run's metrics reaches the sinks before unwind
         self.close()
+
+
+# materialize one record method per registry row (record_event,
+# record_memory, ..., record_numerics) — the registry is the single
+# source of truth for channel names, nulling policy and docstrings
+for _spec in CHANNELS:
+    setattr(MetricsLogger, _spec.method, _channel_method(_spec))
+del _spec

@@ -1,0 +1,817 @@
+"""Per-tensor dynamic-range telemetry: the numerics observatory.
+
+ROADMAP item 5 (fp8 end-to-end) has guard rails — the anomaly guard
+catches a diverging trajectory, the sentinel a perf regression — but no
+*measurement* layer: nothing can say which tensors' measured exponent
+ranges actually fit e4m3/e5m2, so any fp8 rollout would be flying
+blind. This module is the measurement half, the PR-10 pattern (land the
+observatory, then spend it) applied to numerics:
+
+- **the fold** (:func:`numerics_observe`): every ``check_every`` steps
+  the jitted step folds, per tracked *site* (a stable apexlint-style
+  string like ``"amp/grads/['encoder']['w']"``), pure-``jnp`` bit-trick
+  statistics: amax/amin EMA windows, a bucketed **biased-exponent
+  histogram** (the f32 bit pattern's exponent field, ``bits >> 23 &
+  0xFF`` — no host ops, no data-dependent shapes), zero / nonfinite
+  fractions, and update-to-weight ratios for optimizer-update sites.
+  Off-steps take the empty ``lax.cond`` branch — no fold, no extra
+  dispatch (the ``numerics/no-extra-dispatch`` compile-check case pins
+  the host-polling half bit-identical). The result is a
+  :class:`NumericsState` pytree carried next to GuardState /
+  IntegrityState: checkpointable, donate-able, scan-carryable;
+- **the format table** (:data:`FORMAT_TABLE`): exponent range +
+  mantissa bits for fp32 / bf16 / fp16 / fp8-e4m3 / fp8-e5m2 (OCP
+  variants; provenance in docs/numerics.md). Because the histogram is
+  kept in *exponent space*, the host can price ANY target format — and
+  any power-of-two scale, which is just an index shift — against the
+  measured distribution without re-observing;
+- **the verdict** (:func:`precision_report`): the host joins measured
+  exponent coverage against the table into a machine-readable per-site
+  verdict list ({required_dtype, predicted underflow/saturation
+  fractions, recommended_scale}) — the fp8 candidate generator, shaped
+  like the roofline observatory's ``worst_gaps(k)``;
+- **the advisor** (:func:`placement_advisor`): joins the verdicts with
+  a :class:`~apex_tpu.prof.RooflineReport`'s new what-if dtype column
+  (``RooflineReport.what_if``) so candidate sites rank by *measured
+  perf headroom × numeric safety*, not by either alone.
+
+The per-tensor delayed-scaling state machine the verdicts' scales feed
+(amax window → next-step scale, the loss scaler's growth/backoff
+semantics generalized per site) is :mod:`apex_tpu.amp.scale_history`.
+
+Cadence is the knob (docs/numerics.md#cadence): ``check_every=1``
+observes every step — the histogram then covers the whole trajectory —
+at the cost of one fold per tracked tensor per step; a coarser cadence
+amortizes the fold but can miss a transient between checks (the scale
+machinery's backoff still catches a nonfinite amax at the next check).
+
+Events ride the **10th** MetricsLogger channel
+(``MetricsLogger(numerics_sink=…)``; ``kind="numerics_check" |
+"scale_update" | "precision_verdict"``; ``check_metrics_schema.py
+--kind numerics`` validates). The asserted CI audit is
+``scripts/numerics_audit.py --cpu8``. The guard's nonfinite probes
+(:mod:`apex_tpu.guard.detect`) say *that* something went nonfinite and
+veto the commit; :func:`nonfinite_sites` names *where* — the forensic
+cross-link docs/resilience.md describes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "FormatSpec", "FORMAT_TABLE", "FORMAT_LADDER", "HIST_BINS",
+    "NumericsConfig", "NumericsState", "SiteVerdict", "NumericsReport",
+    "site_names", "numerics_init", "numerics_observe", "finite_ok",
+    "scale_amax", "nonfinite_sites", "precision_report",
+    "placement_advisor",
+    "check_events", "stats_to_json", "stats_from_json",
+]
+
+#: biased-exponent histogram resolution: one bucket per f32 exponent
+#: value. Bucket 0 = exact zeros are EXCLUDED (tracked as zero_frac);
+#: nonzero subnormals land in bucket 0; bucket 255 (inf/nan) is
+#: excluded too (tracked as nonfinite_frac) — the histogram is the
+#: distribution of *finite nonzero* magnitudes.
+HIST_BINS = 256
+
+#: f32 exponent bias: bucket b holds magnitudes in [2^(b-127), 2^(b-126))
+_BIAS = 127
+
+
+class FormatSpec(NamedTuple):
+    """One target floating format's range, as the verdict machinery
+    prices it: ``min_exp``/``max_exp`` are the unbiased exponents of the
+    smallest normal and the largest finite binade; ``max_finite`` the
+    largest representable magnitude. Mantissa bits are carried for the
+    docs/advisor (rounding error ~2^-(m+1)); the range verdict itself is
+    exponent-space only."""
+
+    name: str
+    mantissa_bits: int
+    min_exp: int          # smallest normal binade: 2^min_exp
+    max_exp: int          # largest finite binade: max_finite in [2^max_exp, 2^(max_exp+1))
+    max_finite: float
+
+
+#: the dtype ladder, narrow → wide. e4m3 is the OCP "FN" variant (no
+#: inf, max 448); e5m2 is IEEE-like (max 57344); provenance and the
+#: half-bucket saturation approximation are documented in
+#: docs/numerics.md#formats.
+FORMAT_TABLE: Dict[str, FormatSpec] = {
+    "fp8_e4m3": FormatSpec("fp8_e4m3", 3, -6, 8, 448.0),
+    "fp8_e5m2": FormatSpec("fp8_e5m2", 2, -14, 15, 57344.0),
+    "fp16": FormatSpec("fp16", 10, -14, 15, 65504.0),
+    "bf16": FormatSpec("bf16", 7, -126, 127, 3.3895314e38),
+    "fp32": FormatSpec("fp32", 23, -126, 127, 3.4028235e38),
+}
+
+#: verdict search order (narrowest safe format wins)
+FORMAT_LADDER: Tuple[str, ...] = ("fp8_e4m3", "fp8_e5m2", "fp16",
+                                  "bf16", "fp32")
+
+#: jnp dtype name → FORMAT_TABLE key (the ``current_dtype`` join)
+_DTYPE_TO_FORMAT = {
+    "float32": "fp32", "f32": "fp32", "fp32": "fp32",
+    "bfloat16": "bf16", "bf16": "bf16",
+    "float16": "fp16", "f16": "fp16", "fp16": "fp16",
+    "float8_e4m3fn": "fp8_e4m3", "f8e4m3fn": "fp8_e4m3",
+    "f8e4m3": "fp8_e4m3", "fp8_e4m3": "fp8_e4m3",
+    "float8_e5m2": "fp8_e5m2", "f8e5m2": "fp8_e5m2",
+    "fp8_e5m2": "fp8_e5m2",
+}
+
+
+def format_of_dtype(dtype) -> Optional[str]:
+    """FORMAT_TABLE key for a jnp/HLO dtype name, or None when the
+    dtype has no entry (ints, f64, …)."""
+    return _DTYPE_TO_FORMAT.get(str(jnp.dtype(dtype).name)
+                                if not isinstance(dtype, str) else dtype)
+
+
+class NumericsConfig(NamedTuple):
+    """Static observatory configuration (hashable; safe to close over
+    in jit)."""
+
+    check_every: int = 1   #: fold cadence in steps; 1 = every step
+    ema: float = 0.9       #: EMA decay for the windows (first check
+                           #: seeds the window — no zero-bias warmup)
+
+
+class NumericsState(NamedTuple):
+    """The in-graph numeric-health monitor: ``[n_sites]``-shaped device
+    arrays carried through the jitted step next to GuardState —
+    checkpointable, donate-able, ``lax.scan``-carryable. Site *names*
+    are static strings and live with the host (:func:`site_names`);
+    row ``i`` of every array is site ``i`` in that tuple's order.
+    """
+
+    step: jax.Array           # i32 observed (attempted) steps
+    check_count: jax.Array    # i32 cumulative folds executed
+    amax: jax.Array           # f32[S] last-check max |x| (finite)
+    amax_ema: jax.Array       # f32[S] EMA of amax
+    amin: jax.Array           # f32[S] last-check min nonzero |x|
+    amin_ema: jax.Array       # f32[S] EMA of amin
+    exp_hist: jax.Array       # f32[S, HIST_BINS] EMA'd normalized
+                              #   biased-exponent histogram (finite
+                              #   nonzero elements only)
+    zero_frac: jax.Array      # f32[S] last-check exact-zero fraction
+    nonfinite_frac: jax.Array  # f32[S] last-check inf/nan fraction
+    uw_ratio: jax.Array       # f32[S] EMA update/weight norm ratio;
+                              #   -1.0 = site has no weight companion
+    last_check_step: jax.Array  # i32 step of the last executed fold
+
+
+def site_names(trees: Dict[str, Any]) -> Tuple[str, ...]:
+    """The stable site tuple for a dict of (prefix → pytree): one site
+    per leaf, named ``"{prefix}/{keystr}"`` — the apexlint-style
+    fingerprint identity the state's rows, the events and the verdicts
+    all key on. Prefixes iterate sorted, leaves in ``tree_flatten``
+    order, so the mapping is reproducible across processes and runs.
+    Use the SAME dict structure in :func:`numerics_observe`."""
+    names: List[str] = []
+    for prefix in sorted(trees):
+        leaves = jax.tree_util.tree_leaves_with_path(trees[prefix])
+        for path, _leaf in leaves:
+            names.append(f"{prefix}/{jax.tree_util.keystr(path)}"
+                         if path else prefix)
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate numerics sites: {names}")
+    return tuple(names)
+
+
+def numerics_init(cfg: NumericsConfig = NumericsConfig(), *,
+                  sites: Sequence[str]) -> NumericsState:
+    """Fresh numerics state for a static site tuple (from
+    :func:`site_names`) — thread through the step like GuardState."""
+    if int(cfg.check_every) < 1:
+        raise ValueError(f"NumericsConfig.check_every must be >= 1, "
+                         f"got {cfg.check_every}")
+    if not 0.0 < float(cfg.ema) < 1.0:
+        raise ValueError(f"NumericsConfig.ema must be in (0, 1), "
+                         f"got {cfg.ema}")
+    s = len(tuple(sites))
+    if s < 1:
+        raise ValueError("numerics_init needs at least one site")
+    z = jnp.int32(0)
+    zs = jnp.zeros((s,), jnp.float32)
+    return NumericsState(
+        step=z, check_count=z,
+        amax=zs, amax_ema=zs, amin=zs, amin_ema=zs,
+        exp_hist=jnp.zeros((s, HIST_BINS), jnp.float32),
+        zero_frac=zs, nonfinite_frac=zs,
+        uw_ratio=jnp.full((s,), -1.0, jnp.float32),
+        last_check_step=jnp.int32(-1))
+
+
+def _leaf_stats(x: jax.Array):
+    """One leaf's (amax, amin_nonzero, normalized exponent histogram,
+    zero_frac, nonfinite_frac) — pure-jnp bit tricks: the f32 bit
+    pattern's exponent field buckets every finite nonzero element, a
+    scatter-add builds the histogram, no host ops and no
+    data-dependent shapes."""
+    xf = jnp.asarray(x).astype(jnp.float32).reshape(-1)
+    n = xf.size
+    if n == 0:
+        return (jnp.float32(0), jnp.float32(0),
+                jnp.zeros((HIST_BINS,), jnp.float32),
+                jnp.float32(0), jnp.float32(0))
+    ax = jnp.abs(xf)
+    finite = jnp.isfinite(xf)
+    nz = jnp.logical_and(finite, ax > 0)
+    amax = jnp.max(jnp.where(finite, ax, 0.0))
+    amin = jnp.min(jnp.where(nz, ax, jnp.inf))
+    amin = jnp.where(jnp.isfinite(amin), amin, 0.0)  # all-zero leaf
+    bits = lax.bitcast_convert_type(xf, jnp.uint32)
+    be = ((bits >> 23) & jnp.uint32(0xFF)).astype(jnp.int32)
+    hist = jnp.zeros((HIST_BINS,), jnp.float32).at[be].add(
+        jnp.where(nz, 1.0, 0.0))
+    nz_count = jnp.sum(nz.astype(jnp.float32))
+    hist = hist / jnp.maximum(nz_count, 1.0)
+    inv_n = jnp.float32(1.0 / n)
+    zero_frac = jnp.sum(jnp.logical_and(
+        finite, ax == 0).astype(jnp.float32)) * inv_n
+    nonfinite_frac = jnp.sum(
+        jnp.logical_not(finite).astype(jnp.float32)) * inv_n
+    return amax, amin, hist, zero_frac, nonfinite_frac
+
+
+def numerics_observe(ns: NumericsState, cfg: NumericsConfig,
+                     trees, *,
+                     weights: Optional[Dict[str, Any]] = None
+                     ) -> NumericsState:
+    """Observe one step: fold per-site statistics every
+    ``cfg.check_every`` steps, advance counters. ``trees`` must carry
+    the SAME (prefix → pytree) structure the state's sites were built
+    from (:func:`site_names` — sorted prefixes, flatten order) — or be
+    a zero-arg callable *returning* that dict, in which case the
+    tensors are built inside the fold's ``lax.cond`` branch and
+    derived observation inputs (a cast copy, an update delta) cost
+    nothing on off-steps (the :meth:`Amp.step <apex_tpu.amp.Amp.step>`
+    hook uses this). ``weights`` optionally maps a prefix whose
+    tensors are optimizer *updates* to the matching weight pytree;
+    those sites additionally fold the update-to-weight norm ratio
+    (``‖update‖₂ / ‖weight‖₂`` — the classic silent-stall /
+    blown-update gauge).
+
+    Off-steps take the empty ``lax.cond`` branch: no fold, no extra
+    work (``check_every=1`` skips the cond entirely). Observation is
+    read-only — the trajectory with it enabled is bit-identical to the
+    trajectory without (the parity sweep in tests/test_numerics.py
+    asserts it per opt level).
+    """
+    weights = weights or {}
+    s_total = int(ns.amax.shape[0])
+
+    def _fold(st: NumericsState) -> NumericsState:
+        tr = trees() if callable(trees) else trees
+        for k in weights:
+            if k not in tr:
+                raise ValueError(f"weights prefix {k!r} has no "
+                                 f"matching tree in trees="
+                                 f"{sorted(tr)}")
+        amaxs, amins, hists, zeros, nonfin = [], [], [], [], []
+        uws: List[jax.Array] = []
+        for prefix in sorted(tr):
+            leaves = jax.tree_util.tree_leaves(tr[prefix])
+            wleaves = (jax.tree_util.tree_leaves(weights[prefix])
+                       if prefix in weights else [None] * len(leaves))
+            if len(wleaves) != len(leaves):
+                raise ValueError(
+                    f"weights[{prefix!r}] has {len(wleaves)} leaves, "
+                    f"trees[{prefix!r}] has {len(leaves)}")
+            for leaf, w in zip(leaves, wleaves):
+                amax, amin, hist, zf, nf = _leaf_stats(leaf)
+                amaxs.append(amax)
+                amins.append(amin)
+                hists.append(hist)
+                zeros.append(zf)
+                nonfin.append(nf)
+                if w is None:
+                    uws.append(jnp.float32(-1.0))
+                else:
+                    un = jnp.sqrt(jnp.sum(jnp.square(
+                        jnp.asarray(leaf).astype(jnp.float32))))
+                    wn = jnp.sqrt(jnp.sum(jnp.square(
+                        jnp.asarray(w).astype(jnp.float32))))
+                    uws.append(un / jnp.maximum(wn, 1e-30))
+        if len(amaxs) != s_total:
+            raise ValueError(
+                f"numerics_observe saw {len(amaxs)} sites, state has "
+                f"{s_total} — trees must match numerics_init's sites")
+        amax = jnp.stack(amaxs)
+        amin = jnp.stack(amins)
+        hist = jnp.stack(hists)
+        uw = jnp.stack(uws)
+        d = jnp.float32(cfg.ema)
+        first = st.check_count == 0
+        ema = lambda prev, cur: jnp.where(  # noqa: E731 — 3-use local
+            first, cur, d * prev + (1 - d) * cur)
+        # a -1 slot means "no weight companion": it never mixes
+        had_uw = st.uw_ratio >= 0
+        new_uw = jnp.where(
+            uw < 0, st.uw_ratio,
+            jnp.where(had_uw, d * st.uw_ratio + (1 - d) * uw, uw))
+        return st._replace(
+            amax=amax, amax_ema=ema(st.amax_ema, amax),
+            amin=amin, amin_ema=ema(st.amin_ema, amin),
+            exp_hist=ema(st.exp_hist, hist),
+            zero_frac=jnp.stack(zeros),
+            nonfinite_frac=jnp.stack(nonfin),
+            uw_ratio=new_uw,
+            check_count=st.check_count + 1,
+            last_check_step=st.step)
+
+    if int(cfg.check_every) <= 1:
+        new = _fold(ns)
+    else:
+        new = lax.cond((ns.step % cfg.check_every) == 0, _fold,
+                       lambda st: st, ns)
+    return new._replace(step=ns.step + 1)
+
+
+def scale_amax(ns: NumericsState, rows=None) -> jax.Array:
+    """The amax feed for :func:`apex_tpu.amp.scale_history_update`:
+    per-site last-check amax with **inf substituted wherever the fold
+    saw nonfinite elements**. ``NumericsState.amax`` itself is the max
+    of the *finite* magnitudes by design (the EMAs, histograms and
+    verdicts must stay usable through an overflow episode), which
+    means it alone can never carry the overflow signal the scale
+    machinery's backoff keys on — feeding ``ns.amax`` directly would
+    let a poisoned step's finite remainder GROW the scale
+    mid-overflow. Always wire delayed scaling through this helper::
+
+        sh = amp.scale_history_update(sh, scfg,
+                                      nx.scale_amax(ns, grad_rows))
+
+    ``rows`` optionally gathers a static subset of site rows (e.g.
+    the grad sites). Pure ``jnp``; rides the step dispatch."""
+    amax = jnp.where(ns.nonfinite_frac > 0, jnp.inf, ns.amax)
+    if rows is None:
+        return amax
+    return amax[jnp.asarray(rows)]
+
+
+def finite_ok(ns: NumericsState) -> jax.Array:
+    """True when the last fold saw NO nonfinite element at any site —
+    the in-graph predicate mirroring the guard's nonfinite probes
+    (redundant as a veto when the step already runs ``guard_observe``;
+    the numerics value-add is :func:`nonfinite_sites` naming WHERE)."""
+    return jnp.all(ns.nonfinite_frac == 0)
+
+
+def nonfinite_sites(ns: NumericsState,
+                    sites: Sequence[str]) -> List[Tuple[str, float]]:
+    """Host-side: the sites whose last fold saw nonfinite elements,
+    with their fractions — the forensic complement of the guard's
+    tree-level nonfinite probes (docs/resilience.md names the
+    cross-link): the guard vetoes the commit, this names the tensor."""
+    import numpy as np
+    nf = np.asarray(ns.nonfinite_frac)
+    return [(sites[i], float(nf[i])) for i in range(len(sites))
+            if nf[i] > 0]
+
+
+# -- the host half: format pricing + verdicts ---------------------------------
+
+def _coverage(hist, fmt: FormatSpec, scale_exp: int) -> Tuple[float,
+                                                              float]:
+    """(underflow, saturation) fraction of the measured distribution if
+    cast to ``fmt`` after multiplying by 2**scale_exp — a pure index
+    shift on the exponent histogram. Elements in the top binade are
+    counted representable (the half-bucket approximation
+    docs/numerics.md#formats states; margin in the scale choice covers
+    it)."""
+    import numpy as np
+    h = np.asarray(hist, dtype=np.float64)
+    lo = fmt.min_exp - scale_exp + _BIAS          # first safe bucket
+    hi = fmt.max_exp - scale_exp + _BIAS          # last safe bucket
+    under = float(h[:max(min(lo, HIST_BINS), 0)].sum())
+    sat = float(h[max(min(hi + 1, HIST_BINS), 0):].sum())
+    return under, sat
+
+
+def _recommended_scale_exp(amax: float, fmt: FormatSpec,
+                           margin: float) -> int:
+    """The power-of-two scale exponent centering the measured amax
+    under ``fmt.max_finite / margin`` — the delayed-scaling formula
+    (:mod:`apex_tpu.amp.scale_history` computes the same thing
+    in-graph from the amax window)."""
+    if amax <= 0 or not math.isfinite(amax):
+        return 0
+    return int(math.floor(math.log2(fmt.max_finite / (margin * amax))))
+
+
+@dataclasses.dataclass
+class SiteVerdict:
+    """One site's measured-range verdict against the format ladder."""
+
+    site: str                     # stable site name (site_names)
+    kind: str                     # prefix before the first "/"
+    amax: float                   # max(last, ema) measured |x|
+    amin: float                   # min nonzero |x| (ema-joined)
+    range_bits: Optional[float]   # log2(amax/amin), None w/o data
+    zero_frac: float
+    nonfinite_frac: float
+    uw_ratio: Optional[float]     # None for sites without a companion
+    required_dtype: str           # narrowest safe FORMAT_LADDER entry
+    recommended_scale: float      # 2**k for the required format
+    predicted_underflow_frac: float   # at required fmt + recommended scale
+    predicted_saturation_frac: float
+    current_dtype: Optional[str]  # FORMAT_TABLE key, when known
+    by_format: Dict[str, Dict[str, float]]  # fmt -> {underflow,
+                                  # saturation, scale} at that fmt's
+                                  # own recommended scale
+    #: True when the measured range fits the site's CURRENT format
+    #: **unscaled** (no surprise) — the tensor runs at that format
+    #: TODAY, with no scale applied, so the verdict prices its
+    #: unscaled coverage against the report thresholds (the same
+    #: number ``worst_gaps`` ranks by). A scale-assisted ladder
+    #: comparison would miss a tensor wholly underflowing the format
+    #: it already runs at — exactly the fp8-rollout surprise this
+    #: field exists to flag. None when the current dtype is unknown.
+    ok: Optional[bool] = None
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable ``numerics|kind|site`` key — the waiver/pin identity,
+        apexlint-fingerprint style (never includes measured numbers)."""
+        return f"numerics|{self.kind}|{self.site}"
+
+    def to_event(self, rank: int = 0,
+                 step: Optional[int] = None) -> Dict:
+        """``kind="precision_verdict"`` event
+        (``check_metrics_schema.py --kind numerics`` validates)."""
+        return {"kind": "precision_verdict", "rank": rank, "step": step,
+                "site": self.site, "site_kind": self.kind,
+                "required_dtype": self.required_dtype,
+                "current_dtype": self.current_dtype,
+                "predicted_underflow_frac":
+                    round(self.predicted_underflow_frac, 6),
+                "predicted_saturation_frac":
+                    round(self.predicted_saturation_frac, 6),
+                "recommended_scale": self.recommended_scale,
+                "amax": (None if not math.isfinite(self.amax)
+                         else self.amax),
+                "ok": self.ok,
+                "fingerprint": self.fingerprint}
+
+
+@dataclasses.dataclass
+class NumericsReport:
+    """The per-site verdict list of one observed run."""
+
+    rows: List[SiteVerdict]
+    underflow_threshold: float
+    saturation_threshold: float
+    margin: float
+
+    def __iter__(self):
+        return iter(self.rows)
+
+    def surprises(self) -> List[SiteVerdict]:
+        """Sites whose measured range does NOT fit their current format
+        — the "zero-surprise" claim the clean audit asserts empty."""
+        return [r for r in self.rows if r.ok is False]
+
+    def worst_gaps(self, k: int = 5) -> List[Dict[str, Any]]:
+        """The top-k numerically-at-risk sites (measured range does
+        NOT fit the current format unscaled), ranked by error mass at
+        the CURRENT format — the numeric-safety complement of the
+        roofline observatory's perf ``worst_gaps(k)``; JSON-able
+        dicts."""
+        gaps = []
+        for r in self.rows:
+            if r.ok is not False:
+                continue
+            # price the current format UNSCALED — that is what the
+            # tensor experiences today
+            u, s = _err_at(r, FORMAT_TABLE[r.current_dtype])
+            gaps.append((u + s, r, u, s))
+        gaps.sort(key=lambda t: -t[0])
+        return [{"fingerprint": r.fingerprint, "site": r.site,
+                 "kind": r.kind, "current_dtype": r.current_dtype,
+                 "required_dtype": r.required_dtype,
+                 "underflow_frac": round(u, 6),
+                 "saturation_frac": round(s, 6),
+                 "recommended_scale": r.recommended_scale}
+                for _, r, u, s in gaps[:k]]
+
+    def fp8_candidates(self, k: Optional[int] = None
+                       ) -> List[Dict[str, Any]]:
+        """Sites whose measured range fits an fp8 format (with the
+        recommended scale applied) — the item-5 rollout candidate list,
+        ranked safest-first (least predicted error mass at e4m3), each
+        entry fingerprinted like a ``worst_gaps`` row."""
+        cands = []
+        for r in self.rows:
+            if r.required_dtype not in ("fp8_e4m3", "fp8_e5m2"):
+                continue
+            f8 = r.by_format["fp8_e4m3"]
+            cands.append((f8["underflow"] + f8["saturation"], r))
+        cands.sort(key=lambda t: (t[0], t[1].site))
+        out = [{"fingerprint": r.fingerprint, "site": r.site,
+                "kind": r.kind, "required_dtype": r.required_dtype,
+                "recommended_scale": r.recommended_scale,
+                "predicted_underflow_frac":
+                    round(r.predicted_underflow_frac, 6),
+                "predicted_saturation_frac":
+                    round(r.predicted_saturation_frac, 6)}
+               for _, r in cands]
+        return out if k is None else out[:k]
+
+    def table(self, top: int = 12) -> str:
+        lines = [f"numerics — {len(self.rows)} sites, "
+                 f"{len(self.surprises())} surprises "
+                 f"(u<{self.underflow_threshold:g} "
+                 f"s<{self.saturation_threshold:g})",
+                 f"{'site':<38} {'cur':<9} {'req':<9} {'amax':>9} "
+                 f"{'scale':>9} {'u%':>7} {'s%':>7}"]
+        rows = sorted(self.rows,
+                      key=lambda r: (r.ok is not False, r.site))
+        for r in rows[:top]:
+            lines.append(
+                f"{r.site[:38]:<38} {r.current_dtype or '?':<9} "
+                f"{r.required_dtype:<9} {r.amax:>9.3g} "
+                f"{r.recommended_scale:>9.3g} "
+                f"{100 * r.predicted_underflow_frac:>6.2f}% "
+                f"{100 * r.predicted_saturation_frac:>6.2f}%")
+        return "\n".join(lines)
+
+    def to_events(self, rank: int = 0,
+                  step: Optional[int] = None) -> List[Dict]:
+        return [r.to_event(rank=rank, step=step) for r in self.rows]
+
+
+def _err_at(r: SiteVerdict, fmt: FormatSpec) -> Tuple[float, float]:
+    """(underflow, saturation) of a verdict's stored histogram at
+    ``fmt`` unscaled (scale_exp 0) — re-derived from the per-format
+    table rather than the raw histogram, which the verdict does not
+    retain; falls back to the recorded per-format coverage."""
+    ent = r.by_format.get(fmt.name)
+    if ent is None:
+        return 0.0, 0.0
+    return ent.get("unscaled_underflow", ent["underflow"]), \
+        ent.get("unscaled_saturation", ent["saturation"])
+
+
+def precision_report(ns_or_stats, sites: Optional[Sequence[str]] = None,
+                     *, current_dtypes=None,
+                     underflow_threshold: float = 1e-3,
+                     saturation_threshold: float = 1e-3,
+                     margin: float = 2.0) -> NumericsReport:
+    """Join measured exponent coverage against :data:`FORMAT_TABLE`
+    into the per-site verdict list.
+
+    ``ns_or_stats`` is a :class:`NumericsState` (with ``sites`` — ONE
+    host fetch, amortized like a metrics flush) or a stats dict from
+    :func:`stats_to_json` (the committed-fixture path: CI pins the
+    verdict list on a committed measurement with no device in sight).
+    ``current_dtypes`` maps site → jnp dtype / format name (or one
+    value for all sites); verdicts then carry the ``ok`` no-surprise
+    bit. ``margin`` is the saturation headroom the recommended scale
+    reserves (2 = half the format's top binade, absorbing the
+    half-bucket approximation AND one growth step of the scale
+    machinery).
+
+    A format is *safe* for a site when, at that format's own
+    recommended power-of-two scale, predicted underflow ≤
+    ``underflow_threshold`` and predicted saturation ≤
+    ``saturation_threshold``; ``required_dtype`` is the narrowest safe
+    ladder entry (fp32 as the unconditional fallback).
+    """
+    import numpy as np
+    if isinstance(ns_or_stats, NumericsState):
+        if sites is None:
+            raise ValueError("precision_report(NumericsState) needs "
+                             "the matching sites tuple")
+        stats = _fetch_stats(ns_or_stats, sites)
+    else:
+        stats = dict(ns_or_stats)
+        sites = tuple(stats["sites"])
+
+    def _cur(i: int) -> Optional[str]:
+        if current_dtypes is None:
+            return None
+        if isinstance(current_dtypes, dict):
+            v = current_dtypes.get(sites[i])
+        else:
+            v = current_dtypes
+        return None if v is None else format_of_dtype(v)
+
+    rows: List[SiteVerdict] = []
+    for i, site in enumerate(sites):
+        amax = max(float(stats["amax"][i]), float(stats["amax_ema"][i]))
+        amin_candidates = [v for v in (float(stats["amin"][i]),
+                                       float(stats["amin_ema"][i]))
+                           if v > 0]
+        amin = min(amin_candidates) if amin_candidates else 0.0
+        hist = np.asarray(stats["exp_hist"][i], dtype=np.float64)
+        by_format: Dict[str, Dict[str, float]] = {}
+        required = "fp32"
+        for name in FORMAT_LADDER:
+            fmt = FORMAT_TABLE[name]
+            k = _recommended_scale_exp(amax, fmt, margin)
+            u, s = _coverage(hist, fmt, k)
+            u0, s0 = _coverage(hist, fmt, 0)
+            by_format[name] = {"underflow": u, "saturation": s,
+                               "scale": float(2.0 ** k),
+                               "unscaled_underflow": u0,
+                               "unscaled_saturation": s0}
+            if (required == "fp32" and name != "fp32"
+                    and u <= underflow_threshold
+                    and s <= saturation_threshold):
+                required = name
+        req = by_format[required]
+        uw = float(stats["uw_ratio"][i])
+        cur = _cur(i)
+        if cur is None:
+            ok = None
+        else:
+            c = by_format[cur]
+            ok = (c["unscaled_underflow"] <= underflow_threshold
+                  and c["unscaled_saturation"] <= saturation_threshold)
+        rows.append(SiteVerdict(
+            site=site, kind=site.split("/", 1)[0],
+            amax=amax, amin=amin,
+            range_bits=(math.log2(amax / amin)
+                        if amax > 0 and amin > 0 else None),
+            zero_frac=float(stats["zero_frac"][i]),
+            nonfinite_frac=float(stats["nonfinite_frac"][i]),
+            uw_ratio=None if uw < 0 else uw,
+            required_dtype=required,
+            recommended_scale=req["scale"],
+            predicted_underflow_frac=req["underflow"],
+            predicted_saturation_frac=req["saturation"],
+            current_dtype=cur, by_format=by_format, ok=ok))
+    return NumericsReport(rows=rows,
+                          underflow_threshold=underflow_threshold,
+                          saturation_threshold=saturation_threshold,
+                          margin=margin)
+
+
+def _fetch_stats(ns: NumericsState, sites: Sequence[str]) -> Dict:
+    import numpy as np
+    host = jax.device_get(ns)
+    if len(sites) != host.amax.shape[0]:
+        raise ValueError(f"{len(sites)} sites for a state with "
+                         f"{host.amax.shape[0]} rows")
+    return {"sites": tuple(sites),
+            "step": int(host.step), "check_count": int(host.check_count),
+            "amax": np.asarray(host.amax),
+            "amax_ema": np.asarray(host.amax_ema),
+            "amin": np.asarray(host.amin),
+            "amin_ema": np.asarray(host.amin_ema),
+            "exp_hist": np.asarray(host.exp_hist),
+            "zero_frac": np.asarray(host.zero_frac),
+            "nonfinite_frac": np.asarray(host.nonfinite_frac),
+            "uw_ratio": np.asarray(host.uw_ratio)}
+
+
+def stats_to_json(ns: NumericsState, sites: Sequence[str]) -> str:
+    """Serialize one fetched measurement (the committed-fixture
+    format: ``tests/fixtures/*_numerics_stats.json`` pins
+    :func:`precision_report` verdicts in CI without a device). The
+    histogram is sparsified (zero buckets dropped) to keep fixtures
+    reviewable."""
+    st = _fetch_stats(ns, sites)
+    hist = [{str(b): round(float(v), 9)
+             for b, v in enumerate(row) if v > 0}
+            for row in st["exp_hist"]]
+    return json.dumps({
+        "version": 1, "sites": list(st["sites"]),
+        "step": st["step"], "check_count": st["check_count"],
+        "amax": [float(v) for v in st["amax"]],
+        "amax_ema": [float(v) for v in st["amax_ema"]],
+        "amin": [float(v) for v in st["amin"]],
+        "amin_ema": [float(v) for v in st["amin_ema"]],
+        "exp_hist": hist,
+        "zero_frac": [float(v) for v in st["zero_frac"]],
+        "nonfinite_frac": [float(v) for v in st["nonfinite_frac"]],
+        "uw_ratio": [float(v) for v in st["uw_ratio"]],
+    }, indent=1)
+
+
+def stats_from_json(text: str) -> Dict:
+    """Inverse of :func:`stats_to_json` — feed the result straight to
+    :func:`precision_report`."""
+    import numpy as np
+    data = json.loads(text)
+    s = len(data["sites"])
+    hist = np.zeros((s, HIST_BINS), dtype=np.float64)
+    for i, row in enumerate(data["exp_hist"]):
+        for b, v in row.items():
+            hist[i, int(b)] = v
+    out = dict(data)
+    out["exp_hist"] = hist
+    return out
+
+
+# -- events (the numerics channel) --------------------------------------------
+
+def check_events(ns: NumericsState, sites: Sequence[str], *,
+                 rank: int = 0,
+                 current_dtype=None) -> List[Dict]:
+    """One ``kind="numerics_check"`` aggregate row (``site`` null) plus
+    one per-site row per call — the host-poll emission (wire through
+    ``MetricsLogger(numerics_sink=…)``; ``--kind numerics``
+    validates). Fetches the state ONCE. ``current_dtype`` prices the
+    per-site underflow/overflow fractions against one format's range
+    (the live gauge; the full ladder verdict is
+    :func:`precision_report`)."""
+    import numpy as np
+    st = _fetch_stats(ns, sites)
+    if current_dtype is None:
+        fmt = FORMAT_TABLE["bf16"]
+    else:
+        key = format_of_dtype(current_dtype)
+        if key is None:
+            # a silent bf16 fallback would emit fractions priced
+            # against a range the caller never asked about — refuse
+            # loudly, like precision_report refuses nothing but maps
+            # unknowns to ok=None
+            raise ValueError(
+                f"check_events: {current_dtype!r} is not a known "
+                f"format/dtype — one of {FORMAT_LADDER} or a float "
+                f"dtype name")
+        fmt = FORMAT_TABLE[key]
+    events: List[Dict] = [{
+        "kind": "numerics_check", "rank": rank, "step": st["step"],
+        "check_count": st["check_count"], "site": None,
+        "n_sites": len(sites),
+        "amax": float(np.max(st["amax"])),
+        "amin": None,
+        "nonfinite_frac": float(np.max(st["nonfinite_frac"])),
+        "zero_frac": float(np.mean(st["zero_frac"])),
+        "underflow_frac": None, "overflow_frac": None,
+        "uw_ratio": None,
+    }]
+    for i, site in enumerate(sites):
+        u, s = _coverage(st["exp_hist"][i], fmt, 0)
+        uw = float(st["uw_ratio"][i])
+        events.append({
+            "kind": "numerics_check", "rank": rank, "step": st["step"],
+            "check_count": st["check_count"], "site": site,
+            "n_sites": len(sites),
+            "amax": float(st["amax"][i]),
+            "amin": float(st["amin"][i]),
+            "underflow_frac": round(u, 6),
+            "overflow_frac": round(s, 6),
+            "zero_frac": round(float(st["zero_frac"][i]), 6),
+            "nonfinite_frac": round(float(st["nonfinite_frac"][i]), 6),
+            "uw_ratio": None if uw < 0 else uw,
+        })
+    return events
+
+
+# -- the advisor: perf headroom × numeric safety ------------------------------
+
+def placement_advisor(roofline_report, report: NumericsReport, *,
+                      k: int = 5) -> List[Dict[str, Any]]:
+    """Rank precision-placement candidates by **measured perf headroom
+    × numeric safety**: join the verdict list's fp8/half candidates
+    with the roofline observatory's what-if dtype column
+    (:meth:`apex_tpu.prof.RooflineReport.what_if` — attainable time if
+    the site's verdict were applied). A site only ranks when (a) its
+    measured range fits the narrower format (the verdict) AND (b) the
+    roofline says the op is near enough its bound that the dtype
+    change buys wall time (the headroom). Sites are matched to
+    roofline rows by case-insensitive substring of the stripped scope
+    — name observation sites after the named-scope conventions
+    (docs/numerics.md#advisor)."""
+    plan = {}
+    for r in report.rows:
+        if r.required_dtype == "fp32":
+            continue
+        if r.current_dtype is not None and r.ok is False:
+            continue          # numerically unsafe today — not a cand.
+        plan[r.site] = r.required_dtype
+    if not plan:
+        return []
+    whatif = roofline_report.what_if(plan)
+    by_site: Dict[str, SiteVerdict] = {r.site: r for r in report.rows}
+    out = []
+    for row in whatif:
+        v = by_site.get(row["site"])
+        if v is None:
+            continue
+        err = (v.predicted_underflow_frac
+               + v.predicted_saturation_frac)
+        safety = 1.0 - min(1.0, err / max(
+            report.underflow_threshold
+            + report.saturation_threshold, 1e-12))
+        gain = row.get("whatif_gain_us") or 0.0
+        out.append({**row, "required_dtype": v.required_dtype,
+                    "recommended_scale": v.recommended_scale,
+                    "numeric_safety": round(safety, 4),
+                    "rank_score": round(gain * safety, 3),
+                    "verdict_fingerprint": v.fingerprint})
+    out.sort(key=lambda e: -e["rank_score"])
+    return out[:k]

@@ -1392,6 +1392,104 @@ def _():
             f"step (donate={donate})")
 
 
+@case("numerics/no-extra-dispatch")
+def _():
+    """The numerics observatory's observability contract: (1) the
+    per-site fold (amax/amin EMAs, exponent histograms, uw ratios) and
+    the in-graph ScaleHistory update ride the existing step program —
+    the instrumented step compiles to ONE executable with no host
+    traffic (off-steps take the empty ``lax.cond`` branch: no fold, no
+    scatter-add); (2) the HOST side — polling NumericsState into
+    ``check_events`` / ``precision_report`` / ``scale_update_events``
+    through a ``numerics_sink`` every step — leaves the compiled HLO
+    BIT-IDENTICAL, donated and undonated (observation is pure
+    host-side reads, never ops). Same guarantee the
+    monitor/guard/integrity cases pin for their layers."""
+    import io
+
+    from apex_tpu import amp, monitor
+    from apex_tpu.monitor import numerics as _nx
+    from apex_tpu.monitor.check import module_count_and_host_ops
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+    ncfg = _nx.NumericsConfig(check_every=4)   # steps 1-3 are OFF
+    scfg = amp.ScaleHistoryConfig(window=4)
+    sites = _nx.site_names({"grads": params, "params": params})
+    n_sites = len(sites)
+    grad_rows = [i for i, s in enumerate(sites)
+                 if s.startswith("grads/")]
+
+    def body(p, ns, sh, x, y, observed):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        g = jax.grad(loss_fn)(p)
+        if observed:
+            ns = _nx.numerics_observe(ns, ncfg,
+                                      {"grads": g, "params": p})
+            sh = amp.scale_history_update(
+                sh, scfg, _nx.scale_amax(ns, grad_rows))
+        new_p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+        return new_p, ns, sh, jnp.float32(0)
+
+    def build(observed, donate):
+        fn = functools.partial(body, observed=observed)
+        kw = {"donate_argnums": (0, 1, 2)} if donate else {}
+        return jax.jit(fn, **kw)
+
+    ns0 = _nx.numerics_init(ncfg, sites=sites)
+    sh0 = amp.scale_history_init(scfg, n_sites=len(grad_rows))
+
+    # half 1: one executable, no host ops (module-count parity with
+    # the unobserved twin)
+    n_o, host_o = module_count_and_host_ops(build(True, False),
+                                            params, ns0, sh0, x, y)
+    n_p, _ = module_count_and_host_ops(build(False, False),
+                                       params, ns0, sh0, x, y)
+    assert n_o == n_p, (n_o, n_p)
+    assert not host_o, \
+        f"numerics-observed step compiled host traffic: {host_o}"
+
+    # half 2: host polling every step (three of four being off-steps)
+    # leaves the program bit-identical, donated and undonated
+    for donate in (False, True):
+        jitted = build(True, donate)
+        before = jitted.lower(params, ns0, sh0, x, y) \
+            .compile().as_text()
+        logger = monitor.MetricsLogger(
+            sinks=[], numerics_sink=monitor.JSONLSink(io.StringIO()))
+        # fresh unaliased buffers: freshly-init'd states share cached
+        # zero-scalar constants a donating jit would refuse to donate
+        # twice
+        p, ns, sh = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), (params, ns0, sh0))
+        for i in range(4):
+            # fetch BEFORE the (possibly donating) dispatch — donation
+            # invalidates the input buffers, the same hazard
+            # MetricsLogger(donation_safe=) covers for metrics
+            prev_sh = jax.device_get(sh)
+            p, ns, sh, _loss = jitted(p, ns, sh, x, y)
+            for ev in _nx.check_events(ns, sites,
+                                       current_dtype="bfloat16"):
+                logger.record_numerics(ev)
+            for ev in amp.scale_update_events(
+                    prev_sh, sh, tuple(sites[i] for i in grad_rows)):
+                logger.record_numerics(ev)
+            rep = _nx.precision_report(ns, sites,
+                                       current_dtypes="float32")
+            for ev in rep.to_events():
+                logger.record_numerics(ev)
+        logger.close()
+        assert int(jax.device_get(ns.check_count)) == 1
+        after = jitted.lower(params, ns0, sh0, x, y) \
+            .compile().as_text()
+        assert after == before, (
+            f"numerics observation changed the compiled program "
+            f"(donate={donate})")
+
+
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)
     regardless of cwd — the module lives next to the package root."""

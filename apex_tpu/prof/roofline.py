@@ -420,6 +420,59 @@ class RooflineReport:
         return dict(sorted(out.items(),
                            key=lambda kv: -kv[1]["measured_us"]))
 
+    def what_if(self, plan: Dict[str, str]) -> List[Dict[str, Any]]:
+        """The what-if dtype column: attainable time per op if a
+        precision-placement verdict were applied.
+
+        ``plan`` maps a *site* (a case-insensitive substring of the
+        stripped scope — the :func:`apex_tpu.monitor.numerics.site_names`
+        convention) to a target format (``FORMAT_TABLE`` key like
+        ``"fp8_e4m3"`` or an HLO dtype like ``"bf16"``). For every
+        matching row with a priced dtype, the HBM-traffic bound scales
+        by the byte ratio and the MXU bound by the spec-sheet dtype
+        ladder (each halving of element width doubles the attainable
+        FLOP rate — the fp8-doubles-bf16 MXU model
+        docs/profiling.md#whatif states; the roofline observatory then
+        *verifies* a landed kernel actually collects, ROADMAP item 5).
+        Returns JSON-able rows with ``whatif_attainable_us`` and the
+        per-occurrence-summed ``whatif_gain_us`` —
+        :func:`apex_tpu.monitor.numerics.placement_advisor` ranks them
+        by gain × numeric safety."""
+        fmt_bytes = {"fp8_e4m3": 1, "fp8_e5m2": 1, "fp16": 2,
+                     "bf16": 2, "fp32": 4}
+        out: List[Dict[str, Any]] = []
+        for site, target in plan.items():
+            b_new = fmt_bytes.get(target, _DTYPE_BYTES.get(target))
+            if b_new is None:
+                raise ValueError(f"what_if target {target!r} is not a "
+                                 f"known format or HLO dtype")
+            needle = site.lower()
+            for r in self.rows:
+                if needle not in r.scope.lower():
+                    continue
+                b_cur = _DTYPE_BYTES.get(r.dtype)
+                if b_cur is None or b_new >= b_cur:
+                    continue      # target not narrower — no what-if
+                ratio = b_new / b_cur
+                new_compute = r.compute_us * ratio
+                new_memory = r.memory_us * ratio
+                whatif = max(new_compute, new_memory)
+                gain = max(0.0, (r.attainable_us - whatif)
+                           * max(r.occurrences, 1))
+                out.append({
+                    "site": site, "op": r.name, "scope": r.scope,
+                    "family": r.family, "fingerprint": r.fingerprint,
+                    "dtype_from": r.dtype, "dtype_to": target,
+                    "bound": r.bound,
+                    "attainable_us": round(r.attainable_us, 3),
+                    "whatif_attainable_us": round(whatif, 3),
+                    "whatif_gain_us": round(gain, 3),
+                    "measured_us": (None if r.measured_us is None
+                                    else round(r.measured_us, 3)),
+                    "occurrences": r.occurrences})
+        out.sort(key=lambda e: -e["whatif_gain_us"])
+        return out
+
     def worst_gaps(self, k: int = 5) -> List[Dict[str, Any]]:
         """The top-k ops by total time above their roofline — the
         committed, fingerprinted candidate list ROADMAP item 4's

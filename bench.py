@@ -927,6 +927,82 @@ def _roofline_row(batch: int, size: int):
     return rep.summary(k=3)
 
 
+def _numerics_row():
+    """The ``numerics_underflow_frac`` column: a freshly MEASURED
+    fp8-readiness gauge (apex_tpu.monitor.numerics /
+    docs/numerics.md). A small deterministic BERT-shaped MLM
+    trajectory (structural encoder, amp O1 + FusedLAMB — the
+    numerics_audit subject downscaled) runs 4 observed steps; the
+    column is the worst amp/grads site's fp8-e4m3 UNDERFLOW fraction
+    AT that format's own recommended power-of-two scale — i.e. the
+    underflow fp8 would experience after optimal delayed scaling,
+    which rises only when a site's dynamic RANGE widens beyond the
+    format's span (a scale shift cannot fix that; the scale's margin
+    reserves the saturation headroom, so widening surfaces as the
+    small tail underflowing — the matching saturation fraction rides
+    along as its own context field). That is the numeric-health
+    regression the sentinel gate (scripts/perf_baseline.json) watches
+    the same way it watches a perf one."""
+    import numpy as _np
+
+    from apex_tpu import amp, models
+    from apex_tpu.monitor import numerics as nx
+    from apex_tpu.optim import FusedLAMB
+
+    policy = amp.Policy.from_opt_level("O1")
+    enc = models.BertEncoder(1000, hidden=64, layers=1, heads=2,
+                             max_len=16)
+    rng = _np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, 1000, (4, 16)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 1000, (4, 16)), jnp.int32)
+    variables = enc.init(jax.random.PRNGKey(0), toks[:1])
+    amp_opt = amp.Amp(policy, FusedLAMB(lr=1e-3))
+    state = amp_opt.init(variables["params"])
+    sites = amp_opt.numerics_sites(state.params)
+    ncfg = nx.NumericsConfig()
+    ns = nx.numerics_init(ncfg, sites=sites)
+
+    def loss_fn(mp, toks, labels):
+        with amp.auto_cast(policy):
+            return models.mlm_loss(enc, {"params": mp}, toks, labels)
+
+    @jax.jit
+    def step(state, ns, toks, labels):
+        state, loss, _finite, ns = amp_opt.step(
+            state, loss_fn, toks, labels, numerics=(ns, ncfg))
+        return state, ns, loss
+
+    for _ in range(4):
+        state, ns, _loss = step(state, ns, toks, labels)
+    # current formats per site (cast copy at the policy's half dtype,
+    # grads/updates at fp32) — without them every verdict's ok is None
+    # and the surprises context column could never read anything
+    half = nx.format_of_dtype(policy.compute_dtype) or "fp32"
+    cur = {s: (half if s.startswith("amp/cast/") else "fp32")
+           for s in sites}
+    report = nx.precision_report(ns, sites, current_dtypes=cur)
+    worst_site, worst, worst_sat, worst_unscaled = None, -1.0, 0.0, 0.0
+    for r in report.rows:
+        if not r.site.startswith("amp/grads/"):
+            continue
+        f8 = r.by_format["fp8_e4m3"]
+        # the gauge is the UNDERFLOW half, matching its name (the
+        # recommended scale reserves saturation headroom by margin,
+        # so range widening shows up as the small tail underflowing);
+        # saturation rides along as its own context field
+        if f8["underflow"] > worst:
+            worst_site, worst = r.site, f8["underflow"]
+            worst_sat = f8["saturation"]
+            worst_unscaled = f8["unscaled_underflow"]
+    return {"underflow_frac": round(max(worst, 0.0), 6),
+            "worst_site": worst_site,
+            "worst_site_saturation_frac": round(worst_sat, 6),
+            "worst_site_unscaled_underflow": round(worst_unscaled, 6),
+            "n_sites": len(sites),
+            "n_fp8_candidates": len(report.fp8_candidates()),
+            "surprises": len(report.surprises())}
+
+
 def _sentinel_row(current):
     """The ``sentinel_regressions`` column: judge THIS bench run (plus
     the committed BENCH_r0*.json trajectory) through the noise-aware
@@ -1074,6 +1150,10 @@ def main():
         roofline = _roofline_row(best_batch, size)
     except Exception as e:
         roofline = {"failed": type(e).__name__}
+    try:
+        numerics = _numerics_row()
+    except Exception as e:
+        numerics = {"failed": type(e).__name__}
     # every trace/lowering/backend-compile the bench performed — a
     # steady-state regression (a step silently retracing per call)
     # shows up here as n_compiles exploding; autotune-origin compiles
@@ -1129,6 +1209,15 @@ def main():
                   "roofline_worst_gap": (roofline.get("worst_gaps")
                                          or [None])[0],
                   "roofline": roofline,
+                  # freshly measured fp8-readiness gauge: the worst
+                  # grad site's e4m3 error fraction at its own
+                  # recommended scale (apex_tpu.monitor.numerics; the
+                  # sentinel's numerics_underflow_frac gate row
+                  # watches it — numeric health regresses like perf
+                  # does)
+                  "numerics_underflow_frac": numerics.get(
+                      "underflow_frac"),
+                  "numerics": numerics,
                   # async checkpoint overhead on the step path (median
                   # per-step capture stall vs a synchronous
                   # save-and-wait; apex_tpu.ckpt, docs/checkpointing.md)

@@ -57,6 +57,14 @@
 #                                   #   apexlint --mesh with APX203 hop
 #                                   #   evidence from the measured
 #                                   #   bytes/s
+#                                   # + the numerics observatory audit
+#                                   #   (--cpu8): per-tensor dynamic-
+#                                   #   range fold zero-dispatch on the
+#                                   #   BERT step, e4m3-boundary tensor
+#                                   #   flagged at the right site with
+#                                   #   a scale that fixes it,
+#                                   #   ScaleHistory bitwise vs oracle,
+#                                   #   numerics schema
 #                                   # + the roofline observatory audit
 #                                   #   (--cpu8): per-op attribution
 #                                   #   closure on the committed BERT
@@ -227,6 +235,19 @@ EOF
     # milliseconds computed from the MEASURED bytes/s, (d) every
     # stream passes --kind goodput
     JAX_PLATFORMS=cpu python scripts/goodput_audit.py --cpu8
+
+    echo "== smoke: numerics observatory audit (--cpu8)"
+    # asserts: (a) the instrumented structural BERT step (numerics
+    # fold + grad-site ScaleHistory through Amp.step) emits ZERO
+    # surprise verdicts with compiled HLO bit-identical under per-step
+    # host polling and no host ops, (b) a seeded tensor straddling the
+    # e4m3 underflow boundary is flagged at the correct site with a
+    # verdict naming the minimum safe format and a recommended_scale
+    # that, applied, drives the measured underflow below threshold,
+    # (c) ScaleHistory tracks a synthetic amax ramp matching a
+    # pure-numpy oracle bitwise through grow/shrink/backoff, (d) the
+    # stream passes --kind numerics with all three kinds present
+    JAX_PLATFORMS=cpu python scripts/numerics_audit.py --cpu8
 
     echo "== smoke: roofline observatory audit (--cpu8)"
     # asserts: (a) the per-op roofline join over the committed

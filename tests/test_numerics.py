@@ -1,0 +1,749 @@
+"""The numerics observatory: per-tensor dynamic-range telemetry, the
+per-site delayed-scaling state machine, the precision-placement
+advisor, and the ``--kind numerics`` event schema (valid stream +
+negative twins). The end-to-end claims — zero-surprise BERT run,
+e4m3-boundary flagging with a scale that fixes it, ScaleHistory
+bitwise vs its oracle — live in ``scripts/numerics_audit.py --cpu8``.
+"""
+
+import hashlib
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.monitor import numerics as nx
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                       "bert_numerics_stats.json")
+
+#: CI pin of ``precision_report()`` on the committed BERT fixture: the
+#: (fingerprint, required_dtype, recommended_scale) list is a pure
+#: host-side function of the committed measurement — regenerate with
+#: ``scripts/numerics_audit.py --cpu8 --write-fixture
+#: tests/fixtures/bert_numerics_stats.json`` and update the digest
+#: ONLY when the verdict machinery intentionally changes.
+FIXTURE_VERDICT_DIGEST = \
+    "6af8d2d31a7c418c10d12ee11a755d7bd042cc7ff20d0acfc8d1e6d8f0e71dbe"
+
+
+def _signed_pow2(rng, lo, hi, n=4096):
+    return jnp.asarray((2.0 ** rng.uniform(lo, hi, (n,))
+                        * np.where(rng.rand(n) < 0.5, -1.0, 1.0)
+                        ).astype("float32"))
+
+
+def _observe_once(trees, weights=None, cfg=nx.NumericsConfig()):
+    sites = nx.site_names(trees)
+    ns = nx.numerics_init(cfg, sites=sites)
+    ns = jax.jit(lambda s: nx.numerics_observe(
+        s, cfg, trees, weights=weights))(ns)
+    return ns, sites
+
+
+# --- format table -------------------------------------------------------------
+
+class TestFormatTable:
+    def test_ladder_covers_table(self):
+        assert set(nx.FORMAT_LADDER) == set(nx.FORMAT_TABLE)
+
+    def test_max_finite_sits_in_top_binade(self):
+        for f in nx.FORMAT_TABLE.values():
+            assert 2.0 ** f.max_exp <= f.max_finite \
+                < 2.0 ** (f.max_exp + 1), f
+
+    def test_known_corners(self):
+        assert nx.FORMAT_TABLE["fp8_e4m3"].max_finite == 448.0
+        assert nx.FORMAT_TABLE["fp8_e4m3"].min_exp == -6
+        assert nx.FORMAT_TABLE["fp8_e5m2"].max_finite == 57344.0
+        assert nx.FORMAT_TABLE["fp16"].min_exp == -14
+
+    def test_format_of_dtype(self):
+        assert nx.format_of_dtype(jnp.bfloat16) == "bf16"
+        assert nx.format_of_dtype("float32") == "fp32"
+        assert nx.format_of_dtype(jnp.int32) is None
+
+
+# --- sites + init -------------------------------------------------------------
+
+class TestSites:
+    def test_sorted_prefixes_and_flatten_order(self):
+        trees = {"b": {"y": jnp.zeros(2), "x": jnp.zeros(2)},
+                 "a": jnp.zeros(3)}
+        sites = nx.site_names(trees)
+        assert sites[0] == "a"
+        assert all(s.startswith("b/") for s in sites[1:])
+        assert sites == nx.site_names(dict(reversed(trees.items())))
+
+    def test_init_validation(self):
+        with pytest.raises(ValueError):
+            nx.numerics_init(nx.NumericsConfig(check_every=0),
+                             sites=("a",))
+        with pytest.raises(ValueError):
+            nx.numerics_init(nx.NumericsConfig(ema=1.5), sites=("a",))
+        with pytest.raises(ValueError):
+            nx.numerics_init(sites=())
+
+
+# --- the in-graph fold --------------------------------------------------------
+
+class TestObserve:
+    def test_amax_amin_hist_against_numpy(self):
+        rng = np.random.RandomState(0)
+        x = _signed_pow2(rng, -10, 5)
+        ns, sites = _observe_once({"t": x})
+        a = np.abs(np.asarray(x))
+        assert float(ns.amax[0]) == a.max()
+        assert float(ns.amin[0]) == a[a > 0].min()
+        hist = np.asarray(ns.exp_hist[0])
+        # the histogram is normalized over finite nonzero elements
+        assert hist.sum() == pytest.approx(1.0, abs=1e-5)
+        # bucket b holds magnitudes in [2^(b-127), 2^(b-126))
+        be = (np.frexp(a[a > 0])[1] - 1) + 127
+        ref = np.bincount(be, minlength=256) / a.size
+        np.testing.assert_allclose(hist, ref, atol=1e-6)
+
+    def test_zero_and_nonfinite_fractions(self):
+        x = jnp.asarray([0.0, 0.0, 1.0, np.inf, np.nan, -2.0],
+                        jnp.float32)
+        ns, _ = _observe_once({"t": x})
+        assert float(ns.zero_frac[0]) == pytest.approx(2 / 6)
+        assert float(ns.nonfinite_frac[0]) == pytest.approx(2 / 6)
+        assert not bool(nx.finite_ok(ns))
+        named = nx.nonfinite_sites(ns, ("t",))
+        assert named == [("t", pytest.approx(2 / 6))]
+
+    def test_cadence_off_branch(self):
+        cfg = nx.NumericsConfig(check_every=3)
+        trees = {"t": jnp.ones((4,), jnp.float32)}
+        ns = nx.numerics_init(cfg, sites=nx.site_names(trees))
+        step = jax.jit(lambda s: nx.numerics_observe(s, cfg, trees))
+        for _ in range(7):
+            ns = step(ns)
+        assert int(ns.step) == 7
+        assert int(ns.check_count) == 3          # steps 0, 3, 6
+        assert int(ns.last_check_step) == 6
+
+    def test_ema_seeded_by_first_check(self):
+        cfg = nx.NumericsConfig(ema=0.5)
+        trees = {"t": jnp.full((4,), 8.0, jnp.float32)}
+        ns = nx.numerics_init(cfg, sites=("t",))
+        ns = nx.numerics_observe(ns, cfg, trees)
+        assert float(ns.amax_ema[0]) == 8.0      # no zero-bias warmup
+        ns = nx.numerics_observe(ns, cfg,
+                                 {"t": jnp.full((4,), 4.0)})
+        assert float(ns.amax_ema[0]) == pytest.approx(6.0)
+
+    def test_uw_ratio_companion(self):
+        upd = jnp.full((4,), 0.01, jnp.float32)
+        w = jnp.full((4,), 1.0, jnp.float32)
+        ns, sites = _observe_once({"u": upd}, weights={"u": w})
+        assert float(ns.uw_ratio[0]) == pytest.approx(0.01)
+        ns2, _ = _observe_once({"u": upd})
+        assert float(ns2.uw_ratio[0]) == -1.0    # no companion
+
+    def test_mismatched_trees_refused(self):
+        ns = nx.numerics_init(sites=("a", "b"))
+        with pytest.raises(ValueError):
+            nx.numerics_observe(ns, nx.NumericsConfig(),
+                                {"a": jnp.zeros(2)})
+        with pytest.raises(ValueError):
+            nx.numerics_observe(
+                ns, nx.NumericsConfig(),
+                {"a": jnp.zeros(2), "b": jnp.zeros(2)},
+                weights={"c": jnp.zeros(2)})
+
+    def test_scan_carryable(self):
+        cfg = nx.NumericsConfig()
+        ns = nx.numerics_init(cfg, sites=("t",))
+
+        def body(ns, x):
+            return nx.numerics_observe(ns, cfg, {"t": x}), x
+
+        xs = jnp.ones((5, 3), jnp.float32)
+        ns, _ = jax.lax.scan(body, ns, xs)
+        assert int(ns.check_count) == 5
+
+
+# --- verdicts -----------------------------------------------------------------
+
+class TestPrecisionReport:
+    def test_tiny_tensor_needs_scale(self):
+        rng = np.random.RandomState(1)
+        ns, sites = _observe_once({"t": _signed_pow2(rng, -12, -2)})
+        rep = nx.precision_report(ns, sites)
+        (r,) = rep.rows
+        assert r.required_dtype == "fp8_e4m3"
+        assert r.recommended_scale > 1
+        assert r.by_format["fp8_e4m3"]["unscaled_underflow"] > 0.3
+        assert r.predicted_underflow_frac <= rep.underflow_threshold
+
+    def test_wide_range_needs_wider_format(self):
+        rng = np.random.RandomState(2)
+        # 36 octaves of dynamic range: no scale fits e4m3's 15-binade
+        # normal span or e5m2/fp16's 30 — bf16 is the verdict
+        ns, sites = _observe_once({"t": _signed_pow2(rng, -18, 18)})
+        rep = nx.precision_report(ns, sites)
+        (r,) = rep.rows
+        assert r.required_dtype == "bf16"
+        assert r.range_bits == pytest.approx(36, abs=1.5)
+
+    def test_surprise_vs_ok(self):
+        rng = np.random.RandomState(3)
+        ns, sites = _observe_once({"t": _signed_pow2(rng, -18, 18)})
+        rep = nx.precision_report(ns, sites,
+                                  current_dtypes="float16")
+        (r,) = rep.rows
+        assert r.ok is False
+        assert rep.surprises() == [r]
+        gaps = rep.worst_gaps()
+        assert gaps and gaps[0]["site"] == "t"
+        assert gaps[0]["required_dtype"] == "bf16"
+        rep2 = nx.precision_report(ns, sites,
+                                   current_dtypes="bfloat16")
+        assert rep2.rows[0].ok is True and not rep2.surprises()
+        rep3 = nx.precision_report(ns, sites)
+        assert rep3.rows[0].ok is None
+
+    def test_ok_prices_current_format_unscaled(self):
+        """The reviewed blind spot: a tensor living at ~2^-40 fits
+        fp8_e4m3 WITH a scale (required_dtype narrower than fp16), but
+        it runs at fp16 TODAY with no scale — where it wholly
+        underflows. ok must price the current format unscaled, not
+        compare ladder positions of scale-assisted verdicts."""
+        rng = np.random.RandomState(9)
+        ns, sites = _observe_once({"t": _signed_pow2(rng, -42, -38)})
+        rep = nx.precision_report(ns, sites, current_dtypes="fp16")
+        (r,) = rep.rows
+        assert r.by_format["fp16"]["unscaled_underflow"] == 1.0
+        assert r.required_dtype == "fp8_e4m3"    # narrower, WITH scale
+        assert r.ok is False                     # but today: surprise
+        assert rep.surprises() == [r]
+        gaps = rep.worst_gaps()
+        assert gaps and gaps[0]["underflow_frac"] == 1.0
+
+    def test_check_events_unknown_dtype_refused(self):
+        ns, sites = _observe_once({"t": jnp.ones((4,), jnp.float32)})
+        with pytest.raises(ValueError):
+            nx.check_events(ns, sites, current_dtype="bfloat_16")
+        assert nx.check_events(ns, sites, current_dtype=None)
+
+    def test_saturation_flagged(self):
+        # 1e5 sits in the 2^16 binade — strictly above fp16's top
+        # binade, so it counts as saturation unscaled (6e4 would NOT:
+        # it shares 65504's binade and the half-bucket approximation
+        # counts it representable — docs/numerics.md#formats)
+        x = jnp.asarray([1e3, 1e5, 3e4], jnp.float32)
+        ns, sites = _observe_once({"t": x})
+        rep = nx.precision_report(ns, sites)
+        (r,) = rep.rows
+        assert r.by_format["fp16"]["unscaled_saturation"] == \
+            pytest.approx(1 / 3)
+        assert r.predicted_saturation_frac <= rep.saturation_threshold
+        assert r.by_format["fp16"]["scale"] < 1
+
+    def test_fp8_candidates_shape(self):
+        rng = np.random.RandomState(4)
+        ns, sites = _observe_once({"a": _signed_pow2(rng, -3, 3),
+                                   "b": _signed_pow2(rng, -18, 18)})
+        rep = nx.precision_report(ns, sites)
+        cands = rep.fp8_candidates()
+        assert [c["site"] for c in cands] == ["a"]
+        assert set(cands[0]) >= {"fingerprint", "site",
+                                 "required_dtype",
+                                 "recommended_scale"}
+
+    def test_stats_json_round_trip(self):
+        rng = np.random.RandomState(5)
+        ns, sites = _observe_once({"t": _signed_pow2(rng, -9, 2)})
+        text = nx.stats_to_json(ns, sites)
+        rep_a = nx.precision_report(ns, sites)
+        rep_b = nx.precision_report(nx.stats_from_json(text))
+        assert [(r.fingerprint, r.required_dtype, r.recommended_scale)
+                for r in rep_a.rows] == \
+               [(r.fingerprint, r.required_dtype, r.recommended_scale)
+                for r in rep_b.rows]
+
+
+class TestCommittedFixturePin:
+    """``precision_report()`` on the committed BERT fixture is a pure
+    host-side function of committed bytes — the verdict list is pinned
+    in CI (the ISSUE-15 acceptance criterion)."""
+
+    def _report(self):
+        with open(FIXTURE) as f:
+            return nx.precision_report(nx.stats_from_json(f.read()))
+
+    def test_verdict_list_pinned(self):
+        rep = self._report()
+        canon = json.dumps([(r.fingerprint, r.required_dtype,
+                             r.recommended_scale) for r in rep.rows])
+        assert hashlib.sha256(canon.encode()).hexdigest() == \
+            FIXTURE_VERDICT_DIGEST
+        assert len(rep.rows) == 84
+        # the measured BERT ranges are fp8-range-safe with scaling —
+        # the ROADMAP item-5 rollout candidate list is non-empty
+        assert all(r.required_dtype in ("fp8_e4m3", "fp8_e5m2")
+                   for r in rep.rows)
+
+    def test_deterministic_across_runs(self):
+        a, b = self._report(), self._report()
+        assert [r.to_event() for r in a.rows] == \
+               [r.to_event() for r in b.rows]
+
+    def test_no_surprises_at_current_formats(self):
+        with open(FIXTURE) as f:
+            stats = nx.stats_from_json(f.read())
+        cur = {s: ("bf16" if s.startswith("amp/cast/") else "fp32")
+               for s in stats["sites"]}
+        rep = nx.precision_report(stats, current_dtypes=cur)
+        assert rep.surprises() == []
+
+
+# --- ScaleHistory -------------------------------------------------------------
+
+class TestScaleHistory:
+    def test_init_validation(self):
+        with pytest.raises(ValueError):
+            amp.scale_history_init(
+                amp.ScaleHistoryConfig(fmt="fp12"), n_sites=1)
+        with pytest.raises(ValueError):
+            amp.scale_history_init(
+                amp.ScaleHistoryConfig(window=0), n_sites=1)
+        with pytest.raises(ValueError):
+            amp.scale_history_init(amp.ScaleHistoryConfig(), n_sites=0)
+        # non-pow2 factors would break the exact-exponent-shift
+        # invariant on the first backoff — refused at init
+        with pytest.raises(ValueError):
+            amp.scale_history_init(
+                amp.ScaleHistoryConfig(backoff_factor=0.3), n_sites=1)
+        with pytest.raises(ValueError):
+            amp.scale_history_init(
+                amp.ScaleHistoryConfig(growth_factor=3.0), n_sites=1)
+
+    def test_scales_are_exact_powers_of_two(self):
+        cfg = amp.ScaleHistoryConfig(window=2, growth_factor=2.0 ** 40)
+        sh = amp.scale_history_init(cfg, n_sites=1)
+        for a in (3.7e-5, 11.0, 0.9):
+            sh = amp.scale_history_update(sh, cfg,
+                                          jnp.asarray([a], jnp.float32))
+            s = float(sh.scale[0])
+            m, _e = np.frexp(np.float32(s))
+            assert m == 0.5, s                   # exact power of two
+
+    def test_delayed_scaling_formula(self):
+        cfg = amp.ScaleHistoryConfig(window=4, margin=2.0,
+                                     growth_factor=2.0 ** 40)
+        sh = amp.scale_history_init(cfg, n_sites=1)
+        sh = amp.scale_history_update(sh, cfg, jnp.asarray([2.0 ** -8]))
+        # 448 / (2 * 2^-8) = 57344 -> 2^15
+        assert float(sh.scale[0]) == 2.0 ** 15
+
+    def test_shrink_immediate_growth_rate_limited(self):
+        cfg = amp.ScaleHistoryConfig(window=1, growth_factor=2.0,
+                                     growth_interval=2)
+        sh = amp.scale_history_init(cfg, n_sites=1)
+        # big amax: target far below 1.0 — shrink applies IMMEDIATELY
+        sh = amp.scale_history_update(sh, cfg, jnp.asarray([1e6]))
+        assert float(sh.scale[0]) < 1.0
+        low = float(sh.scale[0])
+        # tiny amax: the window-derived target leaps to 2^13, but the
+        # tracker (1 prior clean update + this one = interval) gates a
+        # single RATE-LIMITED x2 hop, not the leap — then resets, so
+        # the next update holds, then hops again
+        sh = amp.scale_history_update(sh, cfg, jnp.asarray([2.0 ** -6]))
+        assert float(sh.scale[0]) == low * 2     # one x2 hop, not 2^13
+        sh = amp.scale_history_update(sh, cfg, jnp.asarray([2.0 ** -6]))
+        assert float(sh.scale[0]) == low * 2     # tracker reset: hold
+        sh = amp.scale_history_update(sh, cfg, jnp.asarray([2.0 ** -6]))
+        assert float(sh.scale[0]) == low * 4     # next gated hop
+
+    def test_backoff_on_nonfinite_and_window_hygiene(self):
+        cfg = amp.ScaleHistoryConfig(window=4)
+        sh = amp.scale_history_init(cfg, n_sites=2)
+        # site 1's amax of 224 pins its target at exactly 1.0
+        # (448 / (2 * 224)) — a stationary control row
+        sh = amp.scale_history_update(sh, cfg,
+                                      jnp.asarray([1.0, 224.0]))
+        before = np.asarray(sh.scale)
+        sh = amp.scale_history_update(sh, cfg,
+                                      jnp.asarray([np.inf, 224.0]))
+        after = np.asarray(sh.scale)
+        assert after[0] == before[0] * cfg.backoff_factor
+        assert after[1] == before[1] == 1.0
+        assert int(sh.overflow_count[0]) == 1
+        assert int(sh.overflow_count[1]) == 0
+        # the poisoned measurement never entered the history
+        assert np.isfinite(np.asarray(sh.amax_history)).all()
+
+    def test_scale_amax_carries_overflow_signal(self):
+        """The reviewed hole: NumericsState.amax is the FINITE max by
+        design (EMAs/verdicts stay usable through an overflow), so it
+        alone can never trigger the backoff — scale_amax substitutes
+        inf wherever the fold saw nonfinite elements, and THAT feed
+        backs the scale off instead of letting the poisoned step's
+        finite remainder grow it."""
+        x = jnp.asarray([3.0, np.inf, 1.0], jnp.float32)
+        ns, _ = _observe_once({"t": x})
+        assert float(ns.amax[0]) == 3.0      # finite max, by design
+        sa = np.asarray(nx.scale_amax(ns))
+        assert np.isinf(sa[0])
+        assert np.isinf(np.asarray(nx.scale_amax(ns, [0])))[0]
+        cfg = amp.ScaleHistoryConfig(window=2)
+        sh = amp.scale_history_init(cfg, n_sites=1)
+        sh = amp.scale_history_update(sh, cfg, nx.scale_amax(ns))
+        assert int(sh.overflow_count[0]) == 1
+        assert float(sh.scale[0]) == cfg.backoff_factor
+        # a clean observation routes the true amax through unchanged
+        ns2, _ = _observe_once({"t": jnp.asarray([3.0, 1.0])})
+        assert float(nx.scale_amax(ns2)[0]) == 3.0
+
+    def test_events_actions(self):
+        cfg = amp.ScaleHistoryConfig(window=1, growth_factor=2.0 ** 40)
+        sh = amp.scale_history_init(cfg, n_sites=1)
+        prev, sh = sh, amp.scale_history_update(
+            sh, cfg, jnp.asarray([2.0 ** -8]))
+        (ev,) = amp.scale_update_events(prev, sh, ("s",))
+        assert ev["kind"] == "scale_update" and ev["action"] == "grow"
+        prev, sh = sh, amp.scale_history_update(
+            sh, cfg, jnp.asarray([1e5]))
+        (ev,) = amp.scale_update_events(prev, sh, ("s",))
+        assert ev["action"] == "shrink"
+        prev, sh = sh, amp.scale_history_update(
+            sh, cfg, jnp.asarray([np.inf]))
+        (ev,) = amp.scale_update_events(prev, sh, ("s",))
+        # the window records the previous max on an overflow step (the
+        # poisoned measurement never enters the history), so the event
+        # gauge is that recorded — finite — value
+        assert ev["action"] == "backoff" and ev["amax"] == 1e5
+        prev, sh = sh, amp.scale_history_update(
+            sh, cfg, jnp.asarray([np.inf]))
+        assert amp.scale_update_events(prev, sh, ("s",),
+                                       include_holds=False)
+        evs = amp.scale_update_events(prev, prev, ("s",),
+                                      include_holds=True)
+        assert evs and evs[0]["action"] == "hold"
+
+
+# --- the amp hook + opt-level parity sweep ------------------------------------
+
+class TestAmpHook:
+    def _run(self, opt_level, observe, steps=5):
+        import optax
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(16, 4).astype("float32")
+                                   * 0.1),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        x = jnp.asarray(rng.randn(8, 16).astype("float32"))
+        y = jnp.asarray(rng.randn(8, 4).astype("float32"))
+        amp_opt, state = amp.initialize(params, optax.sgd(0.05),
+                                        opt_level, verbosity=0)
+
+        def loss_fn(mp, x, y):
+            return jnp.mean(jnp.square(x @ mp["w"] + mp["b"] - y))
+
+        ncfg = nx.NumericsConfig(check_every=2)
+        ns = nx.numerics_init(
+            ncfg, sites=amp_opt.numerics_sites(state.params))
+
+        if observe:
+            @jax.jit
+            def step(state, ns, x, y):
+                state, loss, fin, ns = amp_opt.step(
+                    state, loss_fn, x, y, numerics=(ns, ncfg))
+                return state, ns, loss
+        else:
+            @jax.jit
+            def step(state, ns, x, y):
+                state, loss, fin = amp_opt.step(state, loss_fn, x, y)
+                return state, ns, loss
+
+        losses = []
+        for _ in range(steps):
+            state, ns, loss = step(state, ns, x, y)
+            losses.append(np.asarray(loss).tobytes())
+        return losses, jax.device_get(state.params), ns
+
+    @pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+    def test_trajectory_bit_identical_observed_vs_not(self, opt_level):
+        """The zero-dispatch claim enforced at the TRAJECTORY level:
+        every opt level's losses and params are bitwise identical with
+        the numerics fold on vs off — observation reads, never
+        feeds back."""
+        l_obs, p_obs, ns = self._run(opt_level, observe=True)
+        l_ref, p_ref, _ = self._run(opt_level, observe=False)
+        assert l_obs == l_ref
+        for k in p_ref:
+            assert np.array_equal(np.asarray(p_obs[k]),
+                                  np.asarray(p_ref[k])), (opt_level, k)
+        assert int(ns.check_count) == 3          # steps 0, 2, 4
+
+    def test_numerics_sites_naming(self):
+        import optax
+        params = {"w": jnp.ones((2, 2))}
+        amp_opt, _ = amp.initialize(params, optax.sgd(0.1), "O2",
+                                    verbosity=0)
+        sites = amp_opt.numerics_sites(params)
+        assert sites == ("amp/cast/['w']", "amp/grads/['w']",
+                         "amp/update/['w']")
+
+    def test_step_returns_grow_with_guard(self):
+        import optax
+        from apex_tpu import guard
+        params = {"w": jnp.ones((4, 2), jnp.float32)}
+        gcfg = guard.GuardConfig(window=8, min_history=2)
+        amp_opt, state = amp.initialize(params, optax.sgd(0.1), "O2",
+                                        verbosity=0)
+        ncfg = nx.NumericsConfig()
+        ns = nx.numerics_init(ncfg,
+                              sites=amp_opt.numerics_sites(params))
+
+        def lf(mp):
+            return jnp.mean(jnp.square(mp["w"]))
+
+        ret = amp_opt.step(state, lf, numerics=(ns, ncfg))
+        assert len(ret) == 4 and isinstance(ret[3], nx.NumericsState)
+        gs = guard.guard_init(gcfg)
+        ret = amp_opt.step(state, lf, guard=(gs, gcfg),
+                           numerics=(ns, ncfg))
+        assert len(ret) == 5 and isinstance(ret[4], nx.NumericsState)
+        # update-to-weight folded for the committed delta
+        rep = nx.precision_report(ret[4],
+                                  amp_opt.numerics_sites(params))
+        uw = {r.site: r.uw_ratio for r in rep.rows}
+        assert uw["amp/update/['w']"] is not None
+
+    def test_guard_lr_backoff_does_not_skew_grad_telemetry(self):
+        """The amp/grads site observes the UNSCALED fp32 grads: the
+        guard's lr_scale damping is a response, not a property of the
+        gradients — telemetry must read the same with or without a
+        guard threaded (a 0.5 backoff would otherwise shift every
+        grad site's measured range by a binade)."""
+        import optax
+        from apex_tpu import guard
+        params = {"w": jnp.full((4, 2), 2.0, jnp.float32)}
+        gcfg = guard.GuardConfig(window=8, min_history=2)
+        amp_opt, state = amp.initialize(params, optax.sgd(0.1), "O2",
+                                        verbosity=0)
+        sites = amp_opt.numerics_sites(params)
+        ncfg = nx.NumericsConfig()
+
+        def lf(mp):
+            return jnp.mean(jnp.square(mp["w"]))
+
+        ns0 = nx.numerics_init(ncfg, sites=sites)
+        gs = guard.guard_init(gcfg)._replace(
+            lr_scale=jnp.float32(0.25))
+        *_, ns_guarded = amp_opt.step(state, lf, guard=(gs, gcfg),
+                                      numerics=(ns0, ncfg))
+        *_, ns_plain = amp_opt.step(state, lf, numerics=(ns0, ncfg))
+        gi = sites.index("amp/grads/['w']")
+        assert float(ns_guarded.amax[gi]) == float(ns_plain.amax[gi])
+
+
+# --- the advisor (roofline what-if join) --------------------------------------
+
+class TestAdvisor:
+    def _roofline(self):
+        from apex_tpu.prof.roofline import RooflineReport, RooflineRow
+
+        def row(name, scope, dtype, flops, nbytes, measured,
+                peak=1e12, bw=1e11):
+            compute = flops / peak * 1e6
+            memory = nbytes / bw * 1e6
+            return RooflineRow(
+                name=name, opcode="dot", family="gemm", scope=scope,
+                flops=flops, bytes=nbytes, occurrences=1,
+                measured_us=measured, compute_us=compute,
+                memory_us=memory,
+                bound="compute" if compute >= memory else "memory",
+                dtype=dtype, shape=f"{dtype}[128,128]")
+
+        rows = [row("dot.1", "encoder/mlp/dense", "bf16",
+                    flops=2e9, nbytes=1e6, measured=2500.0),
+                row("dot.2", "encoder/attn/qk", "f32",
+                    flops=1e8, nbytes=8e6, measured=100.0)]
+        return RooflineReport(rows=rows, device_kind="test",
+                              peak_flops=1e12, hbm_bw=1e11,
+                              profile_total_us=0.0,
+                              module_total_us=0.0, module_runs=0)
+
+    def test_what_if_column(self):
+        rep = self._roofline()
+        out = rep.what_if({"mlp/dense": "fp8_e4m3"})
+        (w,) = out
+        assert w["dtype_from"] == "bf16" and w["dtype_to"] == "fp8_e4m3"
+        # halving the element width halves both bounds in this model
+        assert w["whatif_attainable_us"] == pytest.approx(
+            w["attainable_us"] / 2, rel=1e-3)
+        assert w["whatif_gain_us"] > 0
+        # a target not narrower than the current dtype yields no row
+        assert rep.what_if({"mlp/dense": "bf16"}) == []
+        with pytest.raises(ValueError):
+            rep.what_if({"mlp/dense": "fp13"})
+
+    def test_advisor_ranks_by_gain_times_safety(self):
+        rng = np.random.RandomState(6)
+        ns, sites = _observe_once({
+            "mlp/dense": _signed_pow2(rng, -3, 3),
+            "attn/qk": _signed_pow2(rng, -3, 3)})
+        verdicts = nx.precision_report(ns, sites)
+        ranked = nx.placement_advisor(self._roofline(), verdicts)
+        assert ranked
+        # the mlp row has the larger what-if gain — it ranks first
+        assert ranked[0]["site"] == "mlp/dense"
+        assert ranked[0]["rank_score"] >= ranked[-1]["rank_score"]
+        assert set(ranked[0]) >= {"required_dtype",
+                                  "recommended_scale",
+                                  "numeric_safety",
+                                  "verdict_fingerprint"}
+
+    def test_advisor_skips_unsafe_sites(self):
+        rng = np.random.RandomState(7)
+        ns, sites = _observe_once({
+            "mlp/dense": _signed_pow2(rng, -18, 18)})   # needs bf16
+        verdicts = nx.precision_report(ns, sites,
+                                       current_dtypes="float16")
+        # the site is a surprise at fp16 — never a placement candidate
+        assert nx.placement_advisor(self._roofline(), verdicts) == []
+
+
+# --- the numerics channel + schema --------------------------------------------
+
+def _lines(events):
+    return [json.dumps(e) for e in events]
+
+
+_CHECK_EV = {"kind": "numerics_check", "rank": 0, "step": 4,
+             "check_count": 2, "site": "grads/['w']", "n_sites": 3,
+             "amax": 1.5, "amin": 1e-6, "underflow_frac": 0.01,
+             "overflow_frac": 0.0, "zero_frac": 0.25,
+             "nonfinite_frac": 0.0, "uw_ratio": 0.001}
+_SCALE_EV = {"kind": "scale_update", "rank": 0, "step": 4,
+             "site": "grads/['w']", "action": "grow", "scale": 256.0,
+             "prev_scale": 128.0, "amax": 0.5}
+_VERDICT_EV = {"kind": "precision_verdict", "rank": 0, "step": None,
+               "site": "grads/['w']", "site_kind": "grads",
+               "required_dtype": "fp8_e4m3", "current_dtype": "fp32",
+               "predicted_underflow_frac": 0.0,
+               "predicted_saturation_frac": 0.0,
+               "recommended_scale": 256.0, "amax": 0.5, "ok": True,
+               "fingerprint": "numerics|grads|grads/['w']"}
+
+
+class TestNumericsSchema:
+    def _check(self, lines):
+        from scripts.check_metrics_schema import check_numerics_lines
+        return check_numerics_lines(lines)
+
+    def test_valid_stream(self):
+        assert self._check(_lines([_CHECK_EV, _SCALE_EV,
+                                   _VERDICT_EV])) == []
+
+    def test_aggregate_row_nullable_site(self):
+        ev = dict(_CHECK_EV, site=None, amax=None, amin=None,
+                  underflow_frac=None, overflow_frac=None,
+                  uw_ratio=None)
+        assert self._check(_lines([ev])) == []
+
+    def test_unknown_kind_rejected(self):
+        errs = self._check(_lines([dict(_CHECK_EV,
+                                        kind="numerics_meow")]))
+        assert errs and "kind" in errs[0]
+
+    def test_missing_required_key_rejected(self):
+        ev = dict(_VERDICT_EV)
+        del ev["fingerprint"]
+        assert any("fingerprint" in e
+                   for e in self._check(_lines([ev])))
+
+    def test_fraction_out_of_range_rejected(self):
+        assert self._check(_lines([dict(_CHECK_EV,
+                                        underflow_frac=1.5)]))
+        assert self._check(_lines([dict(
+            _VERDICT_EV, predicted_saturation_frac=-0.1)]))
+
+    def test_bad_action_rejected(self):
+        assert self._check(_lines([dict(_SCALE_EV, action="explode")]))
+
+    def test_bad_format_rejected(self):
+        assert self._check(_lines([dict(_VERDICT_EV,
+                                        required_dtype="fp12")]))
+        assert self._check(_lines([dict(_VERDICT_EV,
+                                        current_dtype="int8")]))
+
+    def test_nonpositive_scale_rejected(self):
+        assert self._check(_lines([dict(_SCALE_EV, scale=0.0)]))
+        assert self._check(_lines([dict(_VERDICT_EV,
+                                        recommended_scale=-2.0)]))
+
+    def test_null_site_on_scale_update_rejected(self):
+        assert self._check(_lines([dict(_SCALE_EV, site=None)]))
+
+    def test_nonfinite_number_rejected(self):
+        line = json.dumps(dict(_CHECK_EV, amax=1.0)) \
+            .replace("1.0", "Infinity")
+        assert self._check([line])
+
+    def test_nonbool_ok_rejected(self):
+        assert self._check(_lines([dict(_VERDICT_EV, ok="yes")]))
+
+    def test_library_emission_validates(self):
+        rng = np.random.RandomState(8)
+        ns, sites = _observe_once({"grads": {
+            "w": _signed_pow2(rng, -6, 2, n=64)}})
+        evs = nx.check_events(ns, sites, current_dtype="bfloat16")
+        evs += nx.precision_report(
+            ns, sites, current_dtypes="float32").to_events()
+        assert self._check(_lines(evs)) == []
+
+    def test_logger_channel_round_trip(self, tmp_path):
+        from apex_tpu import monitor
+        out = tmp_path / "numerics.jsonl"
+        logger = monitor.MetricsLogger(
+            sinks=[], numerics_sink=monitor.JSONLSink(str(out)))
+        logger.record_numerics(dict(_CHECK_EV, amax=float("nan")))
+        logger.close()
+        with open(out) as f:
+            rec = json.loads(f.read())
+        assert rec["amax"] is None               # non-finite nulled
+        with open(out) as f:
+            assert self._check(f) == []
+
+
+class TestChannelRegistry:
+    """The MetricsLogger registry refactor: every channel is one
+    declarative row; numerics is the 10th."""
+
+    def test_ten_channels_numerics_last(self):
+        from apex_tpu import monitor
+        names = [c.name for c in monitor.CHANNELS]
+        assert len(names) == 10 and names[-1] == "numerics"
+
+    def test_registry_kinds_match_schema_registry(self):
+        from apex_tpu import monitor
+        from scripts.check_metrics_schema import SCHEMAS
+        for spec in monitor.CHANNELS:
+            assert tuple(SCHEMAS[spec.name].kinds) == tuple(spec.kinds)
+
+    def test_unknown_sink_kwarg_refused(self):
+        from apex_tpu import monitor
+        with pytest.raises(TypeError):
+            monitor.MetricsLogger(sinks=[], bogus_sink=None)
+
+    def test_every_record_method_exists(self):
+        from apex_tpu import monitor
+        logger = monitor.MetricsLogger(sinks=[])
+        for spec in monitor.CHANNELS:
+            assert callable(getattr(logger, spec.method))
+        logger.close()
+
+
+class TestCompileCheck:
+    def test_numerics_case_runs_green(self):
+        from apex_tpu.ops import compile_check as cc
+        assert cc.run(pattern="numerics")
