@@ -25,6 +25,12 @@ Plus the runtime performance observatory (docs/monitoring.md#goodput):
   collectives per mesh axis into a MEASURED
   :class:`apex_tpu.lint.mesh_model.MeshModel`
   (``scripts/link_probe.py``);
+- :mod:`~apex_tpu.monitor.comm_drift` — plan-vs-measured per-hop comm
+  drift: times each :class:`apex_tpu.parallel.CommPlan` hop (or joins
+  the pod observatory's measured wire times) against the plan's
+  α–β-predicted ``hop_seconds`` and flags a stale link model with a
+  re-linkbench trigger (``scripts/pod_audit.py --cpu8``;
+  docs/tracing.md#podview);
 - :mod:`~apex_tpu.monitor.numerics` — the numerics observatory
   (docs/numerics.md): per-tensor dynamic-range telemetry
   (:class:`NumericsState` carried through the step like GuardState),
@@ -35,6 +41,9 @@ Plus the runtime performance observatory (docs/monitoring.md#goodput):
 """
 
 from apex_tpu.monitor.check import module_count_and_host_ops
+from apex_tpu.monitor.comm_drift import (CommDriftReport, HopDrift,
+                                         compare as compare_comm_drift,
+                                         measure_hops, wire_from_pod)
 from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
                                           collective_bytes,
                                           collective_bytes_by_dtype,
@@ -72,4 +81,6 @@ __all__ = [
     "GoodputLedger", "StepLedger", "BUCKETS", "classify_span",
     "LinkFit", "LinkSample", "calibrate", "fit_alpha_beta",
     "linkfit_events", "sweep_axis",
+    "CommDriftReport", "HopDrift", "compare_comm_drift",
+    "measure_hops", "wire_from_pod",
 ]

@@ -127,9 +127,10 @@ def collective_bytes_by_hop(hlo_text: str) -> Dict[str, Dict[str, int]]:
     the compiled program), so a hierarchical step shows its per-hop
     dtype split — int8 inside the slice, bf16-or-int8 across — while a
     flat sync reports everything ``unattributed``. This is the static
-    complement of the goodput ledger's ``exposed_comm`` bucket: the
-    ledger measures how much collective time a step exposed, this says
-    which link class and wire dtype the bytes behind it rode."""
+    complement of the goodput ledger's exposed-collective buckets
+    (``comm_skew`` + ``comm_wire``): the ledger measures how much
+    collective time a step exposed, this says which link class and
+    wire dtype the bytes behind it rode."""
     out: Dict[str, Dict[str, int]] = {}
     for _prefix, dt, nbytes, scope in _iter_collective_rows(hlo_text):
         slot = out.setdefault(scope_hop(scope), {})

@@ -13,8 +13,13 @@ guard action events — into one per-step ledger of named buckets:
 bucket                  what lands in it
 ======================  ======================================================
 ``compute``             dispatch + device wait of the step program itself
-``exposed_comm``        host spans tagged ``kind="collective"`` (a collective
-                        the scheduler could not hide behind compute)
+``comm_skew``           wait-for-laggard share of exposed collectives —
+                        joined from the pod observatory's cross-rank
+                        entry-skew measurement (``note_pod_skew``; zero
+                        without pod data)
+``comm_wire``           host spans tagged ``kind="collective"`` (a collective
+                        the scheduler could not hide behind compute), minus
+                        any joined skew — the share the fabric actually took
 ``input_wait``          data loading / host input spans (``data/*``,
                         ``input/*``, ``load*``)
 ``host_callback``       host fetches and callbacks (``fetch*``, ``host/*``,
@@ -76,9 +81,13 @@ from typing import Callable, Dict, List, Optional, Tuple
 __all__ = ["BUCKETS", "GoodputLedger", "StepLedger", "classify_span"]
 
 #: the ledger's bucket names, report order. ``compute`` is the goodput
-#: numerator; ``other`` is the residual no span covered.
-BUCKETS = ("compute", "exposed_comm", "input_wait", "host_callback",
-           "ckpt_stall", "recompile", "guard_rewind", "other")
+#: numerator; ``other`` is the residual no span covered. ``comm_skew``
+#: + ``comm_wire`` together are the exposed-communication time the
+#: pre-podview ledger reported as one ``exposed_comm`` bucket
+#: (:attr:`StepLedger.exposed_comm` keeps that sum readable).
+BUCKETS = ("compute", "comm_skew", "comm_wire", "input_wait",
+           "host_callback", "ckpt_stall", "recompile", "guard_rewind",
+           "other")
 
 #: span-name prefixes per bucket (checked before the kind rules; first
 #: match wins, longest prefix first at classify time)
@@ -97,7 +106,10 @@ def classify_span(name: str, kind: str = "span") -> str:
     """Bucket for one span: the span ``kind`` ("collective"/"compile")
     takes precedence, then the name-prefix table, else ``compute``."""
     if kind == "collective":
-        return "exposed_comm"
+        # the span sweep cannot see cross-rank entry skew; collective
+        # time lands in comm_wire and note_pod_skew moves the measured
+        # wait-for-laggard share to comm_skew after the fact
+        return "comm_wire"
     if kind == "compile":
         return "recompile"
     for prefix, bucket in _NAME_PREFIXES:
@@ -122,6 +134,12 @@ class StepLedger:
     def attributed_ms(self) -> float:
         """Span-covered milliseconds (everything but ``other``)."""
         return sum(v for k, v in self.buckets.items() if k != "other")
+
+    @property
+    def exposed_comm(self) -> float:
+        """Total exposed-collective milliseconds — the pre-podview
+        single bucket, now the ``comm_skew + comm_wire`` sum."""
+        return self.buckets["comm_skew"] + self.buckets["comm_wire"]
 
     @property
     def goodput_frac(self) -> Optional[float]:
@@ -215,7 +233,8 @@ class GoodputLedger:
         # stalls joined from event channels, waiting for their step:
         # {step (or None=next): ms}
         self._pending: Dict[str, Dict] = {"ckpt_stall": {},
-                                          "guard_rewind": {}}
+                                          "guard_rewind": {},
+                                          "comm_skew": {}}
         if tracer is not None:
             tracer.subscribe(self.on_step)
 
@@ -253,6 +272,17 @@ class GoodputLedger:
         self._note("guard_rewind", event.get("dur_ms") or 0.0,
                    event.get("step"))
 
+    def note_pod_skew(self, skew_ms: float,
+                      step: Optional[int] = None) -> None:
+        """Join this rank's pod-measured wait-for-laggard milliseconds
+        (``PodTimeline.rank_step_skew()[rank, step]``) into the
+        matching step's ``comm_skew`` bucket. The move comes OUT of
+        ``comm_wire`` only (a skew claim larger than the measured
+        collective time is clamped — pod blame can reclassify exposed
+        collective time, never invent it), so the bucket sum still
+        closes over wall time exactly."""
+        self._note("comm_skew", skew_ms, step)
+
     def _take_pending(self, bucket: str, step: Optional[int]) -> float:
         pend = self._pending[bucket]
         ms = pend.pop(step, 0.0) if step is not None else 0.0
@@ -273,7 +303,11 @@ class GoodputLedger:
         buckets = _attribute(st.spans, wall, self.classify)
         covered = sum(buckets.values())
         buckets["other"] += max(wall - covered, 0.0)
-        for bucket in ("ckpt_stall", "guard_rewind"):
+        for bucket, donors in (("ckpt_stall", ("other", "compute")),
+                               ("guard_rewind", ("other", "compute")),
+                               # pod skew only reclassifies exposed
+                               # collective time — see note_pod_skew
+                               ("comm_skew", ("comm_wire",))):
             joined = self._take_pending(bucket, st.step)
             # a joined stall MOVES measured time, never invents it —
             # the sum still closes over wall. Drain the residual first:
@@ -281,7 +315,7 @@ class GoodputLedger:
             # case) is sitting in `other` by construction, and only a
             # stall that overlapped the dispatch window should come out
             # of compute.
-            for donor in ("other", "compute"):
+            for donor in donors:
                 if joined <= 0:
                     break
                 take = min(joined, buckets[donor])

@@ -128,6 +128,12 @@ CHANNELS: Tuple[ChannelSpec, ...] = (
                 why_unbuffered="scale backoffs and precision verdicts "
                 "are rare and may immediately precede the overflow "
                 "skip they explain"),
+    ChannelSpec("podview", ("pod_align", "pod_skew", "pod_drift"),
+                "record_podview", True,
+                why_unbuffered="pod merges and drift reports are rare "
+                "offline/audit joins, and a skew-blame record may "
+                "immediately precede the straggler escalation it "
+                "explains (an unaligned rank's residual is null)"),
 )
 
 def _null_nonfinite(rec: Dict, nested: bool) -> None:
@@ -175,7 +181,7 @@ class MetricsLogger:
     Beyond the buffered metrics stream, the logger carries one
     **unbuffered event channel per** :data:`CHANNELS` **row** — pass
     ``{name}_sink=`` (``trace_sink=``, ``guard_sink=``, …,
-    ``numerics_sink=``) and feed events through the matching
+    ``podview_sink=``) and feed events through the matching
     ``record_*`` method; each channel's stream validates under
     ``check_metrics_schema.py --kind {name}``. Events never mix with
     the metrics wire format. Adding a channel is one registry row, not
@@ -369,7 +375,8 @@ class MetricsLogger:
     # -- event channels ------------------------------------------------------
     # record_event / record_memory / record_lint / record_ckpt /
     # record_guard / record_goodput / record_roofline / record_cluster /
-    # record_integrity / record_numerics are generated from the CHANNELS
+    # record_integrity / record_numerics / record_podview are generated
+    # from the CHANNELS
     # registry after the class body — one declarative row per channel,
     # not one 30-line clone. Typical wirings (see each subsystem's
     # docs): ``tracer.subscribe(lambda st: logger.record_event(

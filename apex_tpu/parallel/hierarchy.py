@@ -195,17 +195,25 @@ class CommPlan:
         return sum(h.wire_bytes(e, self.compress_block)
                    for h, e in zip(self.hops, self._hop_elems(elems)))
 
+    def hop_seconds(self, grad_bytes: Optional[int] = None
+                    ) -> List[float]:
+        """α–β-predicted seconds per hop, plan order, for one full
+        sync of ``grad_bytes`` (defaults to the planned payload) —
+        the join key :mod:`apex_tpu.monitor.comm_drift` compares
+        measured wire times against."""
+        nbytes = grad_bytes if grad_bytes is not None else \
+            (self.grad_bytes or 0)
+        elems = nbytes // 4
+        return [h.seconds(e, self.compress_block)
+                for h, e in zip(self.hops, self._hop_elems(elems))]
+
     def predicted_seconds(self, grad_bytes: Optional[int] = None
                           ) -> Dict[str, float]:
         """Predicted seconds per link class for one full sync of
         ``grad_bytes`` (defaults to the planned payload)."""
-        nbytes = grad_bytes if grad_bytes is not None else \
-            (self.grad_bytes or 0)
-        elems = nbytes // 4
         out: Dict[str, float] = {}
-        for h, e in zip(self.hops, self._hop_elems(elems)):
-            out[h.link] = out.get(h.link, 0.0) + \
-                h.seconds(e, self.compress_block)
+        for h, s in zip(self.hops, self.hop_seconds(grad_bytes)):
+            out[h.link] = out.get(h.link, 0.0) + s
         return out
 
     def describe(self) -> str:

@@ -79,6 +79,16 @@ METRICS: Tuple[MetricSpec, ...] = (
                "lower", rel_floor=0.02),
     MetricSpec("goodput_frac", ("extra", "goodput_frac"), "higher",
                rel_floor=0.10),
+    # the pod observatory columns (bench _pod_row; the merge/blame/
+    # drift math behind them is asserted by scripts/pod_audit.py).
+    # Floors are generous: skew gauges run-to-run jitter in single-ms,
+    # and drift ratios on an emulated fabric swing with load
+    MetricSpec("pod_goodput", ("extra", "pod_goodput"), "higher",
+               rel_floor=0.10),
+    MetricSpec("comm_skew_p99", ("extra", "comm_skew_p99"), "lower",
+               rel_floor=0.50, abs_floor=5.0),
+    MetricSpec("comm_drift_ratio", ("extra", "comm_drift_ratio"),
+               "lower", rel_floor=0.50, abs_floor=2.0),
     MetricSpec("lint_errors", ("extra", "lint_errors"), "lower",
                counter=True),
     MetricSpec("lint_spmd_errors", ("extra", "lint_spmd_errors"),

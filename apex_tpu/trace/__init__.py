@@ -30,12 +30,23 @@ artifacts. See docs/tracing.md. Four pieces:
   laggards (median-lag z-score with hysteresis) with the slowest span
   class on the lagging rank — the early-warning tier below the
   watchdog's hard stall deadline
-  (:meth:`HangWatchdog.early_warning`).
+  (:meth:`HangWatchdog.early_warning`);
+- **pod observatory** (:mod:`~apex_tpu.trace.podview`): merges N
+  ranks' span streams onto one clock (least-squares offsets over
+  shared collective exits), splits every collective into
+  wait-for-laggard vs wire time with (rank, span) blame, extracts the
+  per-step cross-rank critical path, and exports a labeled merged
+  Perfetto trace + ``podview``-channel events
+  (``scripts/pod_audit.py --cpu8``; docs/tracing.md#podview).
 """
 
 from apex_tpu.trace.debug_nans import (debug_nans, debug_nans_enabled,
                                        first_nan, nan_probe,
                                        reset_nan_state)
+from apex_tpu.trace.podview import (ClockAlignment, CollectiveSkew,
+                                    PodSpan, PodTimeline, RankClock,
+                                    RankTimeline, align_clocks,
+                                    load_span_events)
 from apex_tpu.trace.recorder import FlightRecorder, StepRecord, rank_path
 from apex_tpu.trace.spans import (SpanEvent, StepTimeline, StepTrace,
                                   Tracer, current_tracer, span, step)
@@ -51,6 +62,9 @@ __all__ = [
     "HangWatchdog",
     "HeartbeatWriter", "StragglerDetector", "StragglerReport",
     "StragglerWatch", "read_heartbeats",
+    "PodSpan", "PodTimeline", "RankTimeline", "RankClock",
+    "ClockAlignment", "CollectiveSkew", "align_clocks",
+    "load_span_events",
     "debug_nans", "debug_nans_enabled", "nan_probe", "first_nan",
     "reset_nan_state",
 ]

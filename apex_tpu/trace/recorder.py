@@ -15,7 +15,8 @@ report on any abnormal exit:
 
 The dump is one header line (``kind="crash"``: reason, rank, hostname,
 pid, last-completed span, in-flight spans, in-flight collective,
-exception + traceback) followed by one ``kind="step"`` line per buffered
+recent guard interventions and straggler blame reports, exception +
+traceback) followed by one ``kind="step"`` line per buffered
 step — the schema ``scripts/check_metrics_schema.py --kind trace``
 validates. On multi-host runs every rank records independently;
 :func:`rank_path` (used automatically for directory paths) keeps the
@@ -164,6 +165,12 @@ class FlightRecorder:
         # the run was already skipping/rewinding before it died
         self._guard_events: "collections.deque[Dict]" = collections.deque(
             maxlen=16)
+        # bounded ring of straggler reports (note_straggler) — a hang
+        # or collective timeout is routinely PRECEDED by one rank
+        # lagging; the dump must name that rank and its slowest span,
+        # not just this rank's heartbeat view
+        self._straggler_reports: "collections.deque[Dict]" = \
+            collections.deque(maxlen=16)
         self._installed = False
         self._dumped = False
         self._abnormal_seen = False
@@ -245,6 +252,18 @@ class FlightRecorder:
         raises."""
         try:
             self._guard_events.append(dict(event))
+        except Exception:
+            pass
+
+    def note_straggler(self, event: Dict) -> None:
+        """Record one ``kind="straggler"`` event (the detector's
+        span-level blame: lagging rank, z, slowest span + its goodput
+        class) for crash forensics — wire
+        ``StragglerWatch(recorder=...)``. The newest 16 land in the
+        crash header as ``straggler_reports``. No device access,
+        never raises."""
+        try:
+            self._straggler_reports.append(dict(event))
         except Exception:
             pass
 
@@ -331,6 +350,8 @@ class FlightRecorder:
             hdr["memory_report"] = self.memory_report
         if self._guard_events:
             hdr["guard_events"] = list(self._guard_events)
+        if self._straggler_reports:
+            hdr["straggler_reports"] = list(self._straggler_reports)
         from apex_tpu.trace.debug_nans import first_nan
         hit = first_nan()
         if hit is not None:

@@ -357,16 +357,21 @@ class StragglerWatch:
     :meth:`~apex_tpu.trace.HangWatchdog.early_warning` — alerting tier
     only, never the escalation path (``on_stall`` stays the hard
     deadline's). Re-reports a still-lagging rank at most once per
-    ``renotify_s``."""
+    ``renotify_s``. ``recorder`` additionally feeds every report to
+    :meth:`apex_tpu.trace.FlightRecorder.note_straggler`, so a later
+    crash dump's header names the rank (and span) the pod was already
+    waiting on — the renotify debounce does NOT apply there: the ring
+    is bounded and forensics want the freshest picture."""
 
     def __init__(self, detector: StragglerDetector, *,
                  poll_s: float = 5.0, watchdog=None,
                  event_sink: Optional[Callable[[Dict], None]] = None,
-                 renotify_s: float = 60.0):
+                 renotify_s: float = 60.0, recorder=None):
         self.detector = detector
         self.poll_s = float(poll_s)
         self.watchdog = watchdog
         self.event_sink = event_sink
+        self.recorder = recorder
         self.renotify_s = float(renotify_s)
         self._last_notified: Dict[int, float] = {}
         self._stop = threading.Event()
@@ -377,6 +382,10 @@ class StragglerWatch:
         reports = self.detector.check()
         now = time.monotonic()
         for rep in reports:
+            if self.recorder is not None:
+                # undebounced: the crash-header ring wants every fresh
+                # report, not one per renotify window
+                self.recorder.note_straggler(rep.to_event())
             last = self._last_notified.get(rep.rank)
             if last is not None and now - last < self.renotify_s:
                 continue

@@ -24,10 +24,16 @@ single-device hosts skip), ``roofline_worst_gap`` (the headline step's
 worst measured-vs-attainable per-op gap — apex_tpu.prof.roofline; the
 fingerprinted autotuner candidate, measured on TPU / AOT-only
 classification elsewhere), ``n_autotune_compiles`` (the autotune-origin
-subset of ``n_compiles`` — prof.compile_watch.autotune_scope), and
-``sentinel_regressions`` (the noise-aware perf-regression gate's
-verdict on this row vs the committed BENCH_r0*.json trajectory —
-apex_tpu.prof.sentinel / ``scripts/perf_sentinel.py``).
+subset of ``n_compiles`` — prof.compile_watch.autotune_scope),
+``pod_goodput``/``comm_skew_p99``/``comm_drift_ratio`` (the pod
+observatory columns: goodput after the comm_skew/comm_wire split on an
+emulated pod merge, the p99 collective entry skew, and the worst
+plan-vs-measured hop drift — apex_tpu.trace.podview /
+apex_tpu.monitor.comm_drift, asserted by
+``scripts/pod_audit.py --cpu8``), and ``sentinel_regressions`` (the
+noise-aware perf-regression gate's verdict on this row vs the
+committed BENCH_r0*.json trajectory — apex_tpu.prof.sentinel /
+``scripts/perf_sentinel.py``).
 
 ``python bench.py --all`` additionally measures the full BASELINE.md
 config table (fp32/O0, O2, SyncBN, DCGAN multi-loss, BERT-Large LAMB)
@@ -877,6 +883,98 @@ def _goodput_row(batch: int, size: int, steps: int = 4):
                            for k, v in ledger.steps[-1].buckets.items()}}
 
 
+def _pod_row(n_ranks: int = 4, steps: int = 3):
+    """The ``pod_goodput`` / ``comm_skew_p99`` / ``comm_drift_ratio``
+    columns (apex_tpu.trace.podview + apex_tpu.monitor.comm_drift;
+    the merge/blame/drift math is asserted by
+    ``scripts/pod_audit.py --cpu8``, this row measures it live).
+
+    The pod is EMULATED on this one host: the same tiny
+    collective-tagged step runs ``n_ranks`` times, each run's span
+    stream tagged as one rank on its own Tracer clock origin, then
+    merged exactly as real per-rank streams would be — so the skew
+    columns gauge the pipeline plus real run-to-run jitter
+    (single-digit ms), not cross-host laggards; multi-host runs feed
+    the same join with real ranks. ``comm_drift_ratio`` is fully
+    measured: linkbench calibrates the local mesh, plan_comm schedules
+    against it, and measure_hops times each hop (worst symmetric
+    measured/predicted ratio — 1.0 means the link model holds)."""
+    from jax.sharding import Mesh
+
+    from apex_tpu import monitor, trace
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    from apex_tpu.parallel import plan_comm
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 256),
+                          jnp.float32)
+    step_fn = jax.jit(lambda a: jnp.tanh(a @ a))
+    jax.block_until_ready(step_fn(w))     # warm: compile outside spans
+
+    events, tracers = [], []
+    for r in range(n_ranks):
+        tracer = trace.Tracer()
+        with tracer:
+            for i in range(steps):
+                with trace.step(i):
+                    with trace.span("dispatch"):
+                        out = step_fn(w)
+                    with trace.span("grad/sync", kind="collective"):
+                        jax.block_until_ready(out)
+        events.extend(tracer.span_events(rank=r))
+        tracers.append(tracer)
+
+    pod = trace.PodTimeline.merge(events)
+    skews = sorted(c.skew_ms for c in pod.collective_skew())
+    p99 = (skews[min(int(len(skews) * 0.99), len(skews) - 1)]
+           if skews else None)
+
+    # re-fold rank 0's steps with the pod-measured skew joined, so
+    # pod_goodput is the fraction AFTER the comm_skew/comm_wire split
+    ledger = monitor.GoodputLedger()
+    for (r, s), ms in sorted(pod.rank_step_skew().items(),
+                             key=lambda kv: (kv[0][1] or 0)):
+        if r == 0:
+            ledger.note_pod_skew(ms, step=s)
+    for st in tracers[0].steps:
+        ledger.on_step(st)
+    ok, worst = ledger.check_closure()
+    fracs = [rec.goodput_frac for rec in ledger.steps
+             if rec.goodput_frac is not None]
+    pod_goodput = sum(fracs) / len(fracs) if fracs else None
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        drift = {"skipped": "single device — a link needs two ends"}
+        ratio = None
+    else:
+        template = parse_mesh_spec(f"ici{len(devs)}")
+        mesh = Mesh(np.array(devs), ("data",))
+        model, _, _ = monitor.calibrate(mesh, template, iters=2)
+        plan = plan_comm(model, grad_bytes=1 << 20, dtypes=(None,))
+        measured = monitor.measure_hops(plan, mesh, iters=2)
+        report = monitor.compare_comm_drift(plan, measured,
+                                            tolerance=8.0)
+        ratio = round(report.drift_ratio, 3)
+        drift = {"comm_drift_ratio": ratio,
+                 "stale": report.stale,
+                 "tolerance": report.tolerance,
+                 "plan": plan.describe(),
+                 "hops": [{"hop": h.hop, "op": h.op, "link": h.link,
+                           "predicted_ms": round(h.predicted_ms, 4),
+                           "measured_ms": round(h.measured_ms, 4),
+                           "ratio": round(h.ratio, 3)}
+                          for h in report.hops]}
+    return {"pod_goodput": (round(pod_goodput, 4)
+                            if pod_goodput is not None else None),
+            "comm_skew_p99": (round(p99, 4) if p99 is not None
+                              else None),
+            "comm_drift_ratio": ratio,
+            "closure_ok": bool(ok),
+            "worst_closure_err": round(worst, 6),
+            "n_ranks": n_ranks, "emulation": "sequential-local",
+            "drift": drift}
+
+
 def _link_fit_row():
     """The ``link_fit`` column: a quick alpha-beta calibration of the
     local device mesh (apex_tpu.monitor.linkbench — the same sweep
@@ -1154,6 +1252,10 @@ def main():
         numerics = _numerics_row()
     except Exception as e:
         numerics = {"failed": type(e).__name__}
+    try:
+        pod = _pod_row()
+    except Exception as e:
+        pod = {"failed": type(e).__name__}
     # every trace/lowering/backend-compile the bench performed — a
     # steady-state regression (a step silently retracing per call)
     # shows up here as n_compiles exploding; autotune-origin compiles
@@ -1232,6 +1334,17 @@ def main():
                   # device mesh (apex_tpu.monitor.linkbench /
                   # scripts/link_probe.py; single-device hosts skip)
                   "link_fit": link_fit,
+                  # the pod observatory columns: goodput after the
+                  # comm_skew/comm_wire split on an emulated pod
+                  # merge, p99 collective entry skew, and the worst
+                  # plan-vs-measured hop drift ratio
+                  # (apex_tpu.trace.podview /
+                  # apex_tpu.monitor.comm_drift; merge/blame/drift
+                  # math asserted by scripts/pod_audit.py --cpu8)
+                  "pod_goodput": pod.get("pod_goodput"),
+                  "comm_skew_p99": pod.get("comm_skew_p99"),
+                  "comm_drift_ratio": pod.get("comm_drift_ratio"),
+                  "pod": pod,
                   "bert_large_lamb": bert,
                   "ddp_comm_modes": ddp_comm},
     }

@@ -67,13 +67,22 @@ FORMAT enum, predicted underflow/saturation fractions ∈ [0, 1], a
 positive recommended_scale, the stable ``numerics|kind|site``
 fingerprint).
 
+``--kind podview`` — the pod-observatory channel
+(``apex_tpu/trace/podview.py``, ``apex_tpu/monitor/comm_drift.py``):
+a ``pod_align`` is one rank's fitted clock model (offset/drift vs the
+reference rank; residual null when the rank shared no collective); a
+``pod_skew`` is one matched collective's wait-for-laggard vs wire
+split with its (rank, span) blame; a ``pod_drift`` is one CommPlan
+hop's plan-vs-measured row (link enum, positive milliseconds, stable
+``comm_drift|op|axis/link`` fingerprint, boolean ``stale`` flag).
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
            [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline
-                   |cluster|integrity|numerics]
+                   |cluster|integrity|numerics|podview]
            FILE
 """
 
@@ -486,7 +495,7 @@ def _guard_special(i, rec, kind, state, errors):
 # --- goodput / straggler / linkfit channel schema -----------------------------
 
 GOODPUT_KINDS = ("goodput", "straggler", "linkfit")
-GOODPUT_BUCKETS = ("compute", "exposed_comm", "input_wait",
+GOODPUT_BUCKETS = ("compute", "comm_skew", "comm_wire", "input_wait",
                    "host_callback", "ckpt_stall", "recompile",
                    "guard_rewind", "other")
 GOODPUT_LINKS = ("ici", "dcn")
@@ -762,6 +771,65 @@ def _numerics_special(i, rec, kind, state, errors):
                           f"got {ok!r}")
 
 
+# --- podview channel schema ---------------------------------------------------
+
+PODVIEW_KINDS = ("pod_align", "pod_skew", "pod_drift")
+PODVIEW_LINKS = ("ici", "dcn")
+PODVIEW_REQUIRED = {
+    "pod_align": ("rank", "offset_ms", "n_shared", "aligned",
+                  "reference", "wall_time"),
+    "pod_skew": ("name", "occurrence", "n_ranks", "skew_ms",
+                 "wire_ms", "wall_time"),
+    "pod_drift": ("hop", "op", "axis", "link", "predicted_ms",
+                  "measured_ms", "ratio", "stale", "fingerprint",
+                  "wall_time"),
+}
+PODVIEW_NULLABLE = {
+    # residual/drift are null for an unaligned rank (no shared
+    # collectives to fit against)
+    "pod_align": ("residual_ms", "drift_ppm"),
+    # blame is null when no host span overlapped the skew window
+    "pod_skew": ("step", "blamed_rank", "blamed_span"),
+    "pod_drift": ("dtype",),
+}
+
+
+def _podview_special(i, rec, kind, state, errors):
+    # offset_ms / drift_ppm are signed (a rank's clock may lead or
+    # lag the reference) — everything else numeric is a magnitude,
+    # enforced via the registry row's nonneg tuple.
+    for bk in ("aligned", "stale"):
+        v = rec.get(bk)
+        if bk in rec and not isinstance(v, bool):
+            errors.append(f"line {i}: {bk!r} must be a boolean, "
+                          f"got {v!r}")
+    if kind == "pod_align":
+        ns, al = rec.get("n_shared"), rec.get("aligned")
+        rk, ref = rec.get("rank"), rec.get("reference")
+        if (al is True and ns == 0 and rk is not None
+                and rk != ref):
+            errors.append(f"line {i}: rank {rk} claims aligned with "
+                          f"no shared collectives (only the "
+                          f"reference may)")
+    if kind == "pod_skew":
+        for sk in ("name", "blamed_span"):
+            v = rec.get(sk)
+            if sk in rec and v is not None and not isinstance(v, str):
+                errors.append(f"line {i}: {sk!r} must be a string "
+                              f"or null, got {v!r}")
+    if kind == "pod_drift":
+        for sk in ("op", "axis", "fingerprint"):
+            v = rec.get(sk)
+            if sk in rec and not isinstance(v, str):
+                errors.append(f"line {i}: {sk!r} must be a string, "
+                              f"got {v!r}")
+        r = rec.get("ratio")
+        if "ratio" in rec and r is not None and (
+                not _is_number(r) or r <= 0):
+            errors.append(f"line {i}: 'ratio' must be a positive "
+                          f"number, got {r!r}")
+
+
 # --- the channel registry -----------------------------------------------------
 
 SCHEMAS: Dict[str, ChannelSchema] = {
@@ -828,6 +896,14 @@ SCHEMAS: Dict[str, ChannelSchema] = {
                "required_dtype": NUMERICS_FORMATS,
                "current_dtype": NUMERICS_FORMATS},
         special=_numerics_special),
+    "podview": ChannelSchema(
+        PODVIEW_KINDS, PODVIEW_REQUIRED, PODVIEW_NULLABLE,
+        counters=("rank", "reference", "n_shared", "step",
+                  "occurrence", "n_ranks", "blamed_rank", "hop"),
+        nonneg=("residual_ms", "skew_ms", "wire_ms", "predicted_ms",
+                "measured_ms", "wall_time"),
+        enums={"link": PODVIEW_LINKS},
+        special=_podview_special),
 }
 
 
@@ -874,6 +950,7 @@ check_roofline_lines = _make_checker(SCHEMAS["roofline"])
 check_cluster_lines = _make_checker(SCHEMAS["cluster"])
 check_integrity_lines = _make_checker(SCHEMAS["integrity"])
 check_numerics_lines = _make_checker(SCHEMAS["numerics"])
+check_podview_lines = _make_checker(SCHEMAS["podview"])
 
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
@@ -882,7 +959,8 @@ CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "roofline": check_roofline_lines,
             "cluster": check_cluster_lines,
             "integrity": check_integrity_lines,
-            "numerics": check_numerics_lines}
+            "numerics": check_numerics_lines,
+            "podview": check_podview_lines}
 
 
 def main(argv=None) -> int:

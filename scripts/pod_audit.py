@@ -1,0 +1,442 @@
+#!/usr/bin/env python
+"""pod_audit — the asserting CI audit of the pod observatory
+(run by ``run_tier1.sh --smoke``; exit status is the verdict).
+
+Four asserted legs:
+
+(a) **deterministic skew blame**: a synthetic 4-rank pod (per-rank
+    clock offsets of ±seconds, rank 2 seeded 60 ms late inside a
+    ``data/load`` span before every collective) merges into one
+    :class:`apex_tpu.trace.PodTimeline` whose clock fit recovers the
+    injected offsets to sub-microsecond residual, and EVERY
+    collective's blame lands on exactly ``(rank 2, "data/load")`` with
+    the injected 60 ms skew / 5 ms wire split exact. The critical path
+    chains those (wait → wire) segments, a rank with no collective
+    spans merges ``aligned=False`` at offset 0 instead of silently
+    pretending, and the emitted podview events match the committed
+    ``tests/fixtures/podview_pod_audit.jsonl`` fixture — which itself
+    must validate under ``check_metrics_schema.py --kind podview``.
+
+(b) **goodput split closure**: an instrumented loop with collective
+    spans joins a pod-measured 12 ms skew per step
+    (:meth:`GoodputLedger.note_pod_skew`); the ``comm_skew`` bucket
+    gets exactly the joined milliseconds OUT of ``comm_wire`` (never
+    invented), the bucket sum still closes over wall time within 5%,
+    and an oversized skew claim is clamped to the measured collective
+    time. The stream validates under the updated ``--kind goodput``.
+
+(c) **multiprocess merge**: 4 REAL processes run traced steps whose
+    per-step collective span blocks on a shared-filesystem barrier —
+    the last arriver's write releases everyone, modeling exactly the
+    simultaneous-exit semantics the clock-alignment contract is built
+    on (XLA:CPU cannot execute cross-process collectives; the real
+    jax.distributed rendezvous path is pinned by
+    tests/test_multiproc_launch.py). Rank 2 sleeps 80 ms in
+    ``data/load`` before each barrier. The parent merges the four
+    per-rank span streams — four genuinely unrelated ``perf_counter``
+    origins — and every steady-state collective must blame
+    ``(rank 2, "data/load")`` with > 40 ms skew.
+
+(d) **plan-vs-measured comm drift**: linkbench calibrates the factored
+    dp2x4 CPU mesh into a MEASURED MeshModel; ``plan_comm`` derives
+    the 3-hop fp32 schedule; :func:`apex_tpu.monitor.measure_hops`
+    times each hop for real and :func:`compare` must agree with the
+    plan's ``hop_seconds`` within a stated 25x ratio band (α–β models
+    are order-of-magnitude instruments and XLA:CPU emulation is noisy
+    — the band pins the *pipeline*; on-chip runs tighten it). The
+    negative twin deliberately stales the model (bytes/s ÷ 10⁴) and
+    the drift flag MUST fire with stable ``comm_drift|op|axis/link``
+    fingerprints and advice naming ``scripts/link_probe.py``. The
+    drift stream validates under ``--kind podview``.
+
+Usage: JAX_PLATFORMS=cpu python scripts/pod_audit.py --cpu8
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FIXTURE = os.path.join(_REPO, "tests", "fixtures",
+                        "podview_pod_audit.jsonl")
+#: pinned wall_time for the committed fixture (2026-08-06 00:00 UTC) —
+#: the synthetic leg is deterministic, so fresh events must EQUAL the
+#: committed ones when stamped with the same clock
+_FIXTURE_WALL = 1785974400.0
+
+
+def _run_schema(path: str, kind: str) -> None:
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "check_metrics_schema.py"),
+         "--kind", kind, path],
+        capture_output=True, text=True)
+    assert r.returncode == 0, (
+        f"schema validation failed for {path}:\n{r.stdout}{r.stderr}")
+
+
+# --- leg (a): deterministic skew blame ----------------------------------------
+
+#: injected truth of the synthetic pod: rank 2 is 60 ms late into
+#: every collective, parked in data/load; the wire itself takes 5 ms
+_SLOW_RANK, _BLAMED_SPAN = 2, "data/load"
+_FAST_MS, _SLOW_MS, _WIRE_MS = 5.0, 65.0, 5.0
+_OFFSETS = {0: 0.0, 1: 1234.5, 2: -987.25, 3: 41.75}
+
+
+def synthetic_pod_events(n_steps: int = 3):
+    """The synthetic 4-rank pod's ``kind="span"`` events, each rank on
+    its own clock (local = pod − offset); plus one extra rank 4 that
+    shares NO collective (the unalignable-rank edge case)."""
+    events = []
+    for step in range(n_steps):
+        base = 1000.0 + step * 100.0        # pod-clock step start
+        exit_ms = base + _SLOW_MS + _WIRE_MS
+        for r, off in _OFFSETS.items():
+            work = _SLOW_MS if r == _SLOW_RANK else _FAST_MS
+            entry = base + work
+            events.append({"kind": "span", "name": _BLAMED_SPAN,
+                           "span_kind": "span", "step": step, "rank": r,
+                           "t_ms": base - off, "dur_ms": work,
+                           "depth": 1})
+            events.append({"kind": "span", "name": "grad/allreduce",
+                           "span_kind": "collective", "step": step,
+                           "rank": r, "t_ms": entry - off,
+                           "dur_ms": exit_ms - entry, "depth": 1})
+        events.append({"kind": "span", "name": "data/load",
+                       "span_kind": "span", "step": step, "rank": 4,
+                       "t_ms": base - 5e6, "dur_ms": _FAST_MS,
+                       "depth": 1})
+    return events
+
+
+def audit_pod_blame(tmp: str) -> None:
+    from apex_tpu import monitor, trace
+
+    print("== pod merge + collective-skew blame (synthetic 4-rank pod)")
+    n_steps = 3
+    pod = trace.PodTimeline.merge(synthetic_pod_events(n_steps))
+    assert pod.ranks == [0, 1, 2, 3, 4], pod.ranks
+
+    al = pod.alignment
+    assert al.reference == 0, al.reference
+    for r, off in _OFFSETS.items():
+        c = al.clocks[r]
+        assert c.aligned, f"rank {r} should have aligned"
+        assert abs(c.offset_ms - off) < 1e-6, (r, c.offset_ms, off)
+        assert c.residual_ms is not None and c.residual_ms < 1e-6, c
+    c4 = al.clocks[4]
+    assert not c4.aligned and c4.offset_ms == 0.0 \
+        and c4.n_shared == 0, c4
+
+    skews = pod.collective_skew()
+    assert len(skews) == n_steps, [s.name for s in skews]
+    for s in skews:
+        assert s.n_ranks == 4, s
+        assert s.blamed_rank == _SLOW_RANK, s
+        assert s.blamed_span == _BLAMED_SPAN, s
+        assert abs(s.skew_ms - (_SLOW_MS - _FAST_MS)) < 1e-6, s
+        assert abs(s.wire_ms - _WIRE_MS) < 1e-6, s
+    print(f"  {n_steps} collectives: blame (rank {_SLOW_RANK}, "
+          f"{_BLAMED_SPAN!r}), skew {skews[0].skew_ms:.1f} ms / wire "
+          f"{skews[0].wire_ms:.1f} ms, clock residual < 1e-6 ms, "
+          f"rank 4 unaligned as designed")
+
+    waits = pod.rank_step_skew()
+    for step in range(n_steps):
+        for r in _OFFSETS:
+            want = 0.0 if r == _SLOW_RANK else _SLOW_MS - _FAST_MS
+            got = waits.get((r, step), 0.0)
+            assert abs(got - want) < 1e-6, (r, step, got, want)
+
+    path = pod.critical_path(1)
+    assert [seg["segment"] for seg in path] == ["wait", "wire"], path
+    assert path[0]["rank"] == _SLOW_RANK \
+        and path[0]["span"] == _BLAMED_SPAN, path
+    print(f"  critical path (step 1): wait {path[0]['dur_ms']:.1f} ms "
+          f"on (rank {path[0]['rank']}, {path[0]['span']!r}) -> wire "
+          f"{path[1]['dur_ms']:.1f} ms")
+
+    ct = pod.chrome_trace()
+    names = {m["pid"]: m["args"]["name"]
+             for m in ct["traceEvents"]
+             if m.get("ph") == "M" and m["name"] == "process_name"}
+    assert names[0] == "rank 0" and names[4] == "rank 4 (unaligned)", \
+        names
+
+    # the podview event stream: fresh events, stamped with the
+    # fixture's pinned wall clock, must EQUAL the committed fixture
+    # (the leg is deterministic by construction), and both validate
+    events = pod.to_events(wall_time=_FIXTURE_WALL)
+    events_path = os.path.join(tmp, "podview.jsonl")
+    logger = monitor.MetricsLogger(
+        sinks=[], podview_sink=monitor.JSONLSink(events_path))
+    for ev in events:
+        logger.record_podview(ev)
+    logger.close()
+    _run_schema(events_path, "podview")
+    _run_schema(_FIXTURE, "podview")
+    committed = [json.loads(l) for l in open(_FIXTURE)]
+    assert committed == events, (
+        "fresh podview events diverge from the committed fixture "
+        "tests/fixtures/podview_pod_audit.jsonl — regenerate it via "
+        "synthetic_pod_events() or fix the regression")
+    print(f"  events validate (--kind podview) and match the "
+          f"committed fixture ({len(committed)} records)")
+
+
+# --- leg (b): goodput comm_skew/comm_wire split closure -----------------------
+
+def _traced_steps(note_skew_ms, n_steps: int = 3):
+    from apex_tpu import monitor, trace
+
+    tracer = trace.Tracer()
+    ledger = monitor.GoodputLedger(tracer, tolerance=0.05)
+    with tracer:
+        for i in range(n_steps):
+            with trace.step(i):
+                with trace.span("data/load"):
+                    time.sleep(0.002)
+                with trace.span("dispatch"):
+                    time.sleep(0.004)
+                with trace.span("grad/sync", kind="collective"):
+                    time.sleep(0.020)
+                ledger.note_pod_skew(note_skew_ms, step=i)
+    return ledger
+
+
+def audit_split_closure(tmp: str) -> None:
+    from apex_tpu import monitor
+
+    print("== goodput comm_skew/comm_wire split closure")
+    ledger = _traced_steps(12.0)
+    ok, worst = ledger.check_closure(tolerance=0.05)
+    assert ok, f"bucket sum no longer closes after the split: {worst}"
+    for rec in ledger.steps:
+        b = rec.buckets
+        assert abs(b["comm_skew"] - 12.0) < 1e-9, b
+        assert b["comm_wire"] >= 7.0, b      # 20 ms sleep - 12 joined
+        assert abs(rec.exposed_comm
+                   - (b["comm_skew"] + b["comm_wire"])) < 1e-9
+    print(f"  12 ms pod skew joined out of comm_wire per step; "
+          f"closure worst error {worst:.2%} (<= 5%)")
+
+    # clamp twin: a skew claim bigger than the measured collective
+    # time moves ALL of comm_wire and nothing else — pod blame can
+    # reclassify exposed collective time, never invent it
+    clamped = _traced_steps(10_000.0)
+    ok, worst = clamped.check_closure(tolerance=0.05)
+    assert ok, worst
+    for rec in clamped.steps:
+        b = rec.buckets
+        assert b["comm_wire"] == 0.0, b
+        assert 15.0 <= b["comm_skew"] <= 60.0, b
+        assert b["compute"] > 0.0, b         # dispatch span untouched
+    print("  oversized skew claim clamped to the measured collective "
+          "time (closure holds)")
+
+    events_path = os.path.join(tmp, "goodput_split.jsonl")
+    logger = monitor.MetricsLogger(
+        sinks=[], goodput_sink=monitor.JSONLSink(events_path))
+    for ev in ledger.to_events():
+        logger.record_goodput(ev)
+    logger.close()
+    _run_schema(events_path, "goodput")
+    print(f"  events validate (--kind goodput): {events_path}")
+
+
+# --- leg (c): multiprocess merge (real clocks, real collectives) --------------
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys, time
+
+    rank = int(os.environ["RANK"])
+    world = int(os.environ["WORLD_SIZE"])
+    bdir = os.environ["POD_BARRIER_DIR"]
+
+    from apex_tpu import trace
+
+    def barrier(tag):
+        # the last arriver's file releases every waiter on its next
+        # poll — the simultaneous-exit semantics of a blocking
+        # collective, which is all the clock-alignment fit assumes
+        open(os.path.join(bdir, "%s.%d" % (tag, rank)), "w").close()
+        want = tag + "."
+        while sum(1 for n in os.listdir(bdir)
+                  if n.startswith(want)) < world:
+            time.sleep(0.001)
+
+    barrier("start")        # de-skew process startup, outside spans
+    tracer = trace.Tracer()
+    with tracer:
+        for i in range(4):
+            with trace.step(i):
+                with trace.span("data/load"):
+                    time.sleep(0.080 if rank == 2 else 0.005)
+                with trace.span("grad/sync", kind="collective"):
+                    barrier("step%d" % i)
+    with open(os.environ["POD_AUDIT_OUT"], "w") as f:
+        for ev in tracer.span_events(rank):
+            f.write(json.dumps(ev) + chr(10))
+    print("OK rank=%d" % rank, flush=True)
+""")
+
+
+def audit_multiproc_merge(tmp: str) -> None:
+    from apex_tpu import trace
+
+    print("== multiprocess pod merge (4 real ranks, barrier exits)")
+    n_ranks = 4
+    bdir = os.path.join(tmp, "barrier")
+    os.makedirs(bdir, exist_ok=True)
+    procs, outs = [], []
+    for rank in range(n_ranks):
+        env = {**os.environ, "RANK": str(rank),
+               "WORLD_SIZE": str(n_ranks), "POD_BARRIER_DIR": bdir,
+               "POD_AUDIT_OUT": os.path.join(tmp, f"rank{rank}.jsonl")}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _CHILD], env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise AssertionError("multiproc barrier pod timed out:\n"
+                             + "\n---\n".join(outs))
+    joined = "\n---\n".join(outs)
+    assert all(p.returncode == 0 for p in procs), (
+        f"multiproc children failed "
+        f"{[p.returncode for p in procs]}:\n{joined}")
+
+    lines = []
+    for rank in range(n_ranks):
+        lines.extend(open(os.path.join(tmp, f"rank{rank}.jsonl")))
+    pod = trace.PodTimeline.merge(lines)
+    assert pod.ranks == list(range(n_ranks)), pod.ranks
+    assert all(c.aligned for c in pod.alignment.clocks.values()), \
+        pod.alignment.clocks
+
+    # steady state only: step 0 may fold first-dispatch noise
+    skews = [c for c in pod.collective_skew() if (c.step or 0) >= 1]
+    assert skews, "no matched collectives past step 0"
+    for c in skews:
+        assert c.n_ranks == n_ranks, c
+        assert c.blamed_rank == 2, (
+            f"blame landed on rank {c.blamed_rank}, want the seeded "
+            f"slow rank 2: {c}")
+        assert c.blamed_span == "data/load", c
+        assert c.skew_ms > 40.0, c           # 80 ms vs 5 ms injected
+    trace_path = pod.write_chrome_trace(
+        os.path.join(tmp, "pod_trace.json"))
+    events_path = os.path.join(tmp, "podview_multiproc.jsonl")
+    with open(events_path, "w") as f:
+        for ev in pod.to_events():
+            f.write(json.dumps(ev) + "\n")
+    _run_schema(events_path, "podview")
+    worst = max(c.skew_ms for c in skews)
+    print(f"  {len(skews)} steady-state collectives across 4 real "
+          f"processes all blame (rank 2, 'data/load'), worst skew "
+          f"{worst:.1f} ms; merged trace {trace_path}")
+
+
+# --- leg (d): plan-vs-measured comm drift -------------------------------------
+
+#: the audit's stated agreement band — measured/predicted per hop must
+#: stay within 25x either way on the calibrated-moments-ago model
+#: (order-of-magnitude instrument on noisy XLA:CPU emulation; the
+#: staled twin is 10,000x off, so the band separates cleanly)
+_DRIFT_TOL = 25.0
+
+
+def audit_comm_drift(tmp: str) -> None:
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from apex_tpu import monitor
+    from apex_tpu.lint.mesh_model import MeshModel, parse_mesh_spec
+    from apex_tpu.monitor import linkbench
+    from apex_tpu.parallel import plan_comm
+
+    print("== plan-vs-measured comm drift (dp2x4 CPU mesh)")
+    template = parse_mesh_spec("dp2x4", n_devices=8)
+    shape = tuple(a.size for a in template.axes)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(shape),
+                tuple(a.name for a in template.axes))
+    model, _, _ = linkbench.calibrate(mesh, template, iters=3)
+    plan = plan_comm(model, grad_bytes=1 << 20, dtypes=(None,))
+    assert plan.source == "measured" and len(plan.hops) == 3, \
+        plan.describe()
+
+    measured = monitor.measure_hops(plan, mesh, iters=3)
+    report = monitor.compare_comm_drift(plan, measured,
+                                        tolerance=_DRIFT_TOL)
+    print("  " + report.table().replace("\n", "\n  "))
+    assert not report.stale, (
+        f"freshly calibrated model read as stale (worst drift "
+        f"{report.drift_ratio:.1f}x > {_DRIFT_TOL}x):\n"
+        f"{report.table()}")
+
+    # negative twin: stale the model by 1e4 in bytes/s and the flag
+    # MUST fire against the very same measurements
+    stale_json = model.to_json()
+    for link in stale_json["link_bytes_per_s"]:
+        stale_json["link_bytes_per_s"][link] /= 1e4
+    stale_model = MeshModel.from_json(stale_json)
+    stale_plan = plan_comm(stale_model, grad_bytes=1 << 20,
+                           dtypes=(None,))
+    stale_report = monitor.compare_comm_drift(stale_plan, measured,
+                                              tolerance=_DRIFT_TOL)
+    assert stale_report.stale and stale_report.stale_hops(), (
+        "deliberately staled model (bytes/s / 1e4) not flagged:\n"
+        + stale_report.table())
+    advice = stale_report.advice()
+    assert advice and "scripts/link_probe.py" in advice, advice
+    for h in stale_report.stale_hops():
+        assert h.fingerprint == \
+            f"comm_drift|{h.op}|{h.axis}/{h.link}", h.fingerprint
+    print(f"  staled twin flagged: worst drift "
+          f"{stale_report.drift_ratio:.0f}x, advice -> link_probe")
+
+    events_path = os.path.join(tmp, "pod_drift.jsonl")
+    logger = monitor.MetricsLogger(
+        sinks=[], podview_sink=monitor.JSONLSink(events_path))
+    for ev in report.to_events() + stale_report.to_events():
+        logger.record_podview(ev)
+    logger.close()
+    _run_schema(events_path, "podview")
+    print(f"  events validate (--kind podview): {events_path}")
+
+
+def main_cpu8() -> None:
+    import tempfile
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from apex_tpu import _compat
+    _compat.request_cpu_devices(8)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        audit_pod_blame(tmp)
+        audit_split_closure(tmp)
+        audit_multiproc_merge(tmp)
+        audit_comm_drift(tmp)
+    print("\npod audit ok")
+
+
+if __name__ == "__main__":
+    if "--cpu8" in sys.argv:
+        main_cpu8()
+    else:
+        print(__doc__)
+        sys.exit(2)

@@ -57,6 +57,18 @@
 #                                   #   apexlint --mesh with APX203 hop
 #                                   #   evidence from the measured
 #                                   #   bytes/s
+#                                   # + the pod observatory audit
+#                                   #   (--cpu8): cross-rank timeline
+#                                   #   merge recovers injected clock
+#                                   #   offsets, collective skew blamed
+#                                   #   on the seeded (rank, span),
+#                                   #   goodput comm_skew/comm_wire
+#                                   #   split still closes, 4-process
+#                                   #   merge on real clocks, measured
+#                                   #   hop wire time vs plan within
+#                                   #   the stated band + staled-model
+#                                   #   negative twin, podview schema
+#                                   #   incl. the committed fixture
 #                                   # + the numerics observatory audit
 #                                   #   (--cpu8): per-tensor dynamic-
 #                                   #   range fold zero-dispatch on the
@@ -235,6 +247,22 @@ EOF
     # milliseconds computed from the MEASURED bytes/s, (d) every
     # stream passes --kind goodput
     JAX_PLATFORMS=cpu python scripts/goodput_audit.py --cpu8
+
+    echo "== smoke: pod observatory audit (--cpu8)"
+    # asserts: (a) the synthetic 4-rank merge recovers injected clock
+    # offsets to sub-us residual and blames EVERY collective on the
+    # seeded (rank 2, data/load) with the exact skew/wire split, the
+    # critical path chains wait->wire, and the podview stream + the
+    # committed fixture validate under --kind podview, (b) a
+    # pod-measured skew joins OUT of comm_wire into comm_skew with the
+    # bucket closure intact (oversized claims clamped), (c) 4 real
+    # processes with unrelated perf_counter origins merge through
+    # barrier-released collective spans and blame the seeded slow
+    # rank, (d) measured per-hop wire time agrees with plan_comm's
+    # hop_seconds within the stated band on the calibrated dp2x4 mesh
+    # AND the deliberately staled model fires the drift flag with
+    # link_probe advice
+    JAX_PLATFORMS=cpu python scripts/pod_audit.py --cpu8
 
     echo "== smoke: numerics observatory audit (--cpu8)"
     # asserts: (a) the instrumented structural BERT step (numerics

@@ -46,7 +46,7 @@ def _mk_step(step, wall_ms, spans):
 # --- bucket classification ---------------------------------------------------
 
 def test_classify_span():
-    assert classify_span("anything", "collective") == "exposed_comm"
+    assert classify_span("anything", "collective") == "comm_wire"
     assert classify_span("compile/train_step", "compile") == "recompile"
     assert classify_span("data/load") == "input_wait"
     assert classify_span("input/decode") == "input_wait"
@@ -94,14 +94,48 @@ class TestAttribution:
         assert rec.buckets["compute"] == pytest.approx(4.0)
         assert sum(rec.buckets.values()) == pytest.approx(10.0)
 
-    def test_collective_span_is_exposed_comm(self):
+    def test_collective_span_is_comm_wire(self):
+        """Without a pod merge, all collective time is wire time; the
+        exposed_comm property reads the skew+wire sum back as one
+        number for pre-split consumers."""
         ledger = GoodputLedger(rank=0)
         st = _mk_step(1, 5.0, [
             ("ddp/sync_gradients", "collective", 0.0, 3.0, 0)])
         ledger.on_step(st)
-        assert ledger.steps[0].buckets["exposed_comm"] == \
-            pytest.approx(3.0)
-        assert ledger.steps[0].buckets["other"] == pytest.approx(2.0)
+        rec = ledger.steps[0]
+        assert rec.buckets["comm_wire"] == pytest.approx(3.0)
+        assert rec.buckets["comm_skew"] == pytest.approx(0.0)
+        assert rec.exposed_comm == pytest.approx(3.0)
+        assert rec.buckets["other"] == pytest.approx(2.0)
+
+    def test_note_pod_skew_splits_wire_into_skew(self):
+        """A pod-merge skew note moves charge out of comm_wire into
+        comm_skew on the next on_step — closure stays exact and the
+        note is clamped to the wire time actually present."""
+        ledger = GoodputLedger(rank=0)
+        ledger.note_pod_skew(2.0, step=1)
+        st = _mk_step(1, 5.0, [
+            ("ddp/sync_gradients", "collective", 0.0, 3.0, 0)])
+        ledger.on_step(st)
+        rec = ledger.steps[0]
+        assert rec.buckets["comm_skew"] == pytest.approx(2.0)
+        assert rec.buckets["comm_wire"] == pytest.approx(1.0)
+        assert rec.exposed_comm == pytest.approx(3.0)
+        assert sum(rec.buckets.values()) == pytest.approx(5.0)
+        assert rec.closure_error() < 1e-9
+
+    def test_note_pod_skew_clamps_to_available_wire(self):
+        """An over-claimed skew (clock bug upstream) cannot push
+        comm_wire negative or break closure."""
+        ledger = GoodputLedger(rank=0)
+        ledger.note_pod_skew(10_000.0, step=1)
+        st = _mk_step(1, 5.0, [
+            ("ddp/sync_gradients", "collective", 0.0, 3.0, 0)])
+        ledger.on_step(st)
+        rec = ledger.steps[0]
+        assert rec.buckets["comm_wire"] == pytest.approx(0.0)
+        assert rec.buckets["comm_skew"] == pytest.approx(3.0)
+        assert sum(rec.buckets.values()) == pytest.approx(5.0)
 
     def test_uncovered_wall_is_other(self):
         ledger = GoodputLedger(rank=0)

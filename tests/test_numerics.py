@@ -717,12 +717,13 @@ class TestNumericsSchema:
 
 class TestChannelRegistry:
     """The MetricsLogger registry refactor: every channel is one
-    declarative row; numerics is the 10th."""
+    declarative row; numerics is the 10th, podview the 11th."""
 
-    def test_ten_channels_numerics_last(self):
+    def test_eleven_channels_podview_last(self):
         from apex_tpu import monitor
         names = [c.name for c in monitor.CHANNELS]
-        assert len(names) == 10 and names[-1] == "numerics"
+        assert len(names) == 11 and names[-1] == "podview"
+        assert names[-2] == "numerics"
 
     def test_registry_kinds_match_schema_registry(self):
         from apex_tpu import monitor

@@ -1,0 +1,388 @@
+"""Pod observatory: cross-rank timeline merge + comm drift.
+
+The ISSUE-16 contract: per-rank clock offsets are recovered exactly
+from shared collective exits (alternating least squares, reference
+gauge), the clock-alignment edge cases hold (a rank missing the
+collectives merges unaligned rather than silently wrong, a single-rank
+merge is the degenerate identity, monotonic crystal drift is recovered
+with ``fit_drift=True``, out-of-order span arrival matches the same
+keys), collective skew splits into wait-for-laggard vs wire with blame
+on the correct (rank, span), the per-(rank, step) skew joins back into
+the goodput ledger's ``comm_skew``/``comm_wire`` split with closure
+intact, the merged Chrome trace carries per-rank process metadata,
+plan-vs-measured comm drift flags a stale link model with a stable
+fingerprint, and the podview event stream (including the committed
+pod_audit fixture) validates against ``--kind podview``.
+"""
+
+import json
+import os
+import random
+import time
+
+import pytest
+
+from apex_tpu import monitor
+from apex_tpu.monitor import comm_drift
+from apex_tpu.monitor.goodput import GoodputLedger
+from apex_tpu.parallel.hierarchy import CommPlan, Hop
+from apex_tpu.trace import podview
+from apex_tpu.trace.spans import SpanEvent, StepTrace
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_FIXTURE = os.path.join(_REPO_ROOT, "tests", "fixtures",
+                        "podview_pod_audit.jsonl")
+
+
+def _schema():
+    from scripts.check_metrics_schema import check_podview_lines
+    return check_podview_lines
+
+
+def _span(name, kind, step, rank, t_ms, dur_ms, depth=0):
+    return {"kind": "span", "name": name, "span_kind": kind,
+            "step": step, "rank": rank, "t_ms": t_ms, "dur_ms": dur_ms,
+            "depth": depth}
+
+
+def _pod_events(offsets, n_steps=2, *, slow_rank=None, slow_ms=40.0,
+                drift=None):
+    """Synthetic pod: each step runs ``data/load`` then a
+    ``grad/allreduce`` collective entered right after. ``offsets[r]``
+    shifts rank r's local clock (local = true − offset, so the fit
+    recovers +offset); ``slow_rank`` loads ``slow_ms`` instead of 5 ms;
+    ``drift[r]`` scales rank r's local clock rate."""
+    events = []
+    for rank, off in offsets.items():
+        def local(t_true):
+            t = t_true - off
+            if drift and rank in drift:
+                t = t * (1.0 + drift[rank])
+            return t
+        for step in range(n_steps):
+            base = 1000.0 * step
+            load = slow_ms if rank == slow_rank else 5.0
+            events.append(_span("data/load", "span", step, rank,
+                                local(base), load, depth=1))
+            entry = base + load
+            # everyone exits when the last arriver's wire time is done
+            exit_true = base + max(slow_ms if slow_rank is not None
+                                   else 5.0, 5.0) + 10.0
+            events.append(_span("grad/allreduce", "collective", step,
+                                rank, local(entry),
+                                local(exit_true) - local(entry)))
+    return events
+
+
+# --- clock alignment ---------------------------------------------------------
+
+class TestClockAlignment:
+    def test_offsets_recovered_exactly(self):
+        offsets = {0: 0.0, 1: 1234.5, 2: -987.25}
+        pod = podview.PodTimeline.merge(_pod_events(offsets, n_steps=3))
+        assert pod.alignment.reference == 0
+        for r, off in offsets.items():
+            clock = pod.alignment.clocks[r]
+            assert clock.aligned
+            assert clock.offset_ms == pytest.approx(off, abs=1e-6)
+            assert clock.residual_ms == pytest.approx(0.0, abs=1e-6)
+            assert clock.n_shared == 3
+
+    def test_rank_missing_collectives_merges_unaligned(self):
+        """A rank whose stream has spans but no shared collectives
+        cannot be constrained: it stays in the merge with offset 0 and
+        ``aligned=False`` — never a silently wrong clock."""
+        events = _pod_events({0: 0.0, 1: 10.0})
+        events.append(_span("data/load", "span", 0, 7, 5e6, 3.0))
+        pod = podview.PodTimeline.merge(events)
+        clock = pod.alignment.clocks[7]
+        assert not clock.aligned
+        assert clock.offset_ms == 0.0
+        assert clock.n_shared == 0
+        assert 7 in pod.ranks          # still present in the merge
+        # and its pod_align event says so
+        ev = [e for e in pod.alignment.to_events(wall_time=1.0)
+              if e["rank"] == 7][0]
+        assert ev["aligned"] is False
+
+    def test_single_rank_degenerate_identity(self):
+        """One rank alone is the reference: aligned by definition,
+        identity clock, no shared collectives."""
+        pod = podview.PodTimeline.merge(_pod_events({3: 55.0},
+                                                    n_steps=1))
+        clock = pod.alignment.clocks[3]
+        assert pod.alignment.reference == 3
+        assert clock.aligned and clock.offset_ms == 0.0
+        assert clock.n_shared == 0
+        assert pod.collective_skew() == []
+
+    def test_monotonic_drift_recovered(self):
+        """A crystal ticking 200 ppm fast over a long run: the
+        offset-only fit leaves a growing residual; ``fit_drift=True``
+        recovers the rate and collapses it."""
+        offsets = {0: 0.0, 1: 500.0}
+        drift = {1: 2e-4}
+        events = _pod_events(offsets, n_steps=40, drift=drift)
+        rigid = podview.PodTimeline.merge(events)
+        fitted = podview.PodTimeline.merge(events, fit_drift=True)
+        r_rigid = rigid.alignment.clocks[1].residual_ms
+        r_fit = fitted.alignment.clocks[1].residual_ms
+        assert r_fit < r_rigid / 10
+        assert r_fit == pytest.approx(0.0, abs=1e-3)
+        # drift is relative to the reference: local = true·(1+d), so
+        # aligning back needs ≈ −d
+        assert fitted.alignment.clocks[1].drift == \
+            pytest.approx(-2e-4, rel=0.05)
+
+    def test_out_of_order_arrival_same_match_keys(self):
+        """A late-flushed JSONL segment delivers spans out of order;
+        occurrence indices come from the sorted local-time order, so
+        the merge is permutation-invariant."""
+        events = _pod_events({0: 0.0, 1: 77.0, 2: -13.0}, n_steps=3,
+                             slow_rank=2)
+        shuffled = list(events)
+        random.Random(16).shuffle(shuffled)
+        a = podview.PodTimeline.merge(events)
+        b = podview.PodTimeline.merge(shuffled)
+        for r in a.alignment.clocks:
+            assert b.alignment.clocks[r].offset_ms == \
+                pytest.approx(a.alignment.clocks[r].offset_ms, abs=1e-9)
+        sa = [(c.step, c.name, c.occurrence, c.skew_ms, c.blamed_rank)
+              for c in a.collective_skew()]
+        sb = [(c.step, c.name, c.occurrence, c.skew_ms, c.blamed_rank)
+              for c in b.collective_skew()]
+        assert sa == sb
+
+    def test_torn_jsonl_line_skipped(self):
+        lines = [json.dumps(e) for e in _pod_events({0: 0.0, 1: 5.0})]
+        lines.insert(1, '{"kind": "span", "name": "torn')
+        timelines = podview.load_span_events(lines)
+        assert set(timelines) == {0, 1}
+
+
+# --- skew blame --------------------------------------------------------------
+
+class TestSkewBlame:
+    def test_blame_lands_on_laggard_and_its_span(self):
+        pod = podview.PodTimeline.merge(
+            _pod_events({0: 0.0, 1: 300.0, 2: -50.0}, n_steps=2,
+                        slow_rank=1, slow_ms=45.0))
+        skews = pod.collective_skew()
+        assert len(skews) == 2
+        for c in skews:
+            assert c.blamed_rank == 1
+            assert c.blamed_span == "data/load"
+            assert c.n_ranks == 3
+            assert c.skew_ms == pytest.approx(40.0, abs=1e-6)
+            assert c.wire_ms == pytest.approx(10.0, abs=1e-6)
+
+    def test_rank_step_skew_charges_the_waiters(self):
+        """The laggard itself waited 0; everyone else waited the full
+        entry skew — that is what note_pod_skew consumes."""
+        pod = podview.PodTimeline.merge(
+            _pod_events({0: 0.0, 1: 0.0, 2: 0.0}, n_steps=1,
+                        slow_rank=2, slow_ms=25.0))
+        rss = pod.rank_step_skew()
+        assert rss[(0, 0)] == pytest.approx(20.0, abs=1e-6)
+        assert rss[(1, 0)] == pytest.approx(20.0, abs=1e-6)
+        assert (2, 0) not in rss
+
+    def test_critical_path_chains_wait_then_wire(self):
+        pod = podview.PodTimeline.merge(
+            _pod_events({0: 0.0, 1: 42.0}, n_steps=2, slow_rank=0,
+                        slow_ms=30.0))
+        path = pod.critical_path(1)
+        assert [s["segment"] for s in path] == ["wait", "wire"]
+        assert path[0]["rank"] == 0
+        assert path[0]["span"] == "data/load"
+        assert path[0]["dur_ms"] == pytest.approx(25.0, abs=1e-4)
+        assert path[1]["dur_ms"] == pytest.approx(10.0, abs=1e-4)
+
+    def test_goodput_join_round_trip_closure(self):
+        """pod merge → rank_step_skew → note_pod_skew: the waiter's
+        collective time splits into skew + wire and still closes."""
+        pod = podview.PodTimeline.merge(
+            _pod_events({0: 0.0, 1: 0.0}, n_steps=1, slow_rank=1,
+                        slow_ms=35.0))
+        skew = pod.rank_step_skew()[(0, 0)]
+        ledger = GoodputLedger(rank=0)
+        ledger.note_pod_skew(skew, step=0)
+        st = StepTrace(0, 0.0)
+        st.dur_ms = 50.0
+        st.spans.append(SpanEvent("data/load", "span", 0.0, 5.0, 0))
+        # rank 0's collective span covers its wait + the wire time
+        st.spans.append(SpanEvent("grad/allreduce", "collective",
+                                  0.005, 40.0, 0))
+        ledger.on_step(st)
+        rec = ledger.steps[0]
+        assert rec.buckets["comm_skew"] == pytest.approx(30.0)
+        assert rec.buckets["comm_wire"] == pytest.approx(10.0)
+        assert rec.exposed_comm == pytest.approx(40.0)
+        assert sum(rec.buckets.values()) == pytest.approx(50.0)
+        assert rec.closure_error() < 1e-9
+
+
+# --- exports -----------------------------------------------------------------
+
+class TestExports:
+    def test_chrome_trace_process_metadata(self):
+        events = _pod_events({0: 0.0, 1: 20.0})
+        events.append(_span("data/load", "span", 0, 9, 8e6, 2.0))
+        pod = podview.PodTimeline.merge(events)
+        trace = pod.chrome_trace()
+        names = {e["pid"]: e["args"]["name"]
+                 for e in trace["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names[0] == "rank 0"
+        assert names[1] == "rank 1"
+        assert names[9] == "rank 9 (unaligned)"
+        sorts = {e["pid"]: e["args"]["sort_index"]
+                 for e in trace["traceEvents"]
+                 if e.get("name") == "process_sort_index"}
+        assert sorts == {0: 0, 1: 1, 9: 9}
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert all(e["pid"] in (0, 1, 9) for e in spans)
+        assert trace["metadata"]["reference_rank"] == 0
+
+    def test_aligned_collective_entries_line_up(self):
+        """After alignment, both ranks' collective entry edges sit at
+        the same pod-clock instant minus the real entry skew."""
+        pod = podview.PodTimeline.merge(
+            _pod_events({0: 0.0, 1: 500.0}, n_steps=1))
+        coll = {r: tl.collectives()[(0, "grad/allreduce", 0)]
+                for r, tl in pod.timelines.items()}
+        t0 = pod.aligned(coll[0])[0]
+        t1 = pod.aligned(coll[1])[0]
+        assert t1 - t0 == pytest.approx(0.0, abs=1e-6)
+
+    def test_events_validate_and_stream_through_channel(self, tmp_path):
+        check = _schema()
+        pod = podview.PodTimeline.merge(
+            _pod_events({0: 0.0, 1: 7.5}, n_steps=2, slow_rank=1,
+                        slow_ms=15.0))
+        events = pod.to_events(wall_time=time.time())
+        assert check([json.dumps(e) for e in events]) == []
+        path = tmp_path / "podview.jsonl"
+        logger = monitor.MetricsLogger(
+            podview_sink=monitor.JSONLSink(str(path)))
+        for ev in events:
+            logger.record_podview(ev)    # unbuffered: lands immediately
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == len(events)
+        assert check(lines) == []
+        logger.close()
+
+    def test_committed_fixture_validates(self):
+        """The pod_audit fixture in CI is schema-clean and carries the
+        blame the audit asserts."""
+        check = _schema()
+        lines = open(_FIXTURE).read().strip().splitlines()
+        assert check(lines) == []
+        recs = [json.loads(ln) for ln in lines]
+        skews = [r for r in recs if r["kind"] == "pod_skew"]
+        assert skews and all(r["blamed_rank"] == 2 and
+                             r["blamed_span"] == "data/load"
+                             for r in skews)
+
+    def test_negative_twins_rejected(self):
+        check = _schema()
+        good = {"kind": "pod_align", "rank": 1, "offset_ms": 3.0,
+                "drift_ppm": 0.0, "residual_ms": 0.1, "n_shared": 4,
+                "aligned": True, "reference": 0, "wall_time": 1.0}
+        assert check([json.dumps(good)]) == []
+        # a non-reference rank claiming alignment with nothing shared
+        bad = dict(good, n_shared=0)
+        assert check([json.dumps(bad)]) != []
+        # stale must be a boolean, ratio positive
+        drift = {"kind": "pod_drift", "hop": 0, "op": "all_reduce",
+                 "axis": "data", "link": "ici", "dtype": None,
+                 "predicted_ms": 1.0, "measured_ms": 2.0, "ratio": 2.0,
+                 "stale": False,
+                 "fingerprint": "comm_drift|all_reduce|data/ici",
+                 "wall_time": 1.0}
+        assert check([json.dumps(drift)]) == []
+        assert check([json.dumps(dict(drift, stale="no"))]) != []
+        assert check([json.dumps(dict(drift, ratio=-1.0))]) != []
+        assert check([json.dumps(dict(drift, link="pcie"))]) != []
+
+
+# --- comm drift --------------------------------------------------------------
+
+def _plan():
+    hops = (Hop("reduce_scatter", "data_intra", 4, "ici", None,
+                alpha_us=1.0, bytes_per_s=1e9, calibrated=False),
+            Hop("all_reduce", "data_inter", 2, "dcn", None,
+                alpha_us=10.0, bytes_per_s=1e8, calibrated=False),
+            Hop("all_gather", "data_intra", 4, "ici", None,
+                alpha_us=1.0, bytes_per_s=1e9, calibrated=False))
+    return CommPlan(hops=hops, compress_block=256, source="defaults",
+                    mesh_name="testmesh", grad_bytes=1 << 20)
+
+
+class TestCommDrift:
+    def test_compare_within_band_not_stale(self):
+        plan = _plan()
+        rep = comm_drift.compare(plan, plan.hop_seconds(),
+                                 tolerance=4.0)
+        assert not rep.stale
+        assert rep.drift_ratio == pytest.approx(1.0)
+        assert rep.advice() is None
+        assert rep.plan_source == "defaults"
+        assert "holds" in rep.table()
+
+    def test_stale_hop_fires_with_fingerprint_and_advice(self):
+        plan = _plan()
+        measured = plan.hop_seconds()
+        measured[1] *= 100.0          # the DCN hop went bad
+        rep = comm_drift.compare(plan, measured, tolerance=4.0)
+        assert rep.stale
+        assert [h.hop for h in rep.stale_hops()] == [1]
+        fp = rep.stale_hops()[0].fingerprint
+        assert fp == "comm_drift|all_reduce|data_inter/dcn"
+        assert "scripts/link_probe.py" in rep.advice()
+        assert rep.drift_ratio == pytest.approx(100.0, rel=1e-6)
+        # symmetric band: a hop measuring far *under* prediction is
+        # equally a model that does not describe the fabric
+        slow_model = plan.hop_seconds()
+        slow_model[0] /= 100.0
+        assert comm_drift.compare(plan, slow_model,
+                                  tolerance=4.0).stale
+
+    def test_compare_rejects_hop_count_mismatch(self):
+        with pytest.raises(ValueError):
+            comm_drift.compare(_plan(), [1e-3, 2e-3])
+
+    def test_wire_from_pod_positional_join(self):
+        """Hop-position join: the hierarchical sync names sub-spans by
+        link class in hop order, so occurrence j of "ici" maps to the
+        j-th ici hop."""
+        plan = _plan()
+        events = []
+        for rank in (0, 1):
+            t = 0.0
+            for name, d in (("ici", 10.0), ("dcn", 20.0),
+                            ("ici", 5.0)):
+                events.append(_span(name, "collective", 0, rank, t, d))
+                t += d
+        pod = podview.PodTimeline.merge(events)
+        wires = comm_drift.wire_from_pod(pod, plan)
+        assert wires == pytest.approx([10e-3, 20e-3, 5e-3], abs=1e-9)
+
+    def test_wire_from_pod_missing_hop_returns_none(self):
+        plan = _plan()
+        events = [_span("ici", "collective", 0, r, 0.0, 10.0)
+                  for r in (0, 1)]
+        pod = podview.PodTimeline.merge(events)
+        assert comm_drift.wire_from_pod(pod, plan) is None
+
+    def test_drift_events_validate(self):
+        check = _schema()
+        plan = _plan()
+        measured = plan.hop_seconds()
+        measured[2] *= 50.0
+        rep = comm_drift.compare(plan, measured, tolerance=4.0)
+        lines = [json.dumps(e)
+                 for e in rep.to_events(wall_time=time.time())]
+        assert check(lines) == []
+        recs = [json.loads(ln) for ln in lines]
+        assert [r["stale"] for r in recs] == [False, False, True]
