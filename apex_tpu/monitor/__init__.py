@@ -20,7 +20,9 @@ Plus the runtime performance observatory (docs/monitoring.md#goodput):
   each step's wall clock into compute / exposed-comm / input-wait /
   host-callback / ckpt-stall / recompile / guard-rewind buckets off
   the :class:`apex_tpu.trace.Tracer` timeline, with an asserted
-  attribution closure and a rolling goodput fraction;
+  attribution closure, a rolling goodput fraction, and a per-mesh-axis
+  split of the exposed-comm buckets (``comm_axes_ms``) joined through
+  the planned-collective registry;
 - :mod:`~apex_tpu.monitor.linkbench` — α–β link calibration sweeping
   collectives per mesh axis into a MEASURED
   :class:`apex_tpu.lint.mesh_model.MeshModel`
@@ -46,10 +48,12 @@ from apex_tpu.monitor.comm_drift import (CommDriftReport, HopDrift,
                                          measure_hops, wire_from_pod)
 from apex_tpu.monitor.collectives import (COLLECTIVE_OPCODES,
                                           collective_bytes,
+                                          collective_bytes_by_axis,
                                           collective_bytes_by_dtype,
                                           collective_bytes_by_hop,
                                           collective_bytes_from_text,
-                                          scope_hop, wire_report)
+                                          scope_axis_row, scope_hop,
+                                          wire_report)
 from apex_tpu.monitor.goodput import (BUCKETS, GoodputLedger, StepLedger,
                                       classify_span)
 from apex_tpu.monitor.linkbench import (LinkFit, LinkSample, calibrate,
@@ -75,7 +79,8 @@ __all__ = [
     "precision_report", "placement_advisor", "site_names",
     "Sink", "StdoutSink", "JSONLSink", "CSVSink",
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
-    "collective_bytes_by_dtype", "collective_bytes_by_hop", "scope_hop",
+    "collective_bytes_by_dtype", "collective_bytes_by_hop",
+    "collective_bytes_by_axis", "scope_hop", "scope_axis_row",
     "wire_report",
     "module_count_and_host_ops",
     "GoodputLedger", "StepLedger", "BUCKETS", "classify_span",

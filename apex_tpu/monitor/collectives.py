@@ -25,7 +25,8 @@ from apex_tpu.prof import xplane as _xplane
 
 __all__ = ["COLLECTIVE_OPCODES", "collective_bytes",
            "collective_bytes_from_text", "collective_bytes_by_dtype",
-           "collective_bytes_by_hop", "scope_hop", "wire_report"]
+           "collective_bytes_by_hop", "collective_bytes_by_axis",
+           "scope_hop", "scope_axis_row", "wire_report"]
 
 # The canonical prefix list lives next to the trace categorizer so live
 # accounting and post-hoc attribution bucket opcodes identically.
@@ -104,6 +105,22 @@ def scope_hop(scope: str) -> str:
     return "unattributed"
 
 
+def scope_axis_row(scope: str) -> str:
+    """Mesh-axis attribution row of a stripped collective scope: the
+    :func:`apex_tpu.parallel.registry.scope_axis` answer, or the
+    explicit ``"unknown"`` row for a scope the registry doesn't know.
+    This is the ONE scope→axis join every per-axis consumer shares
+    (``wire_report``'s ``by_axis``, the goodput ledger's
+    ``comm_axes_ms`` split, ``mesh_explain``'s wire pricing) — the
+    registry stays the single source (APX102's allowlist), and
+    unattributable traffic lands in a visible row, never silently
+    dropped. tests/test_goodput.py pins that no second private copy of
+    the table exists."""
+    from apex_tpu.parallel import registry
+    axis = registry.scope_axis(scope)
+    return axis if axis else "unknown"
+
+
 def collective_bytes_by_dtype(hlo_text: str) -> Dict[str, Dict[str, int]]:
     """Collective result bytes per opcode, split per wire dtype:
     ``{opcode: {dtype: bytes}}``. The breakdown is what makes compressed
@@ -138,6 +155,27 @@ def collective_bytes_by_hop(hlo_text: str) -> Dict[str, Dict[str, int]]:
     return out
 
 
+def collective_bytes_by_axis(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Collective result bytes per **mesh axis**, split per wire dtype:
+    ``{axis | "unknown": {dtype: bytes}}``.
+
+    The axis comes from joining the collective's stripped scope through
+    the ONE planned-collective registry
+    (:func:`apex_tpu.parallel.registry.scope_axis` via
+    :func:`scope_axis_row`), so a hierarchical DDP + ZeRO step splits
+    its traffic into ``data_intra`` / ``data_inter`` / ``data`` rows
+    while anything outside the registry — the same population APX102
+    flags — lands in an explicit ``"unknown"`` row. The static
+    complement of the goodput ledger's per-axis ``comm_axes_ms``
+    split: the ledger says which axis's exposed time, this says which
+    axis's bytes."""
+    out: Dict[str, Dict[str, int]] = {}
+    for _prefix, dt, nbytes, scope in _iter_collective_rows(hlo_text):
+        slot = out.setdefault(scope_axis_row(scope), {})
+        slot[dt] = slot.get(dt, 0) + nbytes
+    return out
+
+
 def wire_report(fn=None, *args, hlo_text: Optional[str] = None,
                 logical_bytes: Optional[int] = None, **kwargs) -> Dict:
     """Logical-vs-wire collective accounting for one compiled step.
@@ -148,6 +186,7 @@ def wire_report(fn=None, *args, hlo_text: Optional[str] = None,
 
         {"wire_bytes": int, "by_opcode": {op: {dtype: bytes}},
          "by_hop": {hop: {dtype: bytes}},
+         "by_axis": {axis: {dtype: bytes}},
          "logical_bytes": int | None, "wire_to_logical": float | None}
 
     A bucketed+``compress="bf16"`` DDP step reports
@@ -156,7 +195,10 @@ def wire_report(fn=None, *args, hlo_text: Optional[str] = None,
     collectives are judged against. ``by_hop`` is the per-hop per-dtype
     split of the hierarchical schedule (``"ici"``/``"dcn"`` from the
     hop sub-span scopes; flat traffic is ``"unattributed"``) — see
-    :func:`collective_bytes_by_hop`.
+    :func:`collective_bytes_by_hop`. ``by_axis`` joins each scope
+    through the planned-collective registry
+    (:func:`collective_bytes_by_axis`; unregistered scopes land in the
+    explicit ``"unknown"`` row).
     """
     if hlo_text is None:
         if fn is None:
@@ -164,14 +206,18 @@ def wire_report(fn=None, *args, hlo_text: Optional[str] = None,
         hlo_text = _hlo.compiled_hlo(fn, *args, **kwargs)
     by_op: Dict[str, Dict[str, int]] = {}
     by_hop: Dict[str, Dict[str, int]] = {}
+    by_axis: Dict[str, Dict[str, int]] = {}
     for prefix, dt, nbytes, scope in _iter_collective_rows(hlo_text):
         slot = by_op.setdefault(prefix, {})
         slot[dt] = slot.get(dt, 0) + nbytes
         slot = by_hop.setdefault(scope_hop(scope), {})
         slot[dt] = slot.get(dt, 0) + nbytes
+        slot = by_axis.setdefault(scope_axis_row(scope), {})
+        slot[dt] = slot.get(dt, 0) + nbytes
     wire = sum(b for per in by_op.values() for b in per.values())
     ratio = (wire / logical_bytes) if logical_bytes else None
     return {"wire_bytes": wire, "by_opcode": by_op, "by_hop": by_hop,
+            "by_axis": by_axis,
             "logical_bytes": logical_bytes, "wire_to_logical": ratio}
 
 

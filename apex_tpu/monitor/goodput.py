@@ -40,6 +40,14 @@ bucket sum **closes over the measured step wall time** by construction;
 tolerance, memory_budget-style (``scripts/goodput_audit.py --cpu8``
 pins 5% in CI).
 
+The two exposed-communication buckets additionally carry a **per-axis
+split** (:attr:`StepLedger.comm_axes_ms`): each collective span's name
+is joined through the planned-collective registry
+(:func:`apex_tpu.monitor.collectives.scope_axis_row`), so the ledger
+can say "zero axis cost 0.8 ms exposed, dp axis 0.3 ms" per step —
+unregistered scopes land in an explicit ``"unknown"`` row, and the
+axis sums equal the buckets exactly (docs/monitoring.md#per-axis).
+
 **Goodput fraction** = useful-step time ÷ wall time, where useful =
 the ``compute`` bucket (everything else is overhead some subsystem can
 shrink). :meth:`rolling_goodput` averages it over a window;
@@ -121,13 +129,23 @@ def classify_span(name: str, kind: str = "span") -> str:
 class StepLedger:
     """One step's attribution: wall time + per-bucket milliseconds."""
 
-    __slots__ = ("step", "wall_ms", "buckets", "wall_time")
+    __slots__ = ("step", "wall_ms", "buckets", "wall_time",
+                 "comm_axes_ms")
 
     def __init__(self, step: Optional[int], wall_ms: float,
-                 buckets: Dict[str, float]):
+                 buckets: Dict[str, float],
+                 comm_axes_ms: Optional[Dict[str, Dict[str, float]]]
+                 = None):
         self.step = step
         self.wall_ms = wall_ms
         self.buckets = buckets        # {bucket: ms}, every BUCKETS key
+        #: per-mesh-axis split of the exposed-communication buckets:
+        #: ``{axis: {"wire": ms, "skew": ms}}`` — axes joined from each
+        #: collective span's scope through the planned-collective
+        #: registry (scope_axis_row; unregistered scopes land in
+        #: ``"unknown"``). The axis sums equal the comm_wire/comm_skew
+        #: buckets by construction.
+        self.comm_axes_ms = comm_axes_ms or {}
         self.wall_time = time.time()
 
     @property
@@ -162,13 +180,17 @@ class StepLedger:
                 "wall_ms": round(self.wall_ms, 4),
                 "buckets_ms": {k: round(v, 4)
                                for k, v in self.buckets.items()},
+                "comm_axes_ms": {
+                    ax: {k: round(v, 4) for k, v in parts.items()}
+                    for ax, parts in self.comm_axes_ms.items()},
                 "goodput_frac": round(gf, 6) if gf is not None else None,
                 "closure_err": round(self.closure_error(), 6),
                 "wall_time": self.wall_time}
 
 
 def _attribute(spans, wall_ms: float,
-               classify: Callable[[str, str], str]) -> Dict[str, float]:
+               classify: Callable[[str, str], str]
+               ) -> Tuple[Dict[str, float], Dict[str, float]]:
     """Sweep a step's span intervals into bucket milliseconds.
 
     Boundary sweep: between any two adjacent span boundaries exactly
@@ -177,17 +199,26 @@ def _attribute(spans, wall_ms: float,
     back-dated compile spans :func:`Tracer.add_span_event` injects can
     never double-count an instant. Uncovered time is NOT emitted here;
     the caller assigns ``wall − covered`` to ``other``.
+
+    Returns ``(buckets, comm_axis_ms)``: the second dict splits the
+    ``comm_wire`` bucket per mesh axis by joining each winning
+    collective span's name through the planned-collective registry
+    (:func:`apex_tpu.monitor.collectives.scope_axis_row` — the one
+    shared join; unregistered scopes land in ``"unknown"``), so
+    ``sum(comm_axis_ms.values()) == buckets["comm_wire"]`` exactly.
     """
     out = {b: 0.0 for b in BUCKETS}
+    axes: Dict[str, float] = {}
     if not spans:
-        return out
-    # (t0, t1, depth, order, bucket) in step-relative ms
+        return out, axes
+    from apex_tpu.monitor.collectives import scope_axis_row
+    # (t0, t1, depth, order, bucket, name) in step-relative ms
     base = min(s.t_start for s in spans)
     ivals = []
     for order, s in enumerate(spans):
         t0 = (s.t_start - base) * 1e3
         ivals.append((t0, t0 + max(s.dur_ms, 0.0), s.depth, order,
-                      classify(s.name, s.kind)))
+                      classify(s.name, s.kind), s.name))
     bounds = sorted({b for iv in ivals for b in iv[:2]})
     for lo, hi in zip(bounds, bounds[1:]):
         if hi <= lo:
@@ -195,9 +226,13 @@ def _attribute(spans, wall_ms: float,
         covering = [iv for iv in ivals if iv[0] <= lo and iv[1] >= hi]
         if not covering:
             continue
-        _, _, _, _, bucket = max(covering, key=lambda iv: (iv[2], iv[3]))
+        win = max(covering, key=lambda iv: (iv[2], iv[3]))
+        bucket, name = win[4], win[5]
         out[bucket] += hi - lo
-    return out
+        if bucket == "comm_wire":
+            ax = scope_axis_row(name)
+            axes[ax] = axes.get(ax, 0.0) + (hi - lo)
+    return out, axes
 
 
 class GoodputLedger:
@@ -300,9 +335,10 @@ class GoodputLedger:
         """Tracer subscriber: fold one finished
         :class:`~apex_tpu.trace.StepTrace` into the ledger."""
         wall = st.dur_ms if st.dur_ms is not None else 0.0
-        buckets = _attribute(st.spans, wall, self.classify)
+        buckets, axis_wire = _attribute(st.spans, wall, self.classify)
         covered = sum(buckets.values())
         buckets["other"] += max(wall - covered, 0.0)
+        skew_moved = 0.0
         for bucket, donors in (("ckpt_stall", ("other", "compute")),
                                ("guard_rewind", ("other", "compute")),
                                # pod skew only reclassifies exposed
@@ -323,7 +359,18 @@ class GoodputLedger:
                     buckets[donor] -= take
                     buckets[bucket] += take
                     joined -= take
-        rec = StepLedger(st.step, wall, buckets)
+                    if bucket == "comm_skew":
+                        skew_moved += take
+        # the per-axis view of the same move: pod skew reclassifies
+        # each axis's wire share proportionally (no axis-resolved skew
+        # measurement exists — blame follows the wire it delayed), so
+        # the axis sums still equal the comm_wire/comm_skew buckets
+        comm_axes: Dict[str, Dict[str, float]] = {}
+        wire_total = sum(axis_wire.values())
+        for ax, ms in axis_wire.items():
+            share = (skew_moved * ms / wire_total) if wire_total else 0.0
+            comm_axes[ax] = {"wire": ms - share, "skew": share}
+        rec = StepLedger(st.step, wall, buckets, comm_axes)
         self.steps.append(rec)
         if len(self.steps) > self.max_steps:
             del self.steps[:len(self.steps) - self.max_steps]
@@ -372,6 +419,18 @@ class GoodputLedger:
                 out[b] += v
         return out
 
+    def comm_axes_totals(self) -> Dict[str, Dict[str, float]]:
+        """Summed per-axis exposed-comm milliseconds over the retained
+        ledger: ``{axis: {"wire": ms, "skew": ms}}`` — the "zero axis
+        cost 0.8 ms exposed, dp axis 0.3 ms" rollup."""
+        out: Dict[str, Dict[str, float]] = {}
+        for rec in self.steps:
+            for ax, parts in rec.comm_axes_ms.items():
+                slot = out.setdefault(ax, {"wire": 0.0, "skew": 0.0})
+                for k, v in parts.items():
+                    slot[k] = slot.get(k, 0.0) + v
+        return out
+
     def table(self, width: int = 10) -> str:
         """Aligned per-step ledger: wall, every bucket, goodput%."""
         heads = ["step", "wall_ms"] + list(BUCKETS) + ["goodput"]
@@ -390,4 +449,9 @@ class GoodputLedger:
         rg = self.rolling_goodput()
         row.append(f"{rg:.1%}" if rg is not None else "n/a")
         lines.append(" ".join(v.rjust(width) for v in row))
+        axes = self.comm_axes_totals()
+        if axes:
+            lines.append("exposed comm by axis: " + "  ".join(
+                f"{ax} wire {p['wire']:.2f} skew {p['skew']:.2f}"
+                for ax, p in sorted(axes.items())))
         return "\n".join(lines)

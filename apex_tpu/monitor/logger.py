@@ -134,6 +134,11 @@ CHANNELS: Tuple[ChannelSpec, ...] = (
                 "offline/audit joins, and a skew-blame record may "
                 "immediately precede the straggler escalation it "
                 "explains (an unaligned rank's residual is null)"),
+    ChannelSpec("sharding", ("sharding_mesh", "sharding"),
+                "record_sharding", True,
+                why_unbuffered="per-axis attribution rows are rare AOT "
+                "audits (shard_report / mesh_explain pre-flights), and "
+                "an unmeasured link's predicted_s is null by contract"),
 )
 
 def _null_nonfinite(rec: Dict, nested: bool) -> None:
@@ -217,6 +222,7 @@ class MetricsLogger:
         self.memory_report = None      # last attached prof.MemoryReport
         self.lint_report = None        # last attached lint.Report
         self.roofline_report = None    # last attached RooflineReport
+        self.shard_report = None       # last attached prof.ShardReport
         #: the uncompressed payload one step SEMANTICALLY moves (e.g.
         #: ``4 * n_params`` for an fp32 grad sync) — enables the
         #: per-record ``wire_to_logical`` ratio, same contract as
@@ -375,7 +381,8 @@ class MetricsLogger:
     # -- event channels ------------------------------------------------------
     # record_event / record_memory / record_lint / record_ckpt /
     # record_guard / record_goodput / record_roofline / record_cluster /
-    # record_integrity / record_numerics / record_podview are generated
+    # record_integrity / record_numerics / record_podview /
+    # record_sharding are generated
     # from the CHANNELS
     # registry after the class body — one declarative row per channel,
     # not one 30-line clone. Typical wirings (see each subsystem's
@@ -425,6 +432,27 @@ class MetricsLogger:
             except Exception:
                 rank = 0
             self.record_memory(report.to_event(rank=rank))
+        return self
+
+    def attach_shard_report(self, report,
+                            step: Optional[int] = None,
+                            **to_events_kwargs) -> "MetricsLogger":
+        """Attach an :class:`apex_tpu.prof.ShardReport` (the compiled
+        step's per-axis HBM disposition): emits its ``sharding_mesh``
+        header + one ``kind="sharding"`` row per axis on the sharding
+        channel and keeps the report for consumers (``bench.py`` reads
+        the per-axis bytes into its ``axis_hbm`` column). Extra kwargs
+        (``wire_by_axis=``, ``predicted_s=``, ``candidate=``) pass
+        through to :meth:`~apex_tpu.prof.ShardReport.to_events`."""
+        self.shard_report = report
+        if report is not None:
+            try:
+                rank = jax.process_index()
+            except Exception:
+                rank = 0
+            for ev in report.to_events(rank=rank, step=step,
+                                       **to_events_kwargs):
+                self.record_sharding(ev)
         return self
 
     def attach_lint_report(self, report,

@@ -1277,6 +1277,83 @@ def _():
     assert ledger.steps[-1].buckets["recompile"] == 0
 
 
+@case("sharding/no-extra-dispatch")
+def _():
+    """Per-axis sharding attribution is pure AOT observation: building
+    the :func:`apex_tpu.prof.shard_report` (HLO sharding annotations +
+    memory report join) and the per-axis wire split
+    (:func:`apex_tpu.monitor.collective_bytes_by_axis`) off a compiled
+    step, then attaching both to the sharding event channel, must
+    leave the compiled HLO BIT-IDENTICAL — donated and undonated —
+    with zero host ops in the observed module (same guarantee the
+    monitor/memory/goodput cases pin for their layers). The grad
+    sync's wire bytes must land on the ``data`` axis row (the registry
+    join), never silently in ``unknown``."""
+    import io
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from apex_tpu import monitor, parallel, prof
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    from apex_tpu.monitor.check import module_count_and_host_ops
+    from apex_tpu.trace.spans import span
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        print("  (skip: <2 local devices — no data axis to attribute)")
+        return
+    world = len(devs)
+    mesh = Mesh(np.array(devs), ("data",))
+    mm = parse_mesh_spec(f"ici{world}")
+
+    n = 16 * world
+    x = _rand((n, 32), 0)
+    y = _rand((n, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def train_step(p, x, y):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        with span("ddp/sync_gradients", kind="collective"):
+            g = parallel.sync_gradients(g, "data")
+        new_p = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+        return new_p, loss
+
+    def build(donate):
+        mapped = jax.shard_map(
+            train_step, mesh=mesh,
+            in_specs=(P(), P("data"), P("data")),
+            out_specs=(P(), P()), check_vma=False)
+        kw = {"donate_argnums": (0,)} if donate else {}
+        return jax.jit(mapped, **kw)
+
+    for donate in (False, True):
+        jitted = build(donate)
+        compiled = jitted.lower(params, x, y).compile()
+        before = compiled.as_text()
+        sr = prof.shard_report(compiled, mm)
+        wire = {ax: sum(per.values()) for ax, per in
+                monitor.collective_bytes_by_axis(before).items()}
+        logger = monitor.MetricsLogger(
+            sinks=[], sharding_sink=monitor.JSONLSink(io.StringIO()))
+        logger.attach_shard_report(sr, wire_by_axis=wire)
+        logger.close()
+        after = jitted.lower(params, x, y).compile().as_text()
+        assert after == before, (
+            f"sharding attribution changed the compiled program "
+            f"(donate={donate})")
+        assert wire.get("data", 0) > 0, (
+            f"grad sync not attributed to the data axis: {wire}")
+        ok, worst = sr.closure()
+        assert ok, f"per-axis HBM closure broke: {worst:.4f}"
+        assert sr.axis_bytes("data")["sharded_bytes"] > 0, (
+            "nothing attributed sharded over the data axis")
+    _n, host = module_count_and_host_ops(build(False), params, x, y)
+    assert not host, f"observed step compiled host traffic: {host}"
+
+
 @case("roofline/no-extra-dispatch")
 def _():
     """Roofline observation is AOT + offline: compiling the step for

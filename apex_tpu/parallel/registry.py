@@ -56,18 +56,20 @@ class CollectiveScope:
 #: A compiled collective matching none of them is a reshard nobody
 #: asked for (apexlint APX102/APX202).
 COLLECTIVE_SCOPES: Tuple[CollectiveScope, ...] = (
-    CollectiveScope(r"ddp/sync_gradients", DATA_AXIS, "ddp",
-                    "gradient all-reduce across the data axis"),
-    # hop sub-spans of the hierarchical schedule BEFORE the generic
-    # bucket row: scope_entry returns the first match, and these carry
-    # the factored-axis attribution (canonical names — a deployment
-    # using its mesh model's own axis names still matches the pattern)
+    # hop sub-spans of the hierarchical schedule FIRST: scope_entry
+    # returns the first match, and the hierarchical hops nest under
+    # ddp/sync_gradients (``ddp/sync_gradients/bucketNN/ici``) — the
+    # parent row would otherwise swallow the factored-axis attribution
+    # (canonical names — a deployment using its mesh model's own axis
+    # names still matches the pattern)
     CollectiveScope(r"(^|/)bucket\d+/ici", DATA_INTRA_AXIS, "ddp",
                     "hierarchical sync within-slice hop (reduce-"
                     "scatter / all-gather over ICI)"),
     CollectiveScope(r"(^|/)bucket\d+/dcn", DATA_INTER_AXIS, "ddp",
                     "hierarchical sync cross-slice hop (one-member-"
                     "per-slice reduce over DCN)"),
+    CollectiveScope(r"ddp/sync_gradients", DATA_AXIS, "ddp",
+                    "gradient all-reduce across the data axis"),
     CollectiveScope(r"(^|/)bucket\d+", DATA_AXIS, "ddp",
                     "per-bucket overlapped all-reduce sub-spans"),
     CollectiveScope(r"ddp/loss_pmean", DATA_AXIS, "ddp",

@@ -27,7 +27,12 @@ FLOPs analyzers). TPU-native, the same capability is:
   (docs/profiling.md#roofline);
 - :mod:`~apex_tpu.prof.sentinel` — noise-aware perf-regression gate
   over bench JSON trajectories (robust median/MAD, direction-aware,
-  fingerprinted waivers; ``scripts/perf_sentinel.py``).
+  fingerprinted waivers; ``scripts/perf_sentinel.py``);
+- :mod:`~apex_tpu.prof.sharding` — per-mesh-axis HBM attribution from
+  the compiled module's HloSharding annotations: sharded-by vs
+  replicated-over per axis, closure over ``memory_report``'s class
+  totals, what-if ``forecast_axes`` shrink pricing
+  (``scripts/mesh_explain.py``; docs/memory.md#shard-report).
 """
 
 from apex_tpu.prof.annotate import (CallRecord, annotate, annotate_modules,
@@ -44,6 +49,8 @@ from apex_tpu.prof.report import (PEAK_FLOPS, PEAK_HBM_BW, StepReport,
                                   profile_step, trace)
 from apex_tpu.prof.roofline import (RooflineReport, RooflineRow,
                                     roofline_report)
+from apex_tpu.prof.sharding import (ShardRecord, ShardReport,
+                                    shard_report)
 from apex_tpu.prof.xplane import OpRecord, TraceProfile, parse_trace
 
 __all__ = [
@@ -58,4 +65,5 @@ __all__ = [
     "CompileWatcher", "FunctionWatch", "autotune_scope",
     "global_counters",
     "RooflineReport", "RooflineRow", "roofline_report",
+    "ShardRecord", "ShardReport", "shard_report",
 ]

@@ -1,0 +1,437 @@
+"""Per-axis HBM attribution from the compiled module's shardings.
+
+:mod:`apex_tpu.prof.memory` answers "which *class* owns each HBM byte"
+(params / optimizer_state / activations / ...); this module answers the
+question ROADMAP item 1's nD-parallelism arc needs next: **per mesh
+axis**, is each buffer *sharded by* that axis (every coordinate holds a
+distinct tile — HBM shrinks with the axis) or *replicated over* it
+(every coordinate holds the same bytes — HBM does not shrink)? The
+compiled program is again the ground truth: the optimized module's
+entry parameters carry their ``sharding={...}`` HloSharding annotation
+(``{replicated}``, iota tile assignments ``{devices=[8,1]<=[8]}``,
+explicit device lists, ``last_tile_dim_replicate``), and the
+:class:`~apex_tpu.lint.mesh_model.MeshModel` supplies the device→axis
+coordinate arithmetic, so "sharded by which axis" is a pure join — no
+device ever dispatches.
+
+One deliberate escape hatch: **manual sharding is annotation-invisible**.
+A ``shard_map`` program that carves its own shards (the ZeRO optimizer
+state: ``in_specs=P()`` while each rank holds a distinct
+``dynamic_slice`` of the full state) compiles to parameters annotated
+``{replicated}`` even though no byte is actually replicated. The
+``overrides=`` mapping (arg-path regex → axis names) lets the caller
+*declare* that layout; such rows report ``source="declared"`` so the
+table never passes a declaration off as a measurement.
+
+The per-axis HBM table closes over :func:`memory_report`'s class totals
+by construction (:meth:`ShardReport.closure` asserts it within 1%, the
+memory_budget pattern), and :meth:`ShardReport.forecast_axes` prices a
+hypothetical further sharding (``{"tp": 2, "pp": 2}``) per class: only
+the portion replicated over *every* current axis can shrink.
+
+Consumers: ``scripts/mesh_explain.py`` (the AOT MeshPlan pre-flight),
+``MetricsLogger.attach_shard_report`` (the ``sharding`` event channel,
+``check_metrics_schema.py --kind sharding``), and ``bench.py``'s
+``axis_hbm`` column. See docs/memory.md and docs/parallel.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import time
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.prof.memory import (BUFFER_CLASSES, MemoryReport,
+                                  memory_report, parse_entry, shape_bytes)
+
+__all__ = ["ShardRecord", "ShardReport", "shard_report",
+           "parse_hlo_sharding", "parameter_shardings"]
+
+
+# --- HloSharding text parsing ------------------------------------------------
+
+#: ``sharding={...}`` on an entry parameter line. Tuple shardings
+#: (nested braces) don't occur on flat jax entry parameters; a body we
+#: cannot parse degrades to ``form="unparsed"`` (treated replicated —
+#: the conservative direction: never claim HBM shrink without evidence).
+_SHARDING_RE = re.compile(r"sharding=\{(?P<body>[^{}]*)\}")
+
+_DEVICES_RE = re.compile(
+    r"devices=\[(?P<dims>[\d,]+)\]"
+    r"(?:<=\[(?P<iota>[\d,]+)\](?:T\((?P<perm>[\d,]+)\))?"
+    r"|(?P<list>[\d,]+))")
+
+
+def parse_hlo_sharding(body: str, n_devices: int
+                       ) -> Tuple[Optional[List[int]], str]:
+    """Parse one HloSharding body into ``(tiles, form)``.
+
+    ``tiles[device_id]`` is the data-tile index the device holds —
+    devices mapping to the same tile hold identical bytes. Forms:
+    ``"replicated"`` / ``"maximal"`` (all devices one tile), ``"tiled"``
+    (iota or explicit device list, ``last_tile_dim_replicate`` folds
+    the trailing replication dim), ``"unparsed"`` (``tiles=None``).
+    """
+    b = body.strip()
+    if b == "replicated":
+        return [0] * n_devices, "replicated"
+    if b.startswith("maximal"):
+        # the whole tensor on one device; nothing is axis-sharded
+        return [0] * n_devices, "maximal"
+    m = _DEVICES_RE.search(b)
+    if not m:
+        return None, "unparsed"
+    dims = [int(x) for x in m.group("dims").split(",") if x]
+    total = 1
+    for d in dims:
+        total *= d
+    if m.group("iota") is not None:
+        rdims = [int(x) for x in m.group("iota").split(",") if x]
+        arr = np.arange(int(np.prod(rdims))).reshape(rdims)
+        if m.group("perm"):
+            arr = arr.transpose([int(x) for x in
+                                 m.group("perm").split(",") if x])
+        order = arr.reshape(-1).tolist()
+    else:
+        order = [int(x) for x in m.group("list").split(",") if x]
+    if len(order) != total or total != n_devices:
+        return None, "unparsed"     # sub-group sharding: out of scope
+    rep = dims[-1] if "last_tile_dim_replicate" in b else 1
+    rep = max(rep, 1)
+    tiles = [0] * n_devices
+    for i, dev in enumerate(order):
+        if not 0 <= dev < n_devices:
+            return None, "unparsed"
+        tiles[dev] = i // rep
+    return tiles, "tiled"
+
+
+def parameter_shardings(hlo_text: str) -> Dict[str, str]:
+    """``{parameter_name: sharding body}`` for every annotated entry
+    parameter of an optimized module (a separate scan — ``parse_entry``
+    keeps its record shape; both read the same lines)."""
+    out: Dict[str, str] = {}
+    for raw in hlo_text.splitlines():
+        line = raw.strip()
+        if " parameter(" not in line:
+            continue
+        m = _SHARDING_RE.search(line)
+        if not m:
+            continue
+        name = line.split(" = ", 1)[0].strip().lstrip("%")
+        out[name] = m.group("body")
+    return out
+
+
+# --- per-axis disposition ----------------------------------------------------
+
+def _axis_disposition(tiles: Sequence[int], mesh_model) -> Dict[str, str]:
+    """Per mesh axis: ``"sharded"`` when some pair of devices differing
+    only along that axis holds different tiles, else ``"replicated"``."""
+    out: Dict[str, str] = {}
+    names = mesh_model.axis_names
+    coords = [mesh_model.coords(d) for d in range(mesh_model.n_devices)]
+    for ax in names:
+        groups: Dict[Tuple[int, ...], set] = {}
+        for d, t in enumerate(tiles):
+            key = tuple(coords[d][n] for n in names if n != ax)
+            groups.setdefault(key, set()).add(t)
+        out[ax] = ("sharded" if any(len(s) > 1 for s in groups.values())
+                   else "replicated")
+    return out
+
+
+@dataclasses.dataclass
+class ShardRecord:
+    """One entry argument's per-axis disposition."""
+
+    name: str                 # HLO parameter name
+    path: str                 # jax argument path (metadata op_name)
+    cls: str                  # one of BUFFER_CLASSES
+    bytes: int                # LOCAL per-device bytes (parsed shape)
+    axes: Dict[str, str]      # {axis: "sharded" | "replicated"}
+    shard_factor: int         # distinct tiles — global = local * factor
+    source: str               # "annotation" | "declared" | "none"
+    sharding: str             # raw annotation body ("" when absent)
+
+    @property
+    def global_bytes(self) -> int:
+        """Pod-wide bytes this argument semantically holds once."""
+        return self.bytes * self.shard_factor
+
+    def sharded_by(self, axis: str) -> bool:
+        return self.axes.get(axis) == "sharded"
+
+
+# --- the report --------------------------------------------------------------
+
+def _fmt_bytes(n) -> str:
+    from apex_tpu.utils.format import fmt_bytes
+    return fmt_bytes(n)
+
+
+@dataclasses.dataclass
+class ShardReport:
+    """Per-axis HBM disposition of one compiled step.
+
+    ``axis_table[axis]`` holds ``{"sharded": {cls: bytes}, "replicated":
+    {cls: bytes}}`` — for every axis the two sides sum to the memory
+    report's attributed class totals, so the table *closes* the same
+    way memory_budget's class sum does (:meth:`closure`). Classes with
+    no entry-argument backing (activations, the temp share of comm,
+    outputs) are per-device working sets with no statically visible
+    cross-device redundancy: they are attributed sharded-by every axis
+    with ``source="local"`` — a stated convention, not a measurement.
+    """
+
+    mesh_name: str
+    axis_names: Tuple[str, ...]
+    axis_sizes: Dict[str, int]
+    records: List[ShardRecord]
+    axis_table: Dict[str, Dict[str, Dict[str, int]]]
+    class_totals: Dict[str, int]          # memory report's classes
+    memory: Optional[MemoryReport] = None
+
+    # -- per-axis rollups ----------------------------------------------------
+
+    def axis_bytes(self, axis: str) -> Dict[str, int]:
+        """``{"sharded_bytes", "replicated_bytes"}`` summed over
+        classes for one axis (``KeyError`` on an unknown axis)."""
+        t = self.axis_table[axis]
+        return {"sharded_bytes": sum(t["sharded"].values()),
+                "replicated_bytes": sum(t["replicated"].values())}
+
+    def attributed_total(self) -> int:
+        return sum(self.class_totals.values())
+
+    def closure(self) -> Tuple[bool, float]:
+        """(ok, worst relative error): every axis's sharded+replicated
+        sum must close over the memory report's attributed total —
+        within 1%, the memory_budget pattern."""
+        total = self.attributed_total()
+        worst = 0.0
+        for ax in self.axis_names:
+            b = self.axis_bytes(ax)
+            s = b["sharded_bytes"] + b["replicated_bytes"]
+            if total:
+                worst = max(worst, abs(s - total) / total)
+            elif s:
+                worst = 1.0
+        return worst <= 0.01, worst
+
+    def class_shard_ratio(self, cls: str) -> Optional[float]:
+        """local/global byte ratio of one *argument-backed* class —
+        the ZeRO audit number (~1/world for fully sharded opt state).
+        None when the class has no argument records (local temps have
+        no statically known global footprint)."""
+        recs = [r for r in self.records if r.cls == cls]
+        if not recs:
+            return None
+        local = sum(r.bytes for r in recs)
+        glob = sum(r.global_bytes for r in recs)
+        return (local / glob) if glob else None
+
+    # -- what-if axis forecaster ---------------------------------------------
+
+    def forecast_axes(self, factors: Mapping[str, int]) -> Dict[str, Any]:
+        """Analytic shrink forecast for a hypothetical further sharding
+        (``{"tp": 2, "pp": 2}``): per class, only the portion currently
+        replicated over EVERY mesh axis can shrink — it divides by the
+        product of the factors; already-sharded and local portions are
+        carried unchanged. Returns per-class now/eligible/forecast
+        bytes plus totals."""
+        prod = 1
+        for name, f in factors.items():
+            f = int(f)
+            if f < 1:
+                raise ValueError(f"axis {name!r}: factor must be >= 1")
+            prod *= f
+        per_class: Dict[str, Dict[str, int]] = {}
+        for cls in BUFFER_CLASSES:
+            total = self.class_totals.get(cls, 0)
+            recs = [r for r in self.records if r.cls == cls]
+            arg_local = sum(r.bytes for r in recs)
+            fully_rep = sum(
+                r.bytes for r in recs
+                if all(not r.sharded_by(ax) for ax in self.axis_names))
+            # eligible = the fully-replicated byte fraction of the
+            # class's argument-backed share; the temp remainder
+            # (total - args) is local working set, never eligible
+            eligible = 0
+            if total and arg_local:
+                arg_share = min(arg_local, total)
+                eligible = int(round(arg_share * fully_rep / arg_local))
+            forecast = total - eligible + (eligible + prod - 1) // prod
+            per_class[cls] = {"now": total, "eligible": eligible,
+                              "forecast": forecast}
+        return {"factors": dict(factors),
+                "per_class": per_class,
+                "total_now": sum(v["now"] for v in per_class.values()),
+                "total_forecast": sum(v["forecast"]
+                                      for v in per_class.values())}
+
+    # -- renderings ----------------------------------------------------------
+
+    def table(self) -> str:
+        lines = [f"shard report — mesh={self.mesh_name} "
+                 + " x ".join(f"{a}={self.axis_sizes[a]}"
+                              for a in self.axis_names)]
+        head = f"{'axis':<12} {'sharded':>12} {'replicated':>12}  per-class sharded"
+        lines.append(head)
+        for ax in self.axis_names:
+            b = self.axis_bytes(ax)
+            per = " ".join(
+                f"{cls}={_fmt_bytes(v)}"
+                for cls, v in self.axis_table[ax]["sharded"].items()
+                if v)
+            lines.append(f"{ax:<12} {_fmt_bytes(b['sharded_bytes']):>12} "
+                         f"{_fmt_bytes(b['replicated_bytes']):>12}  {per}")
+        lines.append("arguments:")
+        for r in sorted(self.records, key=lambda r: -r.bytes)[:12]:
+            axes = ",".join(a for a in self.axis_names
+                            if r.sharded_by(a)) or "-"
+            lines.append(
+                f"  {_fmt_bytes(r.bytes):>12} {r.cls:<16} "
+                f"sharded_by={axes:<24} x{r.shard_factor} "
+                f"[{r.source}] {(r.path or r.name)[:48]}")
+        return "\n".join(lines)
+
+    def to_events(self, rank: int = 0, step: Optional[int] = None,
+                  candidate: Optional[str] = None,
+                  wire_by_axis: Optional[Mapping[str, int]] = None,
+                  predicted_s: Optional[Mapping[str, float]] = None
+                  ) -> List[Dict]:
+        """``kind="sharding_mesh"`` header + one ``kind="sharding"``
+        row per axis (plus an ``axis="unknown"`` row when the caller's
+        ``wire_by_axis`` carries unattributed traffic — never silently
+        dropped). Wire rows on a *composite* axis the mesh factors
+        (the registry's flat ``data`` over data_inter x data_intra)
+        are declared in the header's ``extra_axes`` so the per-stream
+        axis enum stays strict. ``check_metrics_schema.py --kind
+        sharding`` validates the stream."""
+        now = time.time()
+        wire = dict(wire_by_axis or {})
+        pred = dict(predicted_s or {})
+        rows = list(self.axis_names)
+        rows += [a for a in wire if a not in rows]
+        extra = [a for a in rows
+                 if a not in self.axis_names and a != "unknown"]
+        evs: List[Dict] = [{
+            "kind": "sharding_mesh", "rank": rank, "step": step,
+            "mesh": self.mesh_name, "axes": list(self.axis_names),
+            "axis_sizes": dict(self.axis_sizes),
+            "extra_axes": extra or None,
+            "candidate": candidate, "wall_time": now}]
+        for ax in rows:
+            if ax in self.axis_table:
+                b = self.axis_bytes(ax)
+            else:                       # e.g. "unknown": wire-only row
+                b = {"sharded_bytes": 0, "replicated_bytes": 0}
+            evs.append({
+                "kind": "sharding", "rank": rank, "step": step,
+                "axis": ax, "candidate": candidate,
+                "hbm_sharded_bytes": b["sharded_bytes"],
+                "hbm_replicated_bytes": b["replicated_bytes"],
+                "wire_bytes": wire.get(ax),
+                "predicted_s": pred.get(ax),
+                "wall_time": now})
+        return evs
+
+
+# --- the builder -------------------------------------------------------------
+
+def shard_report(compiled, mesh_model, *,
+                 report: Optional[MemoryReport] = None,
+                 batch_size: Optional[int] = None,
+                 overrides: Optional[Mapping[str, Sequence[str]]] = None
+                 ) -> ShardReport:
+    """Build a :class:`ShardReport` from a compiled executable (or an
+    optimized-HLO text) and a mesh model. AOT-only: no dispatch.
+
+    ``overrides`` maps arg-path regexes to the axis names the buffer is
+    *actually* sharded by despite its annotation — the manual-sharding
+    escape hatch (ZeRO's ``in_specs=P()`` opt state). ``report=`` skips
+    rebuilding the memory report when the caller already has one for
+    the same executable.
+    """
+    if isinstance(compiled, str):
+        hlo_text = compiled
+        if report is None:
+            raise ValueError("pass report= when giving hlo_text "
+                             "(class totals come from memory_report)")
+    else:
+        hlo_text = compiled.as_text()
+        if report is None:
+            report = memory_report(compiled, batch_size=batch_size)
+
+    n = mesh_model.n_devices
+    names = mesh_model.axis_names
+    sizes = {a.name: a.size for a in mesh_model.axes}
+    ann = parameter_shardings(hlo_text)
+    ovr = [(re.compile(p), tuple(axes))
+           for p, axes in (overrides or {}).items()]
+
+    args_meta, _instrs, _root = parse_entry(hlo_text)
+    from apex_tpu.prof.memory import classify_arg_path
+    records: List[ShardRecord] = []
+    for name, shape, path, _pnum in args_meta:
+        nbytes = shape_bytes(shape)
+        cls = classify_arg_path(path or name)
+        body = ann.get(name, "")
+        declared = next((axes for rx, axes in ovr
+                         if rx.search(path or name)), None)
+        if declared is not None:
+            axes = {ax: ("sharded" if ax in declared else "replicated")
+                    for ax in names}
+            factor = 1
+            for ax in declared:
+                factor *= sizes.get(ax, 1)
+            src = "declared"
+        elif body:
+            tiles, form = parse_hlo_sharding(body, n)
+            if tiles is None:
+                axes = {ax: "replicated" for ax in names}
+                factor = 1
+            else:
+                axes = _axis_disposition(tiles, mesh_model)
+                factor = len(set(tiles))
+            src = "annotation"
+        else:
+            axes = {ax: "replicated" for ax in names}
+            factor = 1
+            src = "none"
+        records.append(ShardRecord(
+            name=name, path=path, cls=cls, bytes=nbytes, axes=axes,
+            shard_factor=max(factor, 1), source=src, sharding=body))
+
+    # distribute the memory report's class totals per axis: argument-
+    # backed classes split by the parsed byte fractions (XLA padding
+    # cancels in the ratio); the temp remainder of each class is a
+    # per-device working set -> sharded by every axis ("local")
+    class_totals = dict(report.classes)
+    axis_table: Dict[str, Dict[str, Dict[str, int]]] = {
+        ax: {"sharded": {}, "replicated": {}} for ax in names}
+    for cls in BUFFER_CLASSES:
+        total = class_totals.get(cls, 0)
+        recs = [r for r in records if r.cls == cls]
+        arg_local = sum(r.bytes for r in recs)
+        arg_share = min(arg_local, total) if arg_local else 0
+        temp_share = max(total - arg_share, 0)
+        for ax in names:
+            if arg_local:
+                sh = sum(r.bytes for r in recs if r.sharded_by(ax))
+                sharded = int(round(arg_share * sh / arg_local))
+            else:
+                sharded = 0
+            sharded += temp_share          # local temps: sharded-by all
+            axis_table[ax]["sharded"][cls] = sharded
+            axis_table[ax]["replicated"][cls] = total - sharded
+
+    return ShardReport(
+        mesh_name=mesh_model.name or "mesh",
+        axis_names=tuple(names), axis_sizes=sizes,
+        records=records, axis_table=axis_table,
+        class_totals=class_totals, memory=report)

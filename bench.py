@@ -57,6 +57,7 @@ See PERF.md for the profiling breakdown behind the current number
 """
 
 import json
+import os
 import sys
 import time
 
@@ -1138,6 +1139,19 @@ def _sentinel_row(current):
             "judged": rep.subject, "note": None}
 
 
+def _load_mesh_explain():
+    """Load scripts/mesh_explain.py as a module — its price_candidate
+    is the ONE per-axis wire-pricing join (registry scope→axis + model
+    link budgets); bench must reuse it, not re-derive it."""
+    import importlib.util as _ilu
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "scripts", "mesh_explain.py")
+    spec = _ilu.spec_from_file_location("mesh_explain", path)
+    mod = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _memory_row(batch: int, size: int):
     """The `peak_hbm_bytes` + `lint_findings` columns: AOT-compile the
     headline step (one compile, ZERO dispatches — the measured path is
@@ -1168,7 +1182,32 @@ def _memory_row(batch: int, size: int):
     spmd_errors = sum(
         1 for f in lint.congruence_findings(schedule)
         if f.severity == "error") if schedule else 0
+    # per-axis sharding observatory off the SAME executable: shard
+    # disposition + wire pricing via mesh_explain's price_candidate
+    # (pure text+model arithmetic) — the compile_watch snapshot around
+    # the block proves zero additional compiles ride the bench
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    from apex_tpu.prof import compile_watch as _cw
+    compiles_before = int(_cw.global_counters()["compiles"])
+    # the headline step is single-chip: a 1-wide flat data axis — the
+    # columns exist (and are sentinel-gated) from day one so the mesh
+    # flagships inherit a populated schema, not a new column
+    mm = parse_mesh_spec("ici1")
+    sr = prof.shard_report(compiled, mm, report=rep)
+    price = _load_mesh_explain().price_candidate(compiled.as_text(), mm)
+    axis_hbm = {ax: sr.axis_bytes(ax) for ax in sr.axis_names}
+    # rank by mesh_explain's candidate key (findings, predicted s): a
+    # verdict-free module ranks 1, each APX code class demotes it
+    mesh_rank = 1 + len(price["codes"])
+    compiles_after = int(_cw.global_counters()["compiles"])
+    assert compiles_after == compiles_before, \
+        "per-axis pricing must not compile anything"
     return {
+        "axis_hbm": axis_hbm,
+        "axis_wire_bytes": price["wire_by_axis"],
+        "mesh_explain": {"codes": price["codes"],
+                         "predicted_total_s": price["predicted_total_s"],
+                         "rank": mesh_rank},
         "peak_hbm_bytes": int(peak) if peak else int(rep.peak_live_bytes),
         "source": "device" if peak else "report",
         "peak_live_estimate_bytes": int(rep.peak_live_bytes),
@@ -1299,6 +1338,19 @@ def main():
                   # see docs/linting.md#apx2xx)
                   "lint_spmd_errors": mem.get("lint_spmd", {}).get(
                       "congruence_errors"),
+                  # the sharding observatory columns, off the SAME
+                  # donated executable (apex_tpu.prof.shard_report +
+                  # mesh_explain.price_candidate — zero extra
+                  # compiles, asserted via compile_watch): per-axis
+                  # sharded/replicated HBM, per-axis collective wire
+                  # bytes (explicit "unknown" row for unregistered
+                  # scopes), and the pre-flight rank the headline
+                  # module earns under mesh_explain's (APX findings,
+                  # predicted comm) key — 1 means verdict-free
+                  "axis_hbm": mem.get("axis_hbm"),
+                  "axis_wire_bytes": mem.get("axis_wire_bytes"),
+                  "mesh_explain_rank": mem.get(
+                      "mesh_explain", {}).get("rank"),
                   "n_compiles": n_compiles,
                   # the autotune-origin subset of n_compiles (0 until
                   # the item-4 tuner lands; the column exists so its

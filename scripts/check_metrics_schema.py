@@ -76,13 +76,22 @@ split with its (rank, span) blame; a ``pod_drift`` is one CommPlan
 hop's plan-vs-measured row (link enum, positive milliseconds, stable
 ``comm_drift|op|axis/link`` fingerprint, boolean ``stale`` flag).
 
+``--kind sharding`` — the sharding-observatory channel
+(``apex_tpu/prof/sharding.py``, ``scripts/mesh_explain.py``): a
+``sharding_mesh`` header declares the mesh's axis names, then one
+``kind="sharding"`` row per axis carries the per-axis HBM
+sharded/replicated bytes, wire bytes, and α–β-predicted comm seconds
+— the axis is enum'd against the header's axes (plus the explicit
+``"unknown"`` overflow row), byte fields are non-negative ints, and
+``predicted_s`` is null on unmeasured links.
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
            [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline
-                   |cluster|integrity|numerics|podview]
+                   |cluster|integrity|numerics|podview|sharding]
            FILE
 """
 
@@ -506,7 +515,7 @@ GOODPUT_REQUIRED = {
     "linkfit": ("link", "bytes_per_s", "residual", "n_samples"),
 }
 GOODPUT_NULLABLE = {
-    "goodput": ("step", "goodput_frac"),
+    "goodput": ("step", "goodput_frac", "comm_axes_ms"),
     "straggler": ("slowest_span", "span_class", "slowest_span_ms"),
     "linkfit": ("axis", "alpha_us"),
 }
@@ -526,6 +535,32 @@ def _goodput_special(i, rec, kind, state, errors):
                     errors.append(
                         f"line {i}: buckets_ms[{bk!r}] must be a "
                         f"non-negative number, got {bv!r}")
+        # the per-axis exposed-comm split (StepLedger.comm_axes_ms):
+        # {axis: {"wire": ms, "skew": ms}} — axis names are free-form
+        # (the registry's canonical names plus "unknown"), the leaves
+        # are non-negative milliseconds
+        axes = rec.get("comm_axes_ms")
+        if "comm_axes_ms" in rec and axes is not None:
+            if not isinstance(axes, dict):
+                errors.append(f"line {i}: 'comm_axes_ms' must be an "
+                              f"object")
+            else:
+                for ax, parts in axes.items():
+                    if not isinstance(parts, dict):
+                        errors.append(
+                            f"line {i}: comm_axes_ms[{ax!r}] must be "
+                            f"an object, got {parts!r}")
+                        continue
+                    for pk, pv in parts.items():
+                        if pk not in ("wire", "skew"):
+                            errors.append(
+                                f"line {i}: comm_axes_ms[{ax!r}] key "
+                                f"{pk!r} not in ('wire', 'skew')")
+                        if not _is_number(pv) or pv < 0:
+                            errors.append(
+                                f"line {i}: comm_axes_ms[{ax!r}]"
+                                f"[{pk!r}] must be a non-negative "
+                                f"number, got {pv!r}")
         gf = rec.get("goodput_frac")
         if gf is not None and "goodput_frac" in rec and (
                 not _is_number(gf) or gf < 0):
@@ -830,6 +865,72 @@ def _podview_special(i, rec, kind, state, errors):
                           f"number, got {r!r}")
 
 
+# --- sharding channel schema --------------------------------------------------
+
+SHARDING_KINDS = ("sharding_mesh", "sharding")
+SHARDING_REQUIRED = {
+    "sharding_mesh": ("rank", "mesh", "axes", "axis_sizes",
+                      "wall_time"),
+    "sharding": ("rank", "axis", "hbm_sharded_bytes",
+                 "hbm_replicated_bytes", "wall_time"),
+}
+SHARDING_NULLABLE = {
+    # extra_axes: composite attribution rows beyond the mesh axes the
+    # stream will carry (e.g. the registry's flat "data" axis over a
+    # factored data_inter x data_intra mesh)
+    "sharding_mesh": ("step", "candidate", "extra_axes"),
+    # wire_bytes is null when the caller only measured HBM; predicted_s
+    # stays null on unmeasured links by contract (never a made-up 0)
+    "sharding": ("step", "candidate", "wire_bytes", "predicted_s",
+                 "link"),
+}
+
+
+def _sharding_special(i, rec, kind, state, errors):
+    """The ``sharding_mesh`` header declares the mesh's axis names
+    (plus any ``extra_axes`` composite attribution rows — e.g. the
+    registry's flat ``data`` axis over a factored mesh); every
+    subsequent per-axis row's ``axis`` must be one of them or the
+    explicit ``"unknown"`` overflow row — the enum is *per-stream*
+    (read from the header), not a global table, because every mesh
+    declares its own axes."""
+    if kind == "sharding_mesh":
+        axes = rec.get("axes")
+        if not (isinstance(axes, list) and axes
+                and all(isinstance(a, str) for a in axes)):
+            errors.append(f"line {i}: 'axes' must be a non-empty list "
+                          f"of axis names, got {axes!r}")
+        else:
+            state["axes"] = tuple(axes)
+        extra = rec.get("extra_axes")
+        if extra is not None:
+            if not (isinstance(extra, list)
+                    and all(isinstance(a, str) for a in extra)):
+                errors.append(f"line {i}: 'extra_axes' must be a list "
+                              f"of axis names, got {extra!r}")
+            elif "axes" in state:
+                state["axes"] = state["axes"] + tuple(extra)
+        sizes = rec.get("axis_sizes")
+        if sizes is not None and not (
+                isinstance(sizes, dict)
+                and all(isinstance(v, int) and not isinstance(v, bool)
+                        and v >= 1 for v in sizes.values())):
+            errors.append(f"line {i}: 'axis_sizes' must map axis "
+                          f"names to sizes >= 1, got {sizes!r}")
+    if kind == "sharding":
+        ax = rec.get("axis")
+        if not isinstance(ax, str):
+            errors.append(f"line {i}: 'axis' must be a string, "
+                          f"got {ax!r}")
+        else:
+            known = state.get("axes")
+            if known is not None and ax != "unknown" \
+                    and ax not in known:
+                errors.append(f"line {i}: axis {ax!r} not among the "
+                              f"mesh header's axes {known} (or "
+                              f"'unknown')")
+
+
 # --- the channel registry -----------------------------------------------------
 
 SCHEMAS: Dict[str, ChannelSchema] = {
@@ -904,6 +1005,12 @@ SCHEMAS: Dict[str, ChannelSchema] = {
                 "measured_ms", "wall_time"),
         enums={"link": PODVIEW_LINKS},
         special=_podview_special),
+    "sharding": ChannelSchema(
+        SHARDING_KINDS, SHARDING_REQUIRED, SHARDING_NULLABLE,
+        counters=("rank", "step", "hbm_sharded_bytes",
+                  "hbm_replicated_bytes", "wire_bytes"),
+        nonneg=("predicted_s", "wall_time"),
+        special=_sharding_special),
 }
 
 
@@ -951,6 +1058,7 @@ check_cluster_lines = _make_checker(SCHEMAS["cluster"])
 check_integrity_lines = _make_checker(SCHEMAS["integrity"])
 check_numerics_lines = _make_checker(SCHEMAS["numerics"])
 check_podview_lines = _make_checker(SCHEMAS["podview"])
+check_sharding_lines = _make_checker(SCHEMAS["sharding"])
 
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
@@ -960,7 +1068,8 @@ CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "cluster": check_cluster_lines,
             "integrity": check_integrity_lines,
             "numerics": check_numerics_lines,
-            "podview": check_podview_lines}
+            "podview": check_podview_lines,
+            "sharding": check_sharding_lines}
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,455 @@
+"""AOT MeshPlan pre-flight explainer: compile the flagship step against
+candidate mesh layouts and rank them BEFORE any pod time is spent.
+
+For each candidate the script AOT-compiles the step (``.lower().
+compile()`` — the only dispatches are the ZeRO variant's state init),
+then prices the compiled module with three per-axis views that all join
+through the same primitives the runtime observability uses:
+
+- **per-axis HBM** — :func:`apex_tpu.prof.shard_report`: for every mesh
+  axis, which bytes are sharded by it vs replicated over it, closing
+  over :func:`apex_tpu.prof.memory_report`'s class totals within 1%
+  (ZeRO's annotation-invisible opt-state shards enter via the
+  ``overrides=`` declared-layout escape hatch);
+- **per-axis wire bytes** — the optimized module's collective result
+  shapes joined scope→axis through the ONE planned-collective registry
+  (:func:`apex_tpu.monitor.scope_axis_row`; unattributable traffic
+  lands in an explicit ``unknown`` row);
+- **predicted per-axis comm seconds** — the measured-or-default α–β
+  :class:`~apex_tpu.lint.mesh_model.MeshModel` link budgets
+  (``hop_seconds``), the same model :func:`apex_tpu.parallel.plan_comm`
+  optimizes and :mod:`apex_tpu.monitor.comm_drift` later re-judges
+  against measurements — a stale model flags there, not here.
+
+Candidates are ranked by (APX findings, predicted comm seconds): the
+pre-flight verdict is the apexlint SPMD pass (APX201–204) over each
+module, so a flat DCN-crossing layout ranks below its hierarchical
+factorization *with the finding attached*, not just a worse number.
+
+Candidate grammar: a ``parse_mesh_spec`` string with an optional
+suffix — ``dp2x4`` (hierarchical DDP + CommPlan), ``dp2x4flat``
+(same topology judged, but the step compiled with the FLAT sync —
+the APX203 shape), ``dp2x4zero`` / ``ici8zero`` (ZeRO
+``DistributedFusedAdam`` sharded over the data axes).
+
+Usage:
+  python scripts/mesh_explain.py --candidates dp2x4zero,dp2x4,dp2x4flat
+  python scripts/mesh_explain.py --jsonl preflight.jsonl   # sharding
+      # channel stream (check_metrics_schema.py --kind sharding)
+  python scripts/mesh_explain.py --forecast tp=2,pp=2      # what-if
+      # further-axis HBM shrink per candidate (ShardReport.forecast_axes)
+  python scripts/mesh_explain.py --cpu8    # asserted 8-device CPU-mesh
+      # audit (run_tier1.sh --smoke): HBM closure + ZeRO 1/8 ratio,
+      # plan-vs-priced dp-axis agreement, flat-ranks-last + APX203,
+      # and JSONL schema validity; exit status is the verdict
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+DEFAULT_CANDIDATES = "dp2x4zero,dp2x4,dp2x4flat"
+MESSAGE_SIZE = 30_000
+IMAGE = 32
+PER_CHIP_BATCH = 4
+
+#: agreement tolerance (ratio band) between this script's byte-level
+#: per-axis pricing and the CommPlan's α–β hop prediction: the two sit
+#: on the same MeshModel link budgets but differ by ring factors,
+#: per-hop α and CPU float-normalization of bf16 wires — an order of
+#: magnitude apart means a broken join, not a modeling nuance
+AGREE_BAND = (0.25, 4.0)
+
+
+def _small_model():
+    from apex_tpu import models
+    return models.ResNet(stage_sizes=[1, 1], num_classes=10, width=16,
+                         dtype=jnp.bfloat16)
+
+
+class Candidate:
+    """One parsed candidate spec: base mesh model + step variant."""
+
+    def __init__(self, label, mm, kind, flat):
+        self.label = label      # the spec as given ("dp2x4zero")
+        self.mm = mm            # MeshModel of the base spec
+        self.kind = kind        # "ddp" | "zero"
+        self.flat = flat        # compile the flat sync (APX203 twin)
+
+
+def parse_candidate(spec: str) -> Candidate:
+    from apex_tpu.lint.mesh_model import parse_mesh_spec
+    s = spec.strip()
+    kind, flat, base = "ddp", False, s
+    if s.endswith("zero"):
+        kind, base = "zero", s[:-4]
+    elif s.endswith("flat"):
+        flat, base = True, s[:-4]
+    return Candidate(s, parse_mesh_spec(base), kind, flat)
+
+
+def _count_params(model, image_size):
+    """Flagship param count from avals only (no arrays, no dispatch)."""
+    x1 = jnp.ones((2, image_size, image_size, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), x1, train=True))
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(variables["params"]))
+
+
+# --- pricing (pure: hlo text + mesh model -> numbers; zero compiles) ---------
+
+def price_candidate(hlo_text, mesh_model, known_scopes=()):
+    """Price one compiled module against a mesh model. Pure text+model
+    arithmetic — no compile, no dispatch — so ``bench.py`` reuses it on
+    the module its memory row already compiled.
+
+    Returns ``{"wire_by_axis": {axis: bytes}, "predicted_s": {axis:
+    s | None}, "findings": [lint.Finding], "codes": ["APX203", ...],
+    "predicted_total_s": float}``. An axis the model has no link budget
+    for (the ``unknown`` row) prices to ``None`` — unattributable
+    traffic is surfaced, never priced on faith. A composite axis (the
+    registry's flat ``data`` over a factored model) rides the model's
+    slowest link: the conservative pre-flight direction.
+    """
+    from apex_tpu.lint.findings import RULES
+    from apex_tpu.lint.spmd_pass import lint_spmd_text
+    from apex_tpu.monitor.collectives import collective_bytes_by_axis
+
+    wire = {ax: sum(per.values())
+            for ax, per in collective_bytes_by_axis(hlo_text).items()}
+    axis_link = {a.name: a.link for a in mesh_model.axes}
+    slowest = ("dcn" if any(a.link == "dcn" for a in mesh_model.axes)
+               else "ici")
+    predicted = {}
+    for ax, nbytes in wire.items():
+        link = axis_link.get(ax)
+        if link is None and ax != "unknown":
+            link = slowest            # composite axis: slowest link
+        predicted[ax] = (mesh_model.hop_seconds(nbytes, link)
+                         if link else None)
+    findings = lint_spmd_text(hlo_text, mesh_model=mesh_model,
+                              known_scopes=known_scopes)
+    return {"wire_by_axis": wire, "predicted_s": predicted,
+            "findings": findings,
+            "codes": sorted({RULES[f.rule].id for f in findings}),
+            "predicted_total_s": sum(v for v in predicted.values()
+                                     if v is not None)}
+
+
+# --- per-candidate compile ---------------------------------------------------
+
+def lower_zero_flagship(mesh, axes, model, *, image_size,
+                        per_chip_batch):
+    """Compile the ZeRO flagship (``DistributedFusedAdam`` sharded over
+    ``axes`` — a tuple reduce-scatters per axis in order, so a factored
+    mesh routes each hop on its own link). Same structure as
+    ``memory_budget.build_programs``'s zero program; the only
+    dispatches are the state init and device_put commits."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import amp, ops
+    from apex_tpu.optim import DistributedFusedAdam
+    from apex_tpu.trace.spans import span
+
+    axes = tuple(axes)
+    tx = DistributedFusedAdam(
+        lr=1e-3, axis_name=axes if len(axes) > 1 else axes[0])
+    amp_opt = amp.Amp(amp.Policy.from_opt_level("O2"), tx)
+
+    n = mesh.size
+    rng = np.random.RandomState(0)
+    batch = per_chip_batch * n
+    x = jnp.asarray(rng.rand(batch, image_size, image_size, 3)
+                    .astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, batch), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def step(state, bs, xb, yb):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": bs}, xb, train=True,
+                mutable=["batch_stats"])
+            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, yb))
+            with span("ddp/loss_pmean", kind="collective"):
+                for ax in axes:
+                    loss = jax.lax.pmean(loss, ax)
+            return loss, mut["batch_stats"]
+
+        (loss, new_bs), grads, state, finite = amp_opt.backward(
+            state, loss_fn, has_aux=True)
+        state = amp_opt.apply_gradients(state, grads, finite)
+        return state, new_bs, loss
+
+    state = jax.jit(jax.shard_map(
+        lambda p: amp_opt.init(p), mesh=mesh, in_specs=(P(),),
+        out_specs=P(), check_vma=False))(params)
+    mapped = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P(), P(), P(axes), P(axes)),
+        out_specs=(P(), P(), P()), check_vma=False)
+    args = (state,
+            jax.device_put(batch_stats, NamedSharding(mesh, P())),
+            jax.device_put(x, NamedSharding(mesh, P(axes))),
+            jax.device_put(y, NamedSharding(mesh, P(axes))))
+    compiled = jax.jit(mapped).lower(*args).compile()
+    return compiled, tx, params
+
+
+def explain_candidate(cand, model, *, image_size=IMAGE,
+                      per_chip_batch=PER_CHIP_BATCH,
+                      message_size=MESSAGE_SIZE):
+    """Compile one candidate's flagship step and price it. Returns a
+    row dict (shard report, per-axis wire/predicted, verdict)."""
+    import pod_comm_budget as pcb
+    from jax.sharding import Mesh
+
+    from apex_tpu import parallel
+    from apex_tpu.parallel import hierarchy
+    from apex_tpu.prof import shard_report
+
+    devices = np.asarray(jax.devices()).reshape(-1)
+    mm = cand.mm
+    if mm.n_devices != devices.size:
+        raise SystemExit(
+            f"candidate {cand.label}: mesh model wants {mm.n_devices} "
+            f"devices, have {devices.size}")
+    hier = any(a.link == "dcn" for a in mm.axes)
+    plan, tx, params, overrides = None, None, None, None
+
+    if cand.kind == "zero":
+        mesh = (pcb.hierarchical_mesh_for_model(mm, devices) if hier
+                else Mesh(devices, (parallel.DATA_AXIS,)))
+        axes = mm.axis_names if hier else (parallel.DATA_AXIS,)
+        compiled, tx, params = lower_zero_flagship(
+            mesh, axes, model, image_size=image_size,
+            per_chip_batch=per_chip_batch)
+        # the manual-sharding escape hatch: shard_map carves the opt
+        # slots by hand, so the annotation says replicated — declare
+        # the real layout (rows report source="declared")
+        overrides = {r"opt_state\.slots": tuple(mm.axis_names)}
+    elif hier and not cand.flat:
+        mesh = pcb.hierarchical_mesh_for_model(mm, devices)
+        plan = hierarchy.plan_comm(
+            mm, grad_bytes=4 * _count_params(model, image_size))
+        lowered, params = pcb.lower_flagship(
+            mesh, devices.size, delay_allreduce=False, model=model,
+            image_size=image_size, per_chip_batch=per_chip_batch,
+            message_size=message_size, comm_plan=plan)
+        compiled = lowered.compile()
+    else:
+        # flat bucketed sync — for a "...flat" candidate this is the
+        # deliberate APX203 twin: compiled flat, judged hierarchical
+        mesh = Mesh(devices, (parallel.DATA_AXIS,))
+        lowered, params = pcb.lower_flagship(
+            mesh, devices.size, delay_allreduce=False, model=model,
+            image_size=image_size, per_chip_batch=per_chip_batch,
+            bucket_allreduce=True, message_size=message_size)
+        compiled = lowered.compile()
+
+    hlo = compiled.as_text()
+    sr = shard_report(compiled, mm, batch_size=per_chip_batch,
+                      overrides=overrides)
+    price = price_candidate(hlo, mm)
+    return {"candidate": cand.label, "kind": cand.kind, "mm": mm,
+            "plan": plan, "tx": tx, "params": params, "hlo": hlo,
+            "sr": sr, "price": price}
+
+
+# --- the explainer -----------------------------------------------------------
+
+def _fmt_s(v):
+    return "-" if v is None else f"{v * 1e3:.3f} ms"
+
+
+def explain(candidate_specs, *, model=None, jsonl=None, forecast=None,
+            image_size=IMAGE, per_chip_batch=PER_CHIP_BATCH,
+            message_size=MESSAGE_SIZE):
+    """Compile, price, rank and print every candidate; optionally emit
+    the sharding-channel JSONL stream. Returns the ranked rows."""
+    model = model if model is not None else _small_model()
+    rows = [explain_candidate(parse_candidate(s), model,
+                              image_size=image_size,
+                              per_chip_batch=per_chip_batch,
+                              message_size=message_size)
+            for s in candidate_specs]
+    # rank: findings first (a clean layout beats a flagged one no
+    # matter the predicted number), then predicted comm seconds
+    order = sorted(rows, key=lambda r: (len(r["price"]["findings"]),
+                                        r["price"]["predicted_total_s"]))
+    for i, r in enumerate(order):
+        r["rank"] = i + 1
+
+    for r in order:
+        p = r["price"]
+        verdict = (", ".join(p["codes"]) if p["codes"] else "APX clean")
+        print(f"\n== rank {r['rank']}: {r['candidate']} "
+              f"[{r['kind']}{', flat sync' if 'flat' in r['candidate'] else ''}]"
+              f" — {verdict}, predicted comm "
+              f"{p['predicted_total_s'] * 1e3:.3f} ms")
+        sr = r["sr"]
+        axis_rows = list(sr.axis_names) + [
+            a for a in p["wire_by_axis"] if a not in sr.axis_names]
+        print(f"   {'axis':<12} {'hbm sharded':>12} {'hbm repl':>12} "
+              f"{'wire':>12} {'pred':>10}")
+        for ax in axis_rows:
+            b = (sr.axis_bytes(ax) if ax in sr.axis_table
+                 else {"sharded_bytes": 0, "replicated_bytes": 0})
+            print(f"   {ax:<12} {b['sharded_bytes']:>12} "
+                  f"{b['replicated_bytes']:>12} "
+                  f"{p['wire_by_axis'].get(ax, 0):>12} "
+                  f"{_fmt_s(p['predicted_s'].get(ax)):>10}")
+        for f in p["findings"]:
+            print(f"   finding {f.rule}: {f.message[:110]}")
+        if forecast:
+            fc = sr.forecast_axes(forecast)
+            print(f"   forecast {fc['factors']}: "
+                  f"{fc['total_now']} -> {fc['total_forecast']} B")
+
+    if jsonl:
+        from apex_tpu.monitor import JSONLSink, MetricsLogger
+        logger = MetricsLogger(sinks=[], sharding_sink=JSONLSink(jsonl))
+        for r in order:
+            logger.attach_shard_report(
+                r["sr"], candidate=r["candidate"],
+                wire_by_axis=r["price"]["wire_by_axis"],
+                predicted_s=r["price"]["predicted_s"])
+        logger.close()
+        print(f"\nsharding stream -> {jsonl} "
+              f"(check_metrics_schema.py --kind sharding)")
+    return order
+
+
+# --- the asserted cpu8 audit (run_tier1.sh --smoke) --------------------------
+
+def main_cpu8() -> int:
+    jax.config.update("jax_platforms", "cpu")
+    from apex_tpu import _compat
+    _compat.request_cpu_devices(8)
+
+    import tempfile
+
+    import check_metrics_schema as cms
+
+    n = 8
+    model = _small_model()
+    path = os.path.join(tempfile.mkdtemp(prefix="mesh_explain_"),
+                        "sharding.jsonl")
+    print("mesh pre-flight audit, 8-device CPU mesh")
+    rows = explain(DEFAULT_CANDIDATES.split(","), model=model,
+                   jsonl=path, forecast={"tp": 2})
+    by = {r["candidate"]: r for r in rows}
+
+    # (a) per-axis HBM closes over the memory report on the ZeRO
+    # flagship, and the declared opt-state shards show the ~1/8 ratio
+    z = by["dp2x4zero"]
+    sr = z["sr"]
+    rep = sr.memory
+    rel = (abs(rep.attributed_total() - rep.total_bytes)
+           / max(rep.total_bytes, 1))
+    ok, worst = sr.closure()
+    print(f"\n(a) zero flagship: memory attribution rel err {rel:.4%}, "
+          f"per-axis closure worst rel {worst:.4%}")
+    assert rel < 0.01, (
+        f"memory attribution off by {rel:.2%} on the zero flagship")
+    assert ok, f"per-axis HBM table does not close: {worst:.2%}"
+    ratio = sr.class_shard_ratio("optimizer_state")
+    analytic = z["tx"].state_bytes(z["params"], world=n)
+    print(f"    opt-state local/global ratio {ratio:.4f} "
+          f"(1/N = {1 / n:.4f}, analytic padding slack "
+          f"{analytic['ratio'] * n:.3f}x)")
+    assert 0.8 / n <= ratio <= 1.5 / n, (
+        f"declared ZeRO opt-state shards not ~1/{n}: {ratio:.4f}")
+    for ax in sr.axis_names:
+        shb = sr.axis_table[ax]["sharded"].get("optimizer_state", 0)
+        assert shb > 0, (
+            f"axis {ax}: no opt-state bytes attributed sharded — the "
+            "declared-override join broke")
+
+    # (b) priced dp-axis comm seconds agree with the CommPlan's α–β
+    # hop prediction (the pod_comm_budget numbers) within tolerance
+    h = by["dp2x4"]
+    plan_pred = h["plan"].predicted_seconds()
+    mine = h["price"]["predicted_s"]
+    print(f"(b) dp2x4 priced vs plan: "
+          + ", ".join(f"{ax} {_fmt_s(mine.get(ax))}" for ax in mine)
+          + " | plan "
+          + ", ".join(f"{k} {v * 1e3:.3f} ms"
+                      for k, v in plan_pred.items()))
+    for ax, link in (("data_intra", "ici"), ("data_inter", "dcn")):
+        a, b = mine.get(ax), plan_pred.get(link)
+        assert a and b, (
+            f"missing {ax} pricing ({a}) or plan {link} prediction "
+            f"({b}) — the scope→axis join or the plan broke")
+        r = a / b
+        print(f"    {ax}/{link}: priced/plan ratio {r:.3f}")
+        assert AGREE_BAND[0] <= r <= AGREE_BAND[1], (
+            f"{ax} priced {a:.2e}s vs plan {link} {b:.2e}s: ratio "
+            f"{r:.2f} outside {AGREE_BAND}")
+
+    # (c) the flat candidate ranks below the hierarchical one and
+    # carries the APX203 finding — the negative twin of the ranking
+    flat, hier = by["dp2x4flat"], by["dp2x4"]
+    print(f"(c) ranks: dp2x4 #{hier['rank']}, dp2x4flat "
+          f"#{flat['rank']} ({', '.join(flat['price']['codes'])})")
+    assert "APX203" in flat["price"]["codes"], (
+        "flat candidate lost its APX203 finding — the verdict would "
+        "pass a DCN-flat layout")
+    assert flat["rank"] > hier["rank"], (
+        f"flat candidate ranked {flat['rank']} above hierarchical "
+        f"{hier['rank']}")
+    assert not hier["price"]["codes"], (
+        f"hierarchical candidate has findings: {hier['price']['codes']}")
+
+    # (d) the emitted sharding stream validates
+    with open(path) as f:
+        errors = cms.check_sharding_lines(f)
+    assert not errors, "sharding stream invalid:\n" + "\n".join(errors)
+    with open(path) as f:
+        n_lines = sum(1 for _ in f)
+    print(f"(d) sharding stream {path}: {n_lines} events, schema ok")
+    print("\nmesh pre-flight audit ok")
+    return 0
+
+
+def _parse_forecast(arg):
+    out = {}
+    for part in arg.split(","):
+        k, _, v = part.partition("=")
+        out[k.strip()] = int(v)
+    return out
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if "--cpu8" in argv:
+        return main_cpu8()
+    candidates = DEFAULT_CANDIDATES
+    jsonl = forecast = None
+    it = iter(argv)
+    for a in it:
+        if a == "--candidates":
+            candidates = next(it, "")
+        elif a == "--jsonl":
+            jsonl = next(it, None)
+        elif a == "--forecast":
+            forecast = _parse_forecast(next(it, ""))
+        elif a in ("-h", "--help"):
+            print(__doc__)
+            return 2
+    explain([c for c in candidates.split(",") if c],
+            jsonl=jsonl, forecast=forecast)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

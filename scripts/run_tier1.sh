@@ -84,6 +84,13 @@
 #                                   #   backward gap named, AOT-only
 #                                   #   path, sentinel seeded positive
 #                                   #   + negative twin
+#                                   # + the mesh pre-flight explainer
+#                                   #   (--cpu8): per-axis HBM closure
+#                                   #   + ZeRO ~1/N declared shards,
+#                                   #   wire pricing vs the alpha-beta
+#                                   #   plan within band, flat ranked
+#                                   #   below hierarchical with APX203
+#                                   #   attached, sharding schema
 #                                   # + the perf sentinel gate over the
 #                                   #   committed BENCH_r0*.json
 #                                   #   trajectory (exit 1 on unwaived
@@ -289,6 +296,16 @@ EOF
     # trajectory AND passes clean on the unmodified trajectory (the
     # negative twin), (d) every stream passes --kind roofline
     JAX_PLATFORMS=cpu python scripts/roofline_audit.py --cpu8
+
+    echo "== smoke: mesh pre-flight explainer (--cpu8)"
+    # asserts: (a) per-axis HBM closes over the memory report's class
+    # totals and the ZeRO candidate's declared opt-state shards show
+    # the ~1/N local/global ratio, (b) per-axis wire pricing agrees
+    # with the alpha-beta comm plan within the stated band on both
+    # hops, (c) the flat candidate is ranked below the hierarchical
+    # one WITH an APX203 verdict attached while the hierarchical one
+    # is clean, (d) the emitted stream passes --kind sharding
+    JAX_PLATFORMS=cpu python scripts/mesh_explain.py --cpu8
 
     echo "== smoke: perf sentinel gate over the committed trajectory"
     # the noise-aware regression gate (robust median/MAD baselines,

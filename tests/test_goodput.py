@@ -606,3 +606,98 @@ def test_logger_attach_populates_wire_breakdown(tmp_path):
     assert rec["wire_to_logical"] == 0.0
     from scripts.check_metrics_schema import check_lines
     assert check_lines(buf.getvalue().splitlines()) == []
+
+
+# --- per-axis exposed-comm split (ISSUE-17) ----------------------------------
+
+def test_comm_axes_split_joins_registry():
+    """Each collective span's exposed time lands on its registry axis
+    (hop sub-spans on the factored axes, not the composite parent);
+    unregistered scopes land in the explicit "unknown" row; the axis
+    sums equal the comm buckets exactly."""
+    ledger = GoodputLedger(rank=0)
+    st = _mk_step(3, 10.0, [
+        ("ddp/sync_gradients/bucket00/ici", "collective", 0.000, 2.0, 1),
+        ("ddp/sync_gradients/bucket00/dcn", "collective", 0.002, 1.0, 1),
+        ("nobody/planned/this", "collective", 0.003, 1.0, 1),
+        ("dispatch", "span", 0.004, 6.0, 0),
+    ])
+    ledger.on_step(st)
+    rec = ledger.steps[-1]
+    axes = rec.comm_axes_ms
+    assert set(axes) == {"data_intra", "data_inter", "unknown"}
+    assert axes["data_intra"]["wire"] == pytest.approx(2.0)
+    assert axes["data_inter"]["wire"] == pytest.approx(1.0)
+    assert axes["unknown"]["wire"] == pytest.approx(1.0)
+    assert sum(p["wire"] for p in axes.values()) == pytest.approx(
+        rec.buckets["comm_wire"])
+    assert sum(p["skew"] for p in axes.values()) == pytest.approx(
+        rec.buckets["comm_skew"])
+    ev = rec.to_event(0)
+    assert ev["comm_axes_ms"]["data_intra"]["wire"] == pytest.approx(2.0)
+    assert _schema()([json.dumps(ev)]) == []
+    totals = ledger.comm_axes_totals()
+    assert totals["data_intra"]["wire"] == pytest.approx(2.0)
+
+
+def test_comm_axes_skew_proportional():
+    """A pod-skew join reclassifies each axis's wire share
+    proportionally, so the per-axis sums still equal the
+    comm_wire/comm_skew buckets after the move."""
+    ledger = GoodputLedger(rank=0)
+    ledger.note_pod_skew(1.5, step=0)
+    st = _mk_step(0, 10.0, [
+        ("ddp/sync_gradients/bucket00/ici", "collective", 0.000, 2.0, 1),
+        ("ddp/sync_gradients/bucket00/dcn", "collective", 0.002, 1.0, 1),
+    ])
+    ledger.on_step(st)
+    rec = ledger.steps[-1]
+    assert rec.buckets["comm_skew"] == pytest.approx(1.5)
+    assert rec.buckets["comm_wire"] == pytest.approx(1.5)
+    axes = rec.comm_axes_ms
+    # ici carried 2/3 of the wire -> 2/3 of the skew blame
+    assert axes["data_intra"]["skew"] == pytest.approx(1.0)
+    assert axes["data_inter"]["skew"] == pytest.approx(0.5)
+    assert (axes["data_intra"]["wire"] + axes["data_intra"]["skew"]
+            == pytest.approx(2.0))
+    assert sum(p["wire"] for p in axes.values()) == pytest.approx(
+        rec.buckets["comm_wire"])
+    assert sum(p["skew"] for p in axes.values()) == pytest.approx(
+        rec.buckets["comm_skew"])
+
+
+def test_scope_axis_single_source():
+    """The scope→axis join every per-axis consumer shares: ONE function
+    (monitor.collectives.scope_axis_row) over ONE table
+    (parallel.registry.COLLECTIVE_SCOPES) — a second private copy can
+    silently diverge. The hop sub-span rows must precede their
+    ddp/sync_gradients parent in the registry, or first-match
+    resolution swallows the factored-axis attribution."""
+    from apex_tpu.monitor.collectives import scope_axis_row
+
+    assert scope_axis_row("ddp/sync_gradients/bucket03/ici") == "data_intra"
+    assert scope_axis_row("ddp/sync_gradients/bucket03/dcn") == "data_inter"
+    assert scope_axis_row("ddp/sync_gradients") == "data"
+    assert scope_axis_row("zero/grad_scatter") == "data"
+    assert scope_axis_row("nobody/planned/this") == "unknown"
+    # one definition, one table, in the whole package
+    defs, tables = [], []
+    for root, _dirs, files in os.walk(
+            os.path.join(_REPO_ROOT, "apex_tpu")):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            src = open(os.path.join(root, fname)).read()
+            if "def scope_axis_row" in src:
+                defs.append(fname)
+            if "CollectiveScope(" in src and fname != "registry.py":
+                tables.append(fname)
+    assert defs == ["collectives.py"], defs
+    assert tables == [], f"private collective-scope tables: {tables}"
+    # the per-axis consumers route through the shared join
+    gp = open(os.path.join(_REPO_ROOT, "apex_tpu", "monitor",
+                           "goodput.py")).read()
+    assert "scope_axis_row" in gp
+    me = open(os.path.join(_REPO_ROOT, "scripts", "mesh_explain.py")).read()
+    assert "collective_bytes_by_axis" in me, \
+        "mesh_explain grew its own scope→axis pricing map"

@@ -717,13 +717,14 @@ class TestNumericsSchema:
 
 class TestChannelRegistry:
     """The MetricsLogger registry refactor: every channel is one
-    declarative row; numerics is the 10th, podview the 11th."""
+    declarative row; numerics is the 10th, podview the 11th,
+    sharding the 12th."""
 
-    def test_eleven_channels_podview_last(self):
+    def test_twelve_channels_sharding_last(self):
         from apex_tpu import monitor
         names = [c.name for c in monitor.CHANNELS]
-        assert len(names) == 11 and names[-1] == "podview"
-        assert names[-2] == "numerics"
+        assert len(names) == 12 and names[-1] == "sharding"
+        assert names[-2] == "podview"
 
     def test_registry_kinds_match_schema_registry(self):
         from apex_tpu import monitor

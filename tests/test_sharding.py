@@ -1,0 +1,310 @@
+"""Per-axis sharding observatory: HloSharding parsing, axis
+disposition, the declared-override escape hatch, HBM closure, the
+what-if forecaster, and the sharding event channel with its schema
+negative twins (ISSUE-17).
+"""
+
+import io
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu import monitor
+from apex_tpu.lint.mesh_model import parse_mesh_spec
+from apex_tpu.prof.sharding import (parameter_shardings,
+                                    parse_hlo_sharding, shard_report)
+
+_REPO_ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _schema():
+    from scripts.check_metrics_schema import check_sharding_lines
+    return check_sharding_lines
+
+
+# --- HloSharding text parsing ------------------------------------------------
+
+class TestParseHloSharding:
+    def test_replicated(self):
+        tiles, form = parse_hlo_sharding("replicated", 8)
+        assert form == "replicated" and tiles == [0] * 8
+
+    def test_maximal(self):
+        tiles, form = parse_hlo_sharding("maximal device=3", 8)
+        assert form == "maximal" and len(set(tiles)) == 1
+
+    def test_iota(self):
+        tiles, form = parse_hlo_sharding("devices=[8,1]<=[8]", 8)
+        assert form == "tiled" and tiles == list(range(8))
+
+    def test_iota_transpose(self):
+        # arange(8).reshape(2,4).T ravels to [0,4,1,5,2,6,3,7]: device
+        # order in tile sequence — tiles[device] inverts it
+        tiles, form = parse_hlo_sharding("devices=[4,2]<=[2,4]T(1,0)", 8)
+        assert form == "tiled"
+        order = [0, 4, 1, 5, 2, 6, 3, 7]
+        assert tiles == [order.index(d) for d in range(8)]
+
+    def test_explicit_device_list(self):
+        tiles, form = parse_hlo_sharding("devices=[8,1]0,1,2,3,4,5,6,7", 8)
+        assert form == "tiled" and tiles == list(range(8))
+
+    def test_last_tile_dim_replicate(self):
+        # 2 tiles x 4-way replication: devices 0-3 share tile 0
+        tiles, form = parse_hlo_sharding(
+            "devices=[2,1,4]<=[8] last_tile_dim_replicate", 8)
+        assert form == "tiled"
+        assert tiles == [0, 0, 0, 0, 1, 1, 1, 1]
+        assert len(set(tiles)) == 2
+
+    def test_unparsed_is_conservative(self):
+        tiles, form = parse_hlo_sharding("devices=[4,1]<=[4]", 8)
+        assert tiles is None and form == "unparsed"
+        tiles, form = parse_hlo_sharding("gibberish", 8)
+        assert tiles is None and form == "unparsed"
+
+
+# --- disposition + report on a real compiled program -------------------------
+
+def _compile_flat(mesh):
+    from apex_tpu.trace.spans import span
+
+    init = {"w": jnp.ones((32, 8), jnp.float32)}
+    batch = jnp.ones((16 * 8, 32), jnp.float32)
+
+    def step(params, x):
+        h = x @ params["w"]
+        loss = jnp.sum(h * h)
+        with span("ddp/sync_gradients", kind="collective"):
+            loss = jax.lax.pmean(loss, "data")
+        return loss
+
+    mapped = jax.shard_map(step, mesh=mesh,
+                           in_specs=(P(), P("data")), out_specs=P(),
+                           check_vma=False)
+    return jax.jit(mapped).lower(init, batch).compile()
+
+
+class TestShardReport:
+    @pytest.fixture(scope="class")
+    def flat_report(self, mesh8):
+        compiled = _compile_flat(mesh8)
+        return shard_report(compiled, parse_mesh_spec("ici8"))
+
+    def test_annotation_disposition(self, flat_report):
+        sr = flat_report
+        by_path = {r.path or r.name: r for r in sr.records}
+        batch = [r for r in sr.records if r.shard_factor == 8]
+        assert batch, f"no x8-sharded arg found: {list(by_path)}"
+        assert all(r.sharded_by("data") for r in batch)
+        weights = [r for r in sr.records
+                   if r.shard_factor == 1 and r.bytes >= 32 * 8 * 4]
+        assert weights and not any(r.sharded_by("data") for r in weights)
+        assert all(r.source == "annotation" for r in batch)
+        assert all(r.source in ("annotation", "none") for r in weights)
+
+    def test_closure_and_axis_bytes(self, flat_report):
+        sr = flat_report
+        ok, worst = sr.closure()
+        assert ok, f"per-axis table does not close: {worst:.4f}"
+        b = sr.axis_bytes("data")
+        assert b["sharded_bytes"] > 0 and b["replicated_bytes"] > 0
+        assert (b["sharded_bytes"] + b["replicated_bytes"]
+                == sr.attributed_total())
+        with pytest.raises(KeyError):
+            sr.axis_bytes("no_such_axis")
+
+    def test_declared_override(self, mesh8):
+        """The ZeRO escape hatch: an override re-declares an
+        annotation-replicated arg as sharded; the row carries
+        source="declared" and the global bytes scale by the axis size."""
+        compiled = _compile_flat(mesh8)
+        mm = parse_mesh_spec("ici8")
+        plain = shard_report(compiled, mm)
+        ovr = shard_report(compiled, mm, overrides={r"w": ("data",)})
+        declared = [r for r in ovr.records if r.source == "declared"]
+        assert declared and all(r.sharded_by("data") for r in declared)
+        assert all(r.shard_factor == 8 for r in declared)
+        # the ratio drops: declared shards divide their global bytes
+        ratio_p = plain.class_shard_ratio("params")
+        ratio_o = ovr.class_shard_ratio("params")
+        assert ratio_o < ratio_p <= 1.0
+
+    def test_forecast_axes(self, flat_report):
+        sr = flat_report
+        fc = sr.forecast_axes({"tp": 2, "pp": 2})
+        assert fc["total_forecast"] <= fc["total_now"]
+        pc = fc["per_class"]["params"]
+        # params are fully replicated on the flat mesh: eligible > 0
+        # and the forecast shrinks them by the factor product (ceil)
+        assert pc["eligible"] > 0
+        assert pc["forecast"] == (pc["now"] - pc["eligible"]
+                                  + (pc["eligible"] + 3) // 4)
+        with pytest.raises(ValueError):
+            sr.forecast_axes({"tp": 0})
+
+    def test_factored_mesh_disposition(self, mesh2x4):
+        """On the dp2x4 mesh a batch arg sharded over both data axes is
+        sharded-by both; a synthetic 2-way tile assignment is sharded
+        by data_inter only."""
+        from apex_tpu.prof.sharding import _axis_disposition
+
+        mm = parse_mesh_spec("dp2x4")
+        axes = _axis_disposition([0, 0, 0, 0, 1, 1, 1, 1], mm)
+        assert axes == {"data_inter": "sharded",
+                        "data_intra": "replicated"}
+        axes = _axis_disposition(list(range(8)), mm)
+        assert axes == {"data_inter": "sharded", "data_intra": "sharded"}
+        axes = _axis_disposition([0] * 8, mm)
+        assert axes == {"data_inter": "replicated",
+                        "data_intra": "replicated"}
+
+    def test_parameter_shardings_scan(self, mesh8):
+        hlo = _compile_flat(mesh8).as_text()
+        ann = parameter_shardings(hlo)
+        assert ann, "no sharding annotations found in the module"
+        assert any("devices=" in b for b in ann.values())
+
+
+# --- the sharding event channel + schema negative twins ----------------------
+
+class TestShardingEvents:
+    @pytest.fixture(scope="class")
+    def events(self, mesh8):
+        compiled = _compile_flat(mesh8)
+        sr = shard_report(compiled, parse_mesh_spec("ici8"))
+        return sr.to_events(candidate="ici8",
+                            wire_by_axis={"data": 4096, "unknown": 64},
+                            predicted_s={"data": 1.2e-6})
+
+    def test_stream_validates(self, events):
+        check = _schema()
+        lines = [json.dumps(e) for e in events]
+        assert check(lines) == []
+        # header + one row per axis + the explicit unknown row
+        assert events[0]["kind"] == "sharding_mesh"
+        rows = {e["axis"]: e for e in events[1:]}
+        assert set(rows) == {"data", "unknown"}
+        assert rows["unknown"]["wire_bytes"] == 64
+        assert rows["unknown"]["hbm_sharded_bytes"] == 0
+        assert rows["data"]["predicted_s"] == pytest.approx(1.2e-6)
+
+    def test_logger_channel(self, mesh8):
+        compiled = _compile_flat(mesh8)
+        sr = shard_report(compiled, parse_mesh_spec("ici8"))
+        buf = io.StringIO()
+        logger = monitor.MetricsLogger(
+            sinks=[], sharding_sink=monitor.JSONLSink(buf))
+        logger.attach_shard_report(sr, candidate="ici8",
+                                   wire_by_axis={"data": 4096})
+        logger.close()
+        lines = buf.getvalue().splitlines()
+        assert len(lines) >= 2
+        assert _schema()(lines) == []
+        assert logger.shard_report is sr
+
+    def test_negative_twin_bad_axis(self, events):
+        check = _schema()
+        bad = [dict(e) for e in events]
+        bad[1]["axis"] = "bogus_axis"
+        errors = check([json.dumps(e) for e in bad])
+        assert errors and "bogus_axis" in errors[0]
+
+    def test_negative_twin_negative_bytes(self, events):
+        check = _schema()
+        bad = [dict(e) for e in events]
+        bad[1]["hbm_sharded_bytes"] = -1
+        assert check([json.dumps(e) for e in bad])
+
+    def test_negative_twin_missing_header_axes(self, events):
+        check = _schema()
+        hdr = dict(events[0])
+        del hdr["axes"]
+        assert check([json.dumps(hdr)])
+
+    def test_extra_axes_declaration(self, events):
+        """A composite attribution row (the registry's flat ``data``
+        over a factored mesh) passes only when the header declares it
+        in ``extra_axes`` — undeclared it is a schema violation."""
+        check = _schema()
+        hdr = dict(events[0], axes=["data_inter", "data_intra"],
+                   axis_sizes={"data_inter": 2, "data_intra": 4},
+                   extra_axes=["data"])
+        row = dict(events[1], axis="data")
+        assert check([json.dumps(hdr), json.dumps(row)]) == []
+        hdr_undeclared = dict(hdr, extra_axes=None)
+        errors = check([json.dumps(hdr_undeclared), json.dumps(row)])
+        assert errors and "'data'" in errors[0]
+
+    def test_composite_wire_row_declared_automatically(self, mesh2x4):
+        """to_events on a factored mesh with composite-axis wire (the
+        zero/ddp flat 'data' traffic) declares the extra row in the
+        header so the stream stays schema-valid."""
+        from apex_tpu.trace.spans import span
+
+        params = {"w": jnp.ones((16, 4), jnp.float32)}
+        x = jnp.ones((8 * 4, 16), jnp.float32)
+
+        def step(p, xb):
+            loss = jnp.sum(xb @ p["w"])
+            with span("ddp/loss_pmean", kind="collective"):
+                for ax in ("data_inter", "data_intra"):
+                    loss = jax.lax.pmean(loss, ax)
+            return loss
+
+        mapped = jax.shard_map(
+            step, mesh=mesh2x4,
+            in_specs=(P(), P(("data_inter", "data_intra"))),
+            out_specs=P(), check_vma=False)
+        compiled = jax.jit(mapped).lower(params, x).compile()
+        mm = parse_mesh_spec("dp2x4")
+        sr = shard_report(compiled, mm)
+        wire = {ax: sum(per.values()) for ax, per in
+                monitor.collective_bytes_by_axis(
+                    compiled.as_text()).items()}
+        assert wire.get("data", 0) > 0, wire
+        evs = sr.to_events(wire_by_axis=wire)
+        assert "data" in (evs[0]["extra_axes"] or [])
+        assert _schema()([json.dumps(e) for e in evs]) == []
+
+
+# --- mesh_explain pricing (pure function, no compile) ------------------------
+
+def test_price_candidate_pure():
+    """price_candidate is text+model arithmetic: per-axis wire joined
+    through the registry, predicted seconds from the model's link
+    budgets, unknown priced to None."""
+    import importlib.util as _util
+
+    path = os.path.join(_REPO_ROOT, "scripts", "mesh_explain.py")
+    spec = _util.spec_from_file_location("mesh_explain", path)
+    me = _util.module_from_spec(spec)
+    spec.loader.exec_module(me)
+
+    hlo = """
+HloModule m
+ENTRY e {
+  p0 = f32[1024]{0} parameter(0)
+  a1 = f32[1024]{0} all-reduce(p0), replica_groups={{0,1,2,3},{4,5,6,7}}, metadata={op_name="jit(f)/ddp/sync_gradients/bucket00/ici/psum"}
+  a2 = f32[256]{0} all-reduce(a1), replica_groups={{0,4},{1,5},{2,6},{3,7}}, metadata={op_name="jit(f)/ddp/sync_gradients/bucket00/dcn/psum"}
+  ROOT a3 = f32[64]{0} all-reduce(a2), metadata={op_name="jit(f)/nobody/planned"}
+}
+"""
+    mm = parse_mesh_spec("dp2x4")
+    out = me.price_candidate(hlo, mm)
+    assert out["wire_by_axis"]["data_intra"] == 1024 * 4
+    assert out["wire_by_axis"]["data_inter"] == 256 * 4
+    assert out["wire_by_axis"]["unknown"] == 64 * 4
+    assert out["predicted_s"]["unknown"] is None
+    assert out["predicted_s"]["data_intra"] == pytest.approx(
+        1024 * 4 / mm.link_bytes_per_s["ici"])
+    assert out["predicted_s"]["data_inter"] == pytest.approx(
+        256 * 4 / mm.link_bytes_per_s["dcn"])
+    assert out["predicted_total_s"] > 0
