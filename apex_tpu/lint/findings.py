@@ -85,7 +85,10 @@ RULES: Dict[str, Rule] = {r.slug: r for r in (
          "matmul operand dims are off the TPU tile grid — XLA pads to "
          "(sublane,128) tiles and the padding is wasted HBM/MXU work",
          "size matmul dims to multiples of (8,128) for f32 / (16,128) "
-         "for bf16 where the model allows"),
+         "for bf16 where the model allows; for shapes the model fixes, "
+         "run scripts/kernel_tune.py --update-db to sweep tuned block "
+         "shapes into scripts/kernel_tuning_db.json — a shape a "
+         "committed tuning entry covers stays informational"),
     # SPMD pass (cross-rank congruence + topology)
     Rule("APX201", "spmd-divergence", "error",
          "ranks disagree on a collective's order, channel id, replica "

@@ -265,11 +265,18 @@ def _pad_waste(shape_text: str) -> Tuple[int, int]:
 
 
 def tile_findings(hlo_text: str, *, min_waste_frac: float = 0.01,
-                  min_waste_bytes: int = 1 << 16) -> List[Finding]:
+                  min_waste_bytes: int = 1 << 16,
+                  tuned_shapes: Sequence[str] = ()) -> List[Finding]:
     """``dot`` instructions whose operand/result dims are off the
     (sublane, 128) tile grid, with the padding-waste estimate. Sub-1%
     AND sub-64KiB waste is rounding residue, not a finding — the floor
-    keeps ``bench.py``'s ``lint_findings`` count meaningful."""
+    keeps ``bench.py``'s ``lint_findings`` count meaningful.
+
+    ``tuned_shapes``: normalized shape signatures a committed tuning-DB
+    entry covers (``apex_tpu.ops.autotune.tuned_lint_shapes()``). A
+    matching signature stays at info severity with the fix-it naming
+    the DB entry — the shape is model-fixed and the kernel block was
+    tuned around it, so escalation would only nag."""
     shapes: Dict[str, str] = {}
     dots: List[Tuple[str, str, List[str]]] = []
     for name, shape, op, operands, _line in _hlo.iter_instructions(
@@ -294,14 +301,19 @@ def tile_findings(hlo_text: str, *, min_waste_frac: float = 0.01,
         n, w, lg = agg.get(sig, (0, 0, 0))
         agg[sig] = (n + 1, w + waste, lg + logical)
     findings = []
+    tuned = set(tuned_shapes)
     for sig, (n, waste, logical) in sorted(agg.items()):
         frac = waste / max(logical, 1)
+        db_satisfied = sig in tuned
+        severity = ("warning" if (frac >= 0.25 and waste >= 1 << 20
+                                  and not db_satisfied) else "info")
+        msg = (f"{n} dot(s) {sig} pad {frac:.1%} off the "
+               f"(sublane,128) grid")
+        if db_satisfied:
+            msg += (" [covered by a scripts/kernel_tuning_db.json "
+                    "entry — block shapes tuned around this padding]")
         findings.append(Finding(
-            rule="tile-padding",
-            severity="warning" if (frac >= 0.25 and waste >= 1 << 20)
-            else "info",
-            message=f"{n} dot(s) {sig} pad {frac:.1%} off the "
-                    f"(sublane,128) grid",
+            rule="tile-padding", severity=severity, message=msg,
             op="dot", scope=sig, bytes=waste, count=n))
     return findings
 
@@ -310,9 +322,11 @@ def tile_findings(hlo_text: str, *, min_waste_frac: float = 0.01,
 
 def lint_hlo_text(hlo_text: str, *, known_scopes: Sequence[str] = (),
                   min_donation_bytes: int = 4096,
-                  rules: Optional[Sequence[str]] = None) -> List[Finding]:
+                  rules: Optional[Sequence[str]] = None,
+                  tuned_shapes: Sequence[str] = ()) -> List[Finding]:
     """Run the HLO rules over optimized-HLO text. ``rules`` restricts
-    to a subset of slugs (default: all four)."""
+    to a subset of slugs (default: all four); ``tuned_shapes`` feeds
+    the APX104 tuning-DB exemption (see :func:`tile_findings`)."""
     run = set(rules) if rules is not None else None
 
     def on(slug: str) -> bool:
@@ -326,5 +340,5 @@ def lint_hlo_text(hlo_text: str, *, known_scopes: Sequence[str] = (),
     if on("host-transfer"):
         out += host_transfer_findings(hlo_text)
     if on("tile-padding"):
-        out += tile_findings(hlo_text)
+        out += tile_findings(hlo_text, tuned_shapes=tuned_shapes)
     return out

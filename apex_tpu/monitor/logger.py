@@ -108,10 +108,11 @@ CHANNELS: Tuple[ChannelSpec, ...] = (
                 why_unbuffered="per-step attribution and straggler "
                 "warnings are forensic; a zero-wall warmup step has "
                 "no finite goodput fraction (nested buckets nulled)"),
-    ChannelSpec("roofline", ("roofline", "regress"),
+    ChannelSpec("roofline", ("roofline", "regress", "tune"),
                 "record_roofline", True,
-                why_unbuffered="roofline joins and sentinel verdicts "
-                "are rare AOT/offline audits"),
+                why_unbuffered="roofline joins, sentinel verdicts and "
+                "autotune sweep/consult records are rare AOT/offline "
+                "audits"),
     ChannelSpec("cluster", ("cluster_lease", "cluster_generation",
                             "cluster_fence", "cluster_coord"),
                 "record_cluster", True,

@@ -43,8 +43,10 @@ from apex_tpu.ops.attention import (
     mask_softmax_dropout,
 )
 from apex_tpu.ops.multihead_attn import SelfMultiheadAttn, EncdecMultiheadAttn
+from apex_tpu.ops import autotune
 
 __all__ = [
+    "autotune",
     "multi_tensor_axpby", "multi_tensor_l2norm", "multi_tensor_maxnorm",
     "multi_tensor_scale", "per_tensor_l2norm", "optim_kernels",
     "FusedLayerNorm", "fused_layer_norm", "fused_layer_norm_affine",

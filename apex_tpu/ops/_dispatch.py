@@ -26,22 +26,69 @@ def use_interpret() -> bool:
 # Rows per grid step for flat-buffer elementwise kernels. A (512, 128) fp32
 # block is 256 KiB — small enough that an 8-operand optimizer kernel stays
 # well under the ~16 MiB VMEM budget with double buffering, large enough to
-# saturate HBM bandwidth.
+# saturate HBM bandwidth. Default only: `launch` consults the tuning DB
+# (apex_tpu.ops.autotune, family "optimizer") and accepts an explicit
+# ``block_rows`` per call; the module constant stays the arena's shard
+# alignment anchor (optim.distributed imports it).
 BLOCK_ROWS = 512
 LANES = 128
 
 
-def as_rows(buf):
+def as_rows(buf, block_rows=None):
     """View a flat arena buffer as (rows, 128). Arena buffers are padded to
-    BUFFER_MULTIPLE so rows % BLOCK_ROWS == 0 always holds."""
+    BUFFER_MULTIPLE (= 512 * 128 elements) so rows % BLOCK_ROWS == 0 always
+    holds for the default block; a tuned/explicit ``block_rows`` that does
+    not divide the buffer is refused upstream in `_resolve_block_rows`, not
+    here."""
     n = buf.shape[0]
-    assert n % (BLOCK_ROWS * LANES) == 0, (
-        f"arena buffer length {n} not a multiple of "
-        f"{BLOCK_ROWS * LANES}; use apex_tpu.arena.flatten")
+    br = BLOCK_ROWS if block_rows is None else block_rows
+    assert n % (br * LANES) == 0, (
+        f"arena buffer length {n} is not a multiple of {br * LANES} "
+        f"(block_rows={br} x {LANES} lanes). Flat optimizer buffers must "
+        f"come from apex_tpu.arena.flatten, which pads to BUFFER_MULTIPLE "
+        f"= {512 * LANES} elements; a buffer satisfying BUFFER_MULTIPLE "
+        f"but not a tuned non-default block is rejected before launch by "
+        f"_resolve_block_rows, which falls back to BLOCK_ROWS={BLOCK_ROWS} "
+        f"and names the tuning-DB fingerprint responsible.")
     return buf.reshape(n // LANES, LANES)
 
 
-def launch(kernel, inputs, outs, scalars=None):
+def _resolve_block_rows(rows, buf0, block_rows):
+    """Pick the grid block for one launch: explicit caller value, else a
+    tuning-DB hit for this buffer's (length, dtype), else BLOCK_ROWS.
+
+    A tuned/explicit block that does not divide the (BUFFER_MULTIPLE-padded)
+    buffer would trip the `as_rows` shape assert deep in pallas plumbing
+    with no hint of *which* DB entry chose it — the satellite-2 bug. Refuse
+    it here instead: warn naming the offending fingerprint and the fallback
+    taken, then launch on the default block.
+    """
+    import warnings
+
+    n = int(buf0.shape[0])
+    explicit = block_rows is not None
+    if not explicit:
+        from apex_tpu.ops import autotune
+        block_rows = autotune.tuned_rows(
+            "optimizer", (n,), buf0.dtype, lo=8, hi=4096)
+        if block_rows is None:
+            return BLOCK_ROWS
+    br = int(block_rows)
+    if br <= 0 or rows % br:
+        from apex_tpu.ops import autotune
+        fp = autotune.fingerprint("optimizer", (n,), buf0.dtype)
+        src = "explicit block_rows" if explicit else "tuning entry"
+        warnings.warn(
+            f"{src} {fp}: block_rows={br} does not divide the "
+            f"{rows}-row arena buffer (length {n}, BUFFER_MULTIPLE-padded) "
+            f"— falling back to BLOCK_ROWS={BLOCK_ROWS}; re-run "
+            f"scripts/kernel_tune.py --update-db to re-measure this shape",
+            RuntimeWarning, stacklevel=3)
+        return BLOCK_ROWS
+    return br
+
+
+def launch(kernel, inputs, outs, scalars=None, block_rows=None):
     """Shared pallas_call plumbing for flat-buffer elementwise kernels.
 
     The single launch convention every arena kernel uses (the analogue of
@@ -64,7 +111,8 @@ def launch(kernel, inputs, outs, scalars=None):
 
     rows_arrs = [as_rows(b) for b in inputs]
     rows = rows_arrs[0].shape[0]
-    block = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+    br = _resolve_block_rows(rows, inputs[0], block_rows)
+    block = pl.BlockSpec((br, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM)
     scalar = pl.BlockSpec((1, 1), lambda i: (0, 0),
                           memory_space=pltpu.SMEM)
@@ -89,7 +137,7 @@ def launch(kernel, inputs, outs, scalars=None):
 
     results = pl.pallas_call(
         kernel,
-        grid=(rows // BLOCK_ROWS,),
+        grid=(rows // br,),
         in_specs=in_specs,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shapes),

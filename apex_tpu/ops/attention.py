@@ -1508,9 +1508,36 @@ def _offset_bias(off_arr, sq, sk):
                      NEG_INF).reshape(1, 1, sq, sk)
 
 
+def _tuned_qk(q, k, block_q, block_k, dropout_rate):
+    """Trace-time tuning-DB consult for the attention family.
+
+    Applies only when the caller left (block_q, block_k) at the
+    defaults — an explicit override always wins — and never under
+    dropout (the Philox mask hash is a function of block coordinates;
+    tuned blocks would be a *different* mask than the one the dropout
+    contract documents). Called identically from the fwd residual path
+    and ``_fa_bwd`` so both directions realize the same tuned blocks.
+    Exact-key miss returns the defaults untouched: bit-identical HLO,
+    pinned by the ``autotune/no-extra-dispatch`` compile-check case.
+    """
+    if (block_q, block_k) != (DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K):
+        return block_q, block_k
+    if dropout_rate > 0.0:
+        return block_q, block_k
+    from apex_tpu.ops import autotune
+    b, sq, h, d = q.shape
+    blocks = autotune.lookup_blocks(
+        "attention", (b, sq, k.shape[1], h, d), q.dtype)
+    if not blocks:
+        return block_q, block_k
+    return (int(blocks.get("block_q", block_q)),
+            int(blocks.get("block_k", block_k)))
+
+
 def _flash_attention_fwd_res(q, k, v, bias, dropout_seed, scale, causal,
                              block_q, block_k, dropout_rate,
                              causal_offset=None, dbo=None):
+    block_q, block_k = _tuned_qk(q, k, block_q, block_k, dropout_rate)
     b, sq, h, d = q.shape
     scale = scale if scale is not None else 1.0 / np.sqrt(d)
     seed = _seed_arr(dropout_seed, dropout_rate)
@@ -1577,6 +1604,7 @@ def _fa_fwd(q, k, v, bias, scale, causal, block_q, block_k, dropout_rate,
 
 def _fa_bwd(scale, causal, block_q, block_k, dropout_rate, res, do):
     q, k, v, bias, dropout_seed, o, lse, causal_offset = res
+    block_q, block_k = _tuned_qk(q, k, block_q, block_k, dropout_rate)
     b, sq, h, d = q.shape
     sk = k.shape[1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(d)

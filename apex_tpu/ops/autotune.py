@@ -1,0 +1,574 @@
+"""Kernel autotuner: Pallas block-shape search + the committed tuning DB.
+
+ROADMAP item 3. The repo *measures* its kernel debt precisely —
+``prof.roofline.worst_gaps()`` names the fingerprinted candidates,
+APX104 flags the statically-provable tile padding — and this module
+*closes* gaps: a sweep engine enumerates a per-family grid of Pallas
+block shapes, times each candidate best-of-N under
+``compile_watch.autotune_scope()`` (sweep compiles are accounted, never
+mistaken for steady-state retraces), and persists winners in a committed
+JSON tuning DB (``scripts/kernel_tuning_db.json``) that every kernel
+family's dispatch path consults at trace time.
+
+Fingerprint key (apexlint-style — stable identity, never measured
+numbers)::
+
+    family|dims|dtype|chip        e.g.  attention|2x256x256x4x64|float32|cpu
+
+``dims`` is the family's *logical* problem shape, joined with ``x``:
+
+- ``attention``  — (B, Sq, Sk, H, D) of :func:`~apex_tpu.ops.flash_attention`
+- ``mlp``        — (rows, d0, d1, ..., dN) of :func:`~apex_tpu.ops.fused_mlp`
+- ``layer_norm`` — (rows, H) of the flattened input
+- ``xentropy``   — (rows, V) of the flattened logits
+- ``optimizer``  — (n_elements,) of the arena flat buffer fed to
+  ``ops/_dispatch.launch`` (the multi-tensor optimizer launcher)
+
+Consultation contract (pinned by the ``autotune/no-extra-dispatch``
+compile-check case): **exact-key hit → tuned blocks; miss → the current
+hardcoded defaults, bit-identical HLO**. Nearest-miss never matches. A
+DB entry whose recorded identity no longer re-fingerprints to its key is
+*stale* and is refused loudly at load time (:class:`StaleTuningEntry`)
+instead of silently applied.
+
+Env control: ``APEX_TPU_AUTOTUNE=off|db|sweep`` (default ``db``).
+``off`` skips the DB entirely (trajectory bitwise-identical to the
+pre-tuner code); ``db`` consults the committed DB; ``sweep`` behaves
+like ``db`` and additionally marks the process as a sweep run for
+``scripts/kernel_tune.py``. See docs/profiling.md#autotuner.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "FAMILIES", "MODES", "StaleTuningEntry", "TuningEntry", "TuningDB",
+    "fingerprint", "chip_kind", "mode", "default_db_path", "active_db",
+    "set_db", "use_db", "reload_db", "lookup_blocks", "tuned_rows",
+    "counters", "reset_counters", "recent_consults", "db_stats",
+    "candidate_grid", "measure_candidate", "sweep_entry", "tune_report",
+    "tuned_lint_shapes",
+]
+
+#: the five fused-op families apex_tpu ships tunable kernels for — the
+#: same family names prof.roofline.classify_family assigns, so
+#: worst_gaps rows join DB entries without a translation table
+FAMILIES = ("attention", "mlp", "layer_norm", "xentropy", "optimizer")
+
+MODES = ("off", "db", "sweep")
+
+_ENV = "APEX_TPU_AUTOTUNE"
+_ENV_DB = "APEX_TPU_AUTOTUNE_DB"
+
+#: sublane multiple per itemsize — the TPU tile grid (same table the
+#: APX104 lint rule uses); tuned row blocks must sit on it
+_SUBLANE = {4: 8, 2: 16, 1: 32}
+
+
+class StaleTuningEntry(ValueError):
+    """A tuning-DB entry whose recorded identity does not re-fingerprint
+    to its key — refused loudly instead of silently applied."""
+
+
+def mode() -> str:
+    """The autotune mode from ``APEX_TPU_AUTOTUNE`` (default ``db``)."""
+    m = os.environ.get(_ENV, "db")
+    if m not in MODES:
+        raise ValueError(
+            f"{_ENV}={m!r} is not one of {MODES} — refusing to guess")
+    return m
+
+
+def chip_kind() -> str:
+    """Canonical chip key for fingerprints: the attached device kind
+    lowercased with spaces collapsed (``tpu_v5_lite``), or ``cpu`` off
+    TPU — so interpret-mode sweep artifacts never shadow on-chip ones."""
+    import jax
+    if jax.default_backend() != "tpu":
+        return "cpu"
+    kind = getattr(jax.devices()[0], "device_kind", "tpu")
+    return str(kind).strip().lower().replace(" ", "_")
+
+
+def _dtype_name(dtype) -> str:
+    """Canonical dtype token — derived from the dtype object itself
+    (``float32``/``bfloat16``/...), never a jax-version-dependent repr,
+    so the same logical shape fingerprints identically across jax
+    versions."""
+    import numpy as np
+    try:
+        return np.dtype(dtype).name
+    except TypeError:
+        return str(dtype)
+
+
+def fingerprint(family: str, dims: Sequence[int], dtype,
+                chip: Optional[str] = None) -> str:
+    """``family|dims|dtype|chip`` — the DB key. Pure arithmetic on
+    python ints + the canonical dtype name: stable across processes,
+    jax versions, and reruns."""
+    if family not in FAMILIES:
+        raise ValueError(f"unknown kernel family {family!r} "
+                         f"(one of {FAMILIES})")
+    dims_s = "x".join(str(int(d)) for d in dims)
+    return (f"{family}|{dims_s}|{_dtype_name(dtype)}"
+            f"|{chip if chip is not None else chip_kind()}")
+
+
+# --- the tuning DB -----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class TuningEntry:
+    """One committed winner: identity + block decision + sweep
+    provenance (the measured evidence; never part of the key)."""
+
+    family: str
+    dims: Tuple[int, ...]
+    dtype: str
+    chip: str
+    block: Dict[str, int]              # e.g. {"block_rows": 256} or
+                                       # {"block_q": 512, "block_k": 512}
+    sweep: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    #: normalized dot-shape signatures (APX104's ``scope`` strings) this
+    #: tuned kernel covers — lets the lint pass keep a DB-satisfied
+    #: shape at info severity instead of escalating
+    lint_sigs: Tuple[str, ...] = ()
+
+    @property
+    def fingerprint(self) -> str:
+        return fingerprint(self.family, self.dims, self.dtype, self.chip)
+
+    def to_json(self) -> Dict[str, Any]:
+        out = {"family": self.family, "dims": list(self.dims),
+               "dtype": self.dtype, "chip": self.chip,
+               "block": dict(self.block)}
+        if self.sweep:
+            out["sweep"] = dict(self.sweep)
+        if self.lint_sigs:
+            out["lint_sigs"] = list(self.lint_sigs)
+        return out
+
+    @classmethod
+    def from_json(cls, rec: Dict[str, Any]) -> "TuningEntry":
+        return cls(family=str(rec["family"]),
+                   dims=tuple(int(d) for d in rec["dims"]),
+                   dtype=str(rec["dtype"]), chip=str(rec["chip"]),
+                   block={str(k): int(v)
+                          for k, v in rec["block"].items()},
+                   sweep=dict(rec.get("sweep", {})),
+                   lint_sigs=tuple(rec.get("lint_sigs", ())))
+
+
+def default_db_path() -> str:
+    """``scripts/kernel_tuning_db.json`` next to the package root, or
+    the ``APEX_TPU_AUTOTUNE_DB`` override."""
+    env = os.environ.get(_ENV_DB)
+    if env:
+        return env
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "scripts", "kernel_tuning_db.json")
+
+
+class TuningDB:
+    """Exact-key fingerprint → :class:`TuningEntry` map with committed
+    JSON round-trip. Load validates every entry's recorded identity
+    against its key — a mismatch (hand-edited shape, renamed family, a
+    key from an older dims convention) raises :class:`StaleTuningEntry`
+    naming the offending key."""
+
+    def __init__(self, entries: Optional[Dict[str, TuningEntry]] = None,
+                 path: Optional[str] = None):
+        self.entries: Dict[str, TuningEntry] = dict(entries or {})
+        self.path = path
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+    @classmethod
+    def load(cls, path: str) -> "TuningDB":
+        """Parse + validate a DB file. A missing file is an empty DB
+        (the consult path must work on a fresh clone); a *corrupt or
+        stale* file is an error — tuned block shapes silently falling
+        back would regress perf with no signal."""
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return cls(path=path)
+        if not isinstance(data, dict) or not isinstance(
+                data.get("entries"), dict):
+            raise ValueError(
+                f"{path}: not a tuning DB (expected "
+                '{"version": 1, "entries": {fingerprint: {...}}})')
+        entries: Dict[str, TuningEntry] = {}
+        for key, rec in data["entries"].items():
+            try:
+                e = TuningEntry.from_json(rec)
+            except (KeyError, TypeError, ValueError) as err:
+                raise StaleTuningEntry(
+                    f"{path}: entry {key!r} is malformed ({err}) — "
+                    f"re-run scripts/kernel_tune.py to regenerate it"
+                ) from err
+            if e.fingerprint != key:
+                raise StaleTuningEntry(
+                    f"{path}: stale tuning entry {key!r}: its recorded "
+                    f"identity re-fingerprints to {e.fingerprint!r} — "
+                    f"the entry predates a shape/dims convention change "
+                    f"and is refused, not silently applied; re-run "
+                    f"scripts/kernel_tune.py --update-db to re-measure")
+            entries[key] = e
+        return cls(entries, path=path)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.path
+        if not path:
+            raise ValueError("no path to save the tuning DB to")
+        body = {"version": 1,
+                "entries": {k: self.entries[k].to_json()
+                            for k in sorted(self.entries)}}
+        with open(path, "w") as f:
+            json.dump(body, f, indent=1, sort_keys=False)
+            f.write("\n")
+        self.path = path
+        return path
+
+    def lookup(self, fp: str) -> Optional[TuningEntry]:
+        """EXACT-key lookup — a nearest miss (off-by-one dim, other
+        dtype, other chip) returns None and takes the default path."""
+        return self.entries.get(fp)
+
+    def add(self, entry: TuningEntry) -> None:
+        self.entries[entry.fingerprint] = entry
+
+    def families(self) -> List[str]:
+        return sorted({e.family for e in self.entries.values()})
+
+    def stats(self) -> Dict[str, Any]:
+        return {"entries": len(self.entries),
+                "tuned_families": self.families(),
+                "path": self.path}
+
+
+# --- runtime consult state ---------------------------------------------------
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {"db": None}
+_counters = {"hits": 0, "misses": 0}
+_recent: List[Tuple[str, bool]] = []      # last consults, for audits
+_RECENT_CAP = 256
+
+
+def active_db() -> TuningDB:
+    """The DB consulted at trace time — lazily loaded from
+    :func:`default_db_path` (committed repo DB) unless a test installed
+    one via :func:`set_db`/:func:`use_db`."""
+    with _lock:
+        db = _state["db"]
+        if db is None:
+            db = _state["db"] = TuningDB.load(default_db_path())
+        return db
+
+
+def set_db(db: Optional[TuningDB]) -> None:
+    """Install ``db`` as the active DB (None → lazy-reload the default
+    on next consult)."""
+    with _lock:
+        _state["db"] = db
+
+
+def reload_db(path: Optional[str] = None) -> TuningDB:
+    db = TuningDB.load(path or default_db_path())
+    set_db(db)
+    return db
+
+
+@contextlib.contextmanager
+def use_db(db_or_path):
+    """Temporarily consult a specific DB (tests, sweep verification)."""
+    db = (db_or_path if isinstance(db_or_path, TuningDB)
+          else TuningDB.load(db_or_path))
+    with _lock:
+        prev = _state["db"]
+        _state["db"] = db
+    try:
+        yield db
+    finally:
+        with _lock:
+            _state["db"] = prev
+
+
+def lookup_blocks(family: str, dims: Sequence[int],
+                  dtype) -> Optional[Dict[str, int]]:
+    """The trace-time consult every dispatch seam calls: exact-key DB
+    hit → the tuned block dict; miss (or ``APEX_TPU_AUTOTUNE=off``) →
+    None, leaving the call site's hardcoded default untouched so the
+    compiled HLO is bit-identical to the pre-tuner program."""
+    if mode() == "off":
+        return None
+    fp = fingerprint(family, dims, dtype)
+    entry = active_db().lookup(fp)
+    with _lock:
+        _counters["hits" if entry else "misses"] += 1
+        _recent.append((fp, entry is not None))
+        del _recent[:-_RECENT_CAP]
+    return dict(entry.block) if entry else None
+
+
+def tuned_rows(family: str, dims: Sequence[int], dtype, *,
+               key: str = "block_rows", lo: int = 16, hi: int = 1024,
+               multiple: Optional[int] = None) -> Optional[int]:
+    """Consult + validate a tuned row-block value. An entry carrying an
+    illegal value (off the dtype's sublane grid, outside [lo, hi])
+    warns naming the offending fingerprint and returns None — the call
+    site keeps its heuristic, loudly, never a Mosaic tiling crash."""
+    import numpy as np
+    blocks = lookup_blocks(family, dims, dtype)
+    if not blocks or key not in blocks:
+        return None
+    r = int(blocks[key])
+    if multiple is None:
+        try:
+            itemsize = np.dtype(dtype).itemsize
+        except TypeError:
+            itemsize = 4
+        # at least 16: the row-block kernels size for the widest (bf16)
+        # tiling whatever the array dtype (see layer_norm._row_block)
+        multiple = max(16, _SUBLANE.get(itemsize, 8))
+    if r < lo or r > hi or r % multiple:
+        warnings.warn(
+            f"tuning entry {fingerprint(family, dims, dtype)}: "
+            f"{key}={r} is off the legal grid (multiple of {multiple} "
+            f"in [{lo}, {hi}]) — falling back to the built-in "
+            f"heuristic; re-run scripts/kernel_tune.py --update-db",
+            RuntimeWarning, stacklevel=3)
+        return None
+    return r
+
+
+def counters() -> Dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _lock:
+        _counters["hits"] = _counters["misses"] = 0
+        del _recent[:]
+
+
+def recent_consults() -> List[Tuple[str, bool]]:
+    """(fingerprint, hit) of recent :func:`lookup_blocks` calls — the
+    kernel_tune audit asserts the exact-key hit landed."""
+    with _lock:
+        return list(_recent)
+
+
+def db_stats() -> Dict[str, Any]:
+    """The bench columns, off the already-loaded DB + this process's
+    consult counters — zero compiles, zero device access."""
+    st = active_db().stats()
+    st.update(counters())
+    return st
+
+
+# --- candidate grids ---------------------------------------------------------
+
+def candidate_grid(family: str, dims: Sequence[int],
+                   dtype) -> List[Dict[str, int]]:
+    """The block-shape candidates the sweep times for one problem
+    shape. Defaults lead each grid (the sweep must always be able to
+    fall back to "keep the default"); every candidate is legal for the
+    family's Mosaic tiling rules at that shape."""
+    import numpy as np
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        itemsize = 4
+    sub = _SUBLANE.get(itemsize, 8)
+
+    if family == "attention":
+        # (block_q, block_k) over the proven tile range; 1024x1024 is
+        # the current DEFAULT_BLOCK_Q/K. Clamp to the sequence dims —
+        # a block larger than the (8-aligned) sequence is just the
+        # whole-sequence block the dispatch would pick anyway.
+        _, sq, sk = int(dims[0]), int(dims[1]), int(dims[2])
+        qs = sorted({min(b, -(-sq // 8) * 8)
+                     for b in (256, 512, 1024)}, reverse=True)
+        ks = sorted({min(b, -(-sk // 8) * 8)
+                     for b in (256, 512, 1024)}, reverse=True)
+        out = [{"block_q": 1024, "block_k": 1024}]
+        out += [{"block_q": q, "block_k": k} for q in qs for k in ks
+                if {"block_q": q, "block_k": k} not in out]
+        return out
+
+    if family == "optimizer":
+        # BLOCK_ROWS of the _dispatch launcher. Arena buffers are
+        # padded to BUFFER_MULTIPLE = 512*128 elements, so rows is
+        # always a multiple of 512 — every candidate here divides it.
+        # All are multiples of the widest (int8: 32) sublane grid.
+        return [{"block_rows": r} for r in (512, 256, 128, 64)]
+
+    # the row-block families: rows multiples of the dtype's sublane
+    # grid (16 covers fp32+bf16 — the grid the in-tree heuristics use)
+    step = max(16, sub)
+    rows = [r for r in (256, 128, 64, 32, 16) if r % step == 0]
+    return [{"block_rows": r} for r in rows]
+
+
+# --- the sweep engine --------------------------------------------------------
+
+def measure_candidate(build: Callable[[], Any], args: Sequence[Any], *,
+                      iters: int = 3, warmup: int = 1) -> float:
+    """Compile (under ``autotune_scope`` — accounted, never a retrace)
+    and time one candidate best-of-``iters``; returns microseconds."""
+    import jax
+    from apex_tpu.prof import compile_watch
+
+    jitted = jax.jit(build())
+    with compile_watch.autotune_scope():
+        compiled = jitted.lower(*args).compile()
+    for _ in range(max(warmup, 0)):
+        jax.block_until_ready(compiled(*args))
+    best = float("inf")
+    for _ in range(max(iters, 1)):
+        t0 = time.perf_counter()
+        jax.block_until_ready(compiled(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e6
+
+
+def sweep_entry(family: str, dims: Sequence[int], dtype,
+                build: Callable[[Dict[str, int]], Tuple[Callable, Sequence]],
+                *, candidates: Optional[List[Dict[str, int]]] = None,
+                iters: int = 3, warmup: int = 1,
+                on_candidate: Optional[Callable[[Dict, float], None]] = None,
+                ) -> TuningEntry:
+    """Time every candidate of :func:`candidate_grid` (best-of-N each)
+    and return the winner as a :class:`TuningEntry` with full sweep
+    provenance. ``build(block)`` returns ``(fn, args)`` for one
+    candidate; the first grid entry is the current default (its time is
+    recorded as ``default_us``)."""
+    grid = candidates if candidates is not None else candidate_grid(
+        family, dims, dtype)
+    if not grid:
+        raise ValueError(f"empty candidate grid for {family} {dims}")
+    timed: List[Tuple[Dict[str, int], float]] = []
+    for block in grid:
+        fn, args = build(dict(block))
+        us = measure_candidate(lambda: fn, args, iters=iters,
+                               warmup=warmup)
+        timed.append((dict(block), us))
+        if on_candidate is not None:
+            on_candidate(dict(block), us)
+    default_us = timed[0][1]
+    best_block, best_us = min(timed, key=lambda t: t[1])
+    import jax
+    return TuningEntry(
+        family=family, dims=tuple(int(d) for d in dims),
+        dtype=_dtype_name(dtype), chip=chip_kind(),
+        block=best_block,
+        sweep={"mode": ("compiled" if jax.default_backend() == "tpu"
+                        else "interpret"),
+               "iters": int(iters), "n_candidates": len(timed),
+               "best_us": round(best_us, 3),
+               "default_us": round(default_us, 3),
+               "candidates": [{"block": b, "us": round(us, 3)}
+                              for b, us in timed]})
+
+
+# --- joins: roofline worst_gaps + APX104 -------------------------------------
+
+def tuned_lint_shapes(db: Optional[TuningDB] = None) -> List[str]:
+    """Normalized dot-shape signatures covered by DB entries — the
+    ``tuned_shapes`` input of ``lint.hlo_pass.tile_findings`` (a
+    DB-satisfied shape stays at info severity instead of escalating)."""
+    db = db if db is not None else active_db()
+    out: List[str] = []
+    for e in db.entries.values():
+        out.extend(s for s in e.lint_sigs if s not in out)
+    return out
+
+
+def tune_report(db: Optional[TuningDB] = None,
+                worst_gaps: Optional[Sequence[Dict[str, Any]]] = None,
+                tile_findings: Optional[Sequence[Any]] = None,
+                ) -> Dict[str, Any]:
+    """Join DB entries against ``roofline_report.worst_gaps()`` rows
+    and APX104 tile-padding findings: per roofline candidate, is its
+    family covered by a committed tuning entry, and what closure did
+    the sweep predict (``default_us - best_us`` per occurrence)?
+
+    ``worst_gaps`` rows are the dicts ``RooflineReport.worst_gaps()``
+    returns (fingerprint ``family|opcode|scope|shape``); coverage joins
+    on the shared ``family`` axis — the DB key's dims are logical call
+    shapes, the roofline's are compiled-op shapes, so family (+ the
+    per-entry predicted closure) is the honest join, not a fake
+    exact-key equality between two different key spaces.
+    """
+    db = db if db is not None else active_db()
+    by_family: Dict[str, List[TuningEntry]] = {}
+    for e in db.entries.values():
+        by_family.setdefault(e.family, []).append(e)
+
+    candidates = []
+    for g in (worst_gaps or ()):
+        fam = g.get("family")
+        entries = by_family.get(fam, [])
+        predicted = sum(
+            max(0.0, float(e.sweep.get("default_us", 0.0))
+                - float(e.sweep.get("best_us", 0.0)))
+            for e in entries)
+        candidates.append({
+            "fingerprint": g.get("fingerprint"),
+            "family": fam, "op": g.get("op"),
+            "measured_us": g.get("measured_us"),
+            "attainable_us": g.get("attainable_us"),
+            "gap_us": g.get("gap_us"),
+            "covered": bool(entries),
+            "db_entries": sorted(e.fingerprint for e in entries),
+            "predicted_closure_us": round(predicted, 3),
+        })
+
+    apx104 = []
+    sigs = set(tuned_lint_shapes(db))
+    for f in (tile_findings or ()):
+        scope = getattr(f, "scope", None) or (
+            f.get("scope") if isinstance(f, dict) else None)
+        apx104.append({"scope": scope,
+                       "bytes": getattr(f, "bytes", None) if not
+                       isinstance(f, dict) else f.get("bytes"),
+                       "db_satisfied": scope in sigs})
+
+    covered = [c for c in candidates if c["covered"]]
+    return {
+        "tuned_families": db.families(),
+        "n_entries": len(db.entries),
+        "candidates": candidates,
+        "n_candidates": len(candidates),
+        "n_covered": len(covered),
+        "uncovered_families": sorted(
+            {c["family"] for c in candidates if not c["covered"]}),
+        "apx104": apx104,
+        "workflow": ("scripts/kernel_tune.py --update-db sweeps the "
+                     "grid and commits winners to "
+                     "scripts/kernel_tuning_db.json"),
+    }
+
+
+def tune_event(action: str, fp: str, family: str, *,
+               rank: int = 0, **extra) -> Dict[str, Any]:
+    """``kind="tune"`` event for the roofline channel
+    (``check_metrics_schema.py --kind roofline`` validates)."""
+    ev = {"kind": "tune", "rank": rank, "action": action,
+          "fingerprint": fp, "family": family}
+    ev.update(extra)
+    return ev

@@ -1726,6 +1726,86 @@ def _():
         "default DDP sync compiled a structurally different program")
 
 
+@case("autotune/no-extra-dispatch")
+def _():
+    """The tuning-DB consult is a trace-time table lookup with an
+    exact-key contract: a shape that MISSES the DB must compile HLO
+    BIT-IDENTICAL to ``APEX_TPU_AUTOTUNE=off`` (donated and undonated)
+    — no extra dispatch, no reordered ops, nothing. And the positive
+    twin: an exact-key HIT on a seeded DB must actually change the
+    realized block (a different grid → a different program), proving
+    the consult happens at trace time rather than being dead code."""
+    import os
+
+    from apex_tpu import ops
+    from apex_tpu.ops import autotune
+
+    x = _rand((96, 72), 0)
+    w = jnp.ones((72,), jnp.float32)
+    b = jnp.zeros((72,), jnp.float32)
+    # 2x BUFFER_MULTIPLE: a legal arena length whose fingerprint is
+    # NOT in the committed DB (the committed optimizer entry is the
+    # 1x-BUFFER_MULTIPLE sweep shape)
+    buf = _rand((2 * 512 * 128,), 1)
+
+    def make_step():
+        # a fresh function object per compile — jit's trace cache is
+        # keyed on identity, and the env/DB consult happens at trace
+        # time, so a shared object would reuse the first trace
+        def step(x_, w_, b_, buf_):
+            y = ops.fused_layer_norm_affine(x_, w_, b_)
+            scaled, ok = ops.multi_tensor_scale(buf_, 0.5)
+            return y.sum() + scaled.sum() + ok.astype(jnp.float32)
+        return step
+
+    prev = os.environ.get("APEX_TPU_AUTOTUNE")
+
+    def _set(mode):
+        if mode is None:
+            os.environ.pop("APEX_TPU_AUTOTUNE", None)
+        else:
+            os.environ["APEX_TPU_AUTOTUNE"] = mode
+
+    try:
+        for donate in (False, True):
+            kw = {"donate_argnums": (0,)} if donate else {}
+
+            _set("off")
+            hlo_off = jax.jit(make_step(), **kw).lower(
+                x, w, b, buf).compile().as_text()
+
+            # db mode against the committed DB: these shapes are not
+            # in it — exact-key miss, defaults, bit-identical HLO
+            _set("db")
+            autotune.reset_counters()
+            hlo_db = jax.jit(make_step(), **kw).lower(
+                x, w, b, buf).compile().as_text()
+            assert hlo_db == hlo_off, (
+                f"DB-miss path compiled a different program than "
+                f"APEX_TPU_AUTOTUNE=off (donate={donate})")
+            c = autotune.counters()
+            assert c["misses"] >= 2 and c["hits"] == 0, (
+                f"expected pure trace-time misses, got {c}")
+
+            # positive twin: an exact-key hit changes the realized
+            # block, hence the program
+            entry = autotune.TuningEntry(
+                family="layer_norm", dims=(96, 72), dtype="float32",
+                chip=autotune.chip_kind(), block={"block_rows": 32})
+            with autotune.use_db(autotune.TuningDB(
+                    {entry.fingerprint: entry})):
+                autotune.reset_counters()
+                hlo_hit = jax.jit(make_step(), **kw).lower(
+                    x, w, b, buf).compile().as_text()
+                assert autotune.counters()["hits"] == 1, \
+                    autotune.counters()
+            assert hlo_hit != hlo_off, (
+                "an exact-key tuned hit left the program unchanged — "
+                "the consult is not reaching the dispatch seam")
+    finally:
+        _set(prev)
+
+
 # --- driver ------------------------------------------------------------------
 
 def run(pattern: Optional[str] = None,

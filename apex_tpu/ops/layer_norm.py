@@ -73,10 +73,13 @@ def _ln_fwd_kernel(h, eps, affine, x_ref, *rest):
     y_ref[:] = jnp.where(mask, y, 0.0).astype(y_ref.dtype)
 
 
-def _ln_forward(x2, weight, bias, eps):
+def _ln_forward(x2, weight, bias, eps, block_rows=None):
     n, h = x2.shape
     hp = -(-h // LANES) * LANES
-    r = _row_block(hp, 4)
+    if block_rows is None:
+        from apex_tpu.ops import autotune
+        block_rows = autotune.tuned_rows("layer_norm", (n, h), x2.dtype)
+    r = block_rows if block_rows is not None else _row_block(hp, 4)
     npad = -(-n // r) * r
     xp = _pad2(x2, npad, hp)
     affine = weight is not None
@@ -140,10 +143,13 @@ def _ln_bwd_kernel(h, eps, affine, g_ref, x_ref, *rest):
                               jnp.sum(gm, axis=0, keepdims=True), 0.0)
 
 
-def _ln_backward(g2, x2, weight, eps):
+def _ln_backward(g2, x2, weight, eps, block_rows=None):
     n, h = x2.shape
     hp = -(-h // LANES) * LANES
-    r = _row_block(hp, 6)
+    if block_rows is None:
+        from apex_tpu.ops import autotune
+        block_rows = autotune.tuned_rows("layer_norm", (n, h), x2.dtype)
+    r = block_rows if block_rows is not None else _row_block(hp, 6)
     npad = -(-n // r) * r
     nblocks = npad // r
     gp = _pad2(g2, npad, hp)

@@ -96,7 +96,7 @@ def fused_mlp(x, weights, biases, activation="relu"):
     return _fused_mlp_fwd_impl(x, weights, biases, activation)
 
 
-def _fused_mlp_fwd_impl(x, weights, biases, activation):
+def _fused_mlp_fwd_impl(x, weights, biases, activation, block_rows=None):
     use_bias = biases is not None
     if not _weights_fit_vmem(weights):
         return mlp_reference(x, weights, biases, activation)
@@ -106,9 +106,13 @@ def _fused_mlp_fwd_impl(x, weights, biases, activation):
     x2 = x.reshape(-1, d0)
     n = x2.shape[0]
     dims = [d0] + [w.shape[1] for w in weights]
+    if block_rows is None:
+        from apex_tpu.ops import autotune
+        block_rows = autotune.tuned_rows("mlp", (n, *dims), x.dtype)
     pdims = [-(-d // LANES) * LANES for d in dims]
     widest = max(pdims)
-    r = max(16, min(256, ((1 << 20) // (4 * widest) // 16) * 16))
+    r = (block_rows if block_rows is not None
+         else max(16, min(256, ((1 << 20) // (4 * widest) // 16) * 16)))
     npad = -(-n // r) * r
 
     args = [_pad_to(x2, npad, pdims[0])]

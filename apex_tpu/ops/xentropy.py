@@ -100,13 +100,17 @@ def _broadcast_lanes(vec, npad):
     return jnp.broadcast_to(out[:, None], (npad, LANES))
 
 
-def _fwd_call(x2, labels, smoothing):
+def _fwd_call(x2, labels, smoothing, block_rows=None):
     n, v = x2.shape
     # lane dim = the full vocab dim (legal for Mosaic whatever v is) —
     # padding V up to a 128 multiple would copy the whole logits tensor
     # (500 MB at BERT vocab) just to round 30522 → 30592
     vp = v
-    r = _row_block(-(-v // LANES) * LANES, 1, x2.dtype.itemsize)
+    if block_rows is None:
+        from apex_tpu.ops import autotune
+        block_rows = autotune.tuned_rows("xentropy", (n, v), x2.dtype)
+    r = (block_rows if block_rows is not None
+         else _row_block(-(-v // LANES) * LANES, 1, x2.dtype.itemsize))
     npad = -(-n // r) * r
     xp = _pad2(x2, npad, vp)
     # padding rows get label -1 → zero loss
@@ -129,10 +133,14 @@ def _fwd_call(x2, labels, smoothing):
     return loss[:n, 0], lse[:n, 0]
 
 
-def _bwd_call(x2, labels, lse, g, smoothing):
+def _bwd_call(x2, labels, lse, g, smoothing, block_rows=None):
     n, v = x2.shape
     vp = v                      # full-dim lane blocks; see _fwd_call
-    r = _row_block(-(-v // LANES) * LANES, 2, x2.dtype.itemsize)
+    if block_rows is None:
+        from apex_tpu.ops import autotune
+        block_rows = autotune.tuned_rows("xentropy", (n, v), x2.dtype)
+    r = (block_rows if block_rows is not None
+         else _row_block(-(-v // LANES) * LANES, 2, x2.dtype.itemsize))
     npad = -(-n // r) * r
     xp = _pad2(x2, npad, vp)
     lab = _broadcast_lanes(

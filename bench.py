@@ -25,6 +25,11 @@ worst measured-vs-attainable per-op gap — apex_tpu.prof.roofline; the
 fingerprinted autotuner candidate, measured on TPU / AOT-only
 classification elsewhere), ``n_autotune_compiles`` (the autotune-origin
 subset of ``n_compiles`` — prof.compile_watch.autotune_scope),
+``tuned_families``/``autotune_db_hits`` (the committed kernel tuning
+DB's reach: families holding a sweep winner in
+``scripts/kernel_tuning_db.json`` and exact-key trace-time consult
+hits, off the same AOT executable — apex_tpu.ops.autotune /
+``scripts/kernel_tune.py``),
 ``pod_goodput``/``comm_skew_p99``/``comm_drift_ratio`` (the pod
 observatory columns: goodput after the comm_skew/comm_wire split on an
 emulated pod merge, the p99 collective entry skew, and the worst
@@ -568,6 +573,24 @@ def run_all():
     except Exception as e:
         roofline_note = (f"- Roofline + sentinel: row failed "
                          f"({type(e).__name__}).")
+    try:
+        from apex_tpu.ops import autotune as _at
+        st = _at.db_stats()
+        autotune_note = (
+            f"- Kernel autotuner ({host}): committed tuning DB "
+            f"(scripts/kernel_tuning_db.json) holds {st['entries']} "
+            f"sweep winner(s) over families "
+            f"{'/'.join(st['tuned_families'])}; every dispatch seam "
+            f"consults it at trace time (exact `family|dims|dtype|"
+            f"chip` key, miss = bit-identical defaults), "
+            f"`tuned_families` + `autotune_db_hits` ride the default "
+            f"bench JSON off the same AOT executable (sweep: "
+            f"`scripts/kernel_tune.py --update-db`, audit: "
+            f"`scripts/kernel_tune.py --cpu8 --interpret`, "
+            f"docs/profiling.md#autotuner).")
+    except Exception as e:
+        autotune_note = (f"- Kernel autotuner: note failed "
+                         f"({type(e).__name__}).")
 
     dev = getattr(jax.devices()[0], "device_kind", "?")
     lines = [
@@ -610,6 +633,7 @@ def run_all():
         loader_note,
         goodput_note,
         roofline_note,
+        autotune_note,
     ]
     open("BENCH_TABLE.md", "w").write("\n".join(lines) + "\n")
     print("\n".join(lines))
@@ -1303,6 +1327,13 @@ def main():
     counters = _cw.global_counters()
     n_compiles = int(counters["compiles"])
     n_autotune = int(counters["autotune_compiles"])
+    try:
+        from apex_tpu.ops import autotune as _autotune
+        _tune_stats = _autotune.db_stats()
+        tuned_families = _tune_stats["tuned_families"]
+        autotune_db_hits = int(_tune_stats["hits"])
+    except Exception as e:
+        tuned_families, autotune_db_hits = {"failed": type(e).__name__}, None
 
     out = {
         "metric": "resnet50_amp_o2_images_per_sec",
@@ -1352,10 +1383,19 @@ def main():
                   "mesh_explain_rank": mem.get(
                       "mesh_explain", {}).get("rank"),
                   "n_compiles": n_compiles,
-                  # the autotune-origin subset of n_compiles (0 until
-                  # the item-4 tuner lands; the column exists so its
-                  # sweeps are attributable from day one)
+                  # the autotune-origin subset of n_compiles (the
+                  # kernel_tune.py sweep's compiles are accounted here,
+                  # never mistaken for steady-state retraces; 0 on a
+                  # plain bench run)
                   "n_autotune_compiles": n_autotune,
+                  # the committed tuning DB's reach on this run, off
+                  # the same AOT executable: which kernel families hold
+                  # ≥1 sweep winner in scripts/kernel_tuning_db.json,
+                  # and how many trace-time consults hit an exact key
+                  # (apex_tpu.ops.autotune — pure table stats, zero
+                  # compiles, zero dispatches)
+                  "tuned_families": tuned_families,
+                  "autotune_db_hits": autotune_db_hits,
                   # per-op efficiency attribution of the headline step
                   # (apex_tpu.prof.roofline; worst_gaps is the
                   # autotuner's fingerprinted candidate list —

@@ -587,20 +587,28 @@ def _goodput_special(i, rec, kind, state, errors):
 
 # --- roofline / sentinel channel schema ---------------------------------------
 
-ROOFLINE_KINDS = ("roofline", "regress")
+ROOFLINE_KINDS = ("roofline", "regress", "tune")
 ROOFLINE_BOUNDS = ("compute", "memory", "unknown")
 REGRESS_DIRECTIONS = ("higher", "lower")
+#: autotuner lifecycle on the same channel (apex_tpu/ops/autotune.py):
+#: a grid was swept, a trace-time consult hit/missed the committed DB,
+#: or a tuned entry was refused (stale / off-grid block)
+TUNE_ACTIONS = ("sweep", "hit", "miss", "refused")
 ROOFLINE_REQUIRED = {
     "roofline": ("op", "family", "bound", "flops", "bytes",
                  "attainable_us", "fingerprint"),
     "regress": ("metric", "direction", "regressed", "n_history",
                 "fingerprint"),
+    "tune": ("family", "fingerprint", "action"),
 }
 ROOFLINE_NULLABLE = {
     "roofline": ("step", "measured_us", "efficiency", "gap_us",
                  "scope", "dtype"),
     "regress": ("latest", "baseline", "mad", "threshold",
                 "degradation"),
+    "tune": ("step", "best_us", "default_us", "gap_us", "speedup",
+             "n_candidates", "block_rows", "block_q", "block_k",
+             "chip", "dtype"),
 }
 
 
@@ -630,6 +638,16 @@ def _roofline_special(i, rec, kind, state, errors):
                 errors.append(f"line {i}: {bk!r} must be a boolean")
         for dk in ("mad", "threshold"):
             _check_nonneg(i, rec, dk, errors)
+    if kind == "tune":
+        if not isinstance(rec.get("family"), str):
+            errors.append(f"line {i}: 'family' must be a string")
+        for dk in ("best_us", "default_us", "gap_us", "speedup"):
+            _check_nonneg(i, rec, dk, errors)
+        for sk in ("chip", "dtype"):
+            v = rec.get(sk)
+            if v is not None and sk in rec and not isinstance(v, str):
+                errors.append(f"line {i}: {sk!r} must be a string "
+                              f"or null, got {v!r}")
 
 
 # --- cluster control-plane channel schema -------------------------------------
@@ -968,9 +986,11 @@ SCHEMAS: Dict[str, ChannelSchema] = {
         enums={"link": GOODPUT_LINKS}, special=_goodput_special),
     "roofline": ChannelSchema(
         ROOFLINE_KINDS, ROOFLINE_REQUIRED, ROOFLINE_NULLABLE,
-        counters=("rank", "step", "occurrences", "n_history"),
+        counters=("rank", "step", "occurrences", "n_history",
+                  "n_candidates", "block_rows", "block_q", "block_k"),
         enums={"bound": ROOFLINE_BOUNDS,
-               "direction": REGRESS_DIRECTIONS},
+               "direction": REGRESS_DIRECTIONS,
+               "action": {"tune": TUNE_ACTIONS}},
         special=_roofline_special),
     "cluster": ChannelSchema(
         CLUSTER_KINDS, CLUSTER_REQUIRED, CLUSTER_NULLABLE,

@@ -91,6 +91,16 @@
 #                                   #   plan within band, flat ranked
 #                                   #   below hierarchical with APX203
 #                                   #   attached, sharding schema
+#                                   # + the kernel autotuner audit
+#                                   #   (--cpu8 --interpret): block-
+#                                   #   shape sweep accounted compile-
+#                                   #   exact under autotune_scope,
+#                                   #   DB round-trip + loud stale
+#                                   #   refusal, committed DB exact-key
+#                                   #   hits on every family, zero
+#                                   #   steady-state autotune compiles,
+#                                   #   tune_report covers the fused-
+#                                   #   backward roofline candidate
 #                                   # + the perf sentinel gate over the
 #                                   #   committed BENCH_r0*.json
 #                                   #   trajectory (exit 1 on unwaived
@@ -306,6 +316,23 @@ EOF
     # one WITH an APX203 verdict attached while the hierarchical one
     # is clean, (d) the emitted stream passes --kind sharding
     JAX_PLATFORMS=cpu python scripts/mesh_explain.py --cpu8
+
+    echo "== smoke: kernel autotuner audit (sweep -> DB -> dispatch, --cpu8)"
+    # asserts: (a) the interpret-mode block-shape sweep over all five
+    # kernel families accounts for EXACTLY its candidate count in
+    # compile_watch's autotune_scope and shows a measurable best-vs-
+    # worst spread on >=1 family, while a steady-state tuned dispatch
+    # re-jit adds ZERO autotune compiles and records an exact-key DB
+    # hit, (b) the tuning DB round-trips save->load->exact-key-hit,
+    # nearest-miss shapes return None (defaults), and a seeded stale
+    # entry is refused LOUDLY naming its fingerprint, (c) the committed
+    # scripts/kernel_tuning_db.json loads with a winner for every
+    # family and 5/5 exact-key hits on the sweep shapes, (d)
+    # tune_report joins the DB against the roofline fixture's
+    # worst_gaps — the PERF.md fused-backward attention candidate
+    # (~549 us vs ~436 us) shows as COVERED — and both tune-event
+    # streams pass --kind roofline
+    JAX_PLATFORMS=cpu python scripts/kernel_tune.py --cpu8 --interpret
 
     echo "== smoke: perf sentinel gate over the committed trajectory"
     # the noise-aware regression gate (robust median/MAD baselines,
