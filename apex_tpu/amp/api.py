@@ -289,9 +289,19 @@ class Amp:
         return site_names({"amp/cast": params, "amp/grads": params,
                            "amp/update": params})
 
+    def dynamics_sites(self, params) -> tuple:
+        """The stable site tuple :meth:`step`'s ``dynamics=`` hook
+        observes for ``params``-shaped state: one ``dynamics/update``
+        site per leaf — the committed optimizer delta, with the
+        unscaled fp32 grad as the effective-LR companion and the
+        weight itself as the update-to-weight companion. Feed it to
+        :func:`apex_tpu.monitor.dynamics.dynamics_init`."""
+        from apex_tpu.monitor.dynamics import site_names
+        return site_names({"dynamics/update": params})
+
     def step(self, state: AmpState, loss_fn: Callable, *args,
              loss_id: int = 0, has_aux: bool = False, guard=None,
-             numerics=None, **kwargs):
+             numerics=None, dynamics=None, **kwargs):
         """backward + apply in one call. Returns (state', out, finite).
 
         ``guard=(guard_state, guard_config)`` threads an
@@ -328,8 +338,25 @@ class Amp:
         :meth:`numerics_sites` names. Observation is read-only: the
         trajectory is bit-identical with it on or off at every opt
         level (the parity sweep in tests/test_numerics.py), and the
-        return grows a FINAL element ``numerics_state'`` (after the
-        guard state, when both are threaded)."""
+        return grows an element ``numerics_state'`` (after the
+        guard state, when both are threaded).
+
+        ``dynamics=(dynamics_state, dynamics_config)`` folds the
+        training-dynamics observatory
+        (:func:`apex_tpu.monitor.dynamics.dynamics_observe`) over the
+        committed update delta (``dynamics/update`` sites —
+        :meth:`dynamics_sites`), with the unscaled fp32 grads as the
+        effective-LR companion and the pre-step params as the
+        update-to-weight companion. An optional third element
+        ``dynamics=(ds, dcfg, probe)`` threads a
+        :class:`~apex_tpu.monitor.dynamics.DynamicsProbe` (or a
+        zero-arg thunk returning one) from
+        :func:`apex_tpu.parallel.distributed.dynamics_probe` — the
+        GNS + replica-geometry collectives, for steps running under a
+        dp axis. Same read-only contract (the O0–O3 parity sweep in
+        tests/test_dynamics.py); the return grows a FINAL element
+        ``dynamics_state'`` (after guard and numerics states, when
+        threaded)."""
         out, grads, state, finite = self.backward(
             state, loss_fn, *args, loss_id=loss_id, has_aux=has_aux, **kwargs)
         old_params = state.params
@@ -363,27 +390,48 @@ class Amp:
             new_state = self.apply_gradients(state, grads, committed,
                                              metrics_grad_norm=true_norm)
             ret = (new_state, out, committed, gs)
-        if numerics is None:
-            return ret
-        from apex_tpu.monitor.numerics import numerics_observe
-        ns, ncfg = numerics
-
-        def _trees():
-            # built INSIDE the fold's lax.cond branch (numerics_observe
-            # calls the thunk there), so the cast copy and the fp32
-            # update delta cost nothing on off-steps — the off-step
-            # no-fold contract covers the observation inputs too
-            update = jax.tree_util.tree_map(
+        def _update_delta():
+            return jax.tree_util.tree_map(
                 lambda n, o: (n.astype(jnp.float32)
                               - o.astype(jnp.float32))
                 if jnp.issubdtype(jnp.asarray(n).dtype, jnp.floating)
                 else n, new_state.params, old_params)
-            return {"amp/cast": self.policy.cast_params(old_params),
-                    "amp/grads": obs_grads, "amp/update": update}
 
-        ns = numerics_observe(ns, ncfg, _trees,
-                              weights={"amp/update": old_params})
-        return ret + (ns,)
+        if numerics is not None:
+            from apex_tpu.monitor.numerics import numerics_observe
+            ns, ncfg = numerics
+
+            def _trees():
+                # built INSIDE the fold's lax.cond branch
+                # (numerics_observe calls the thunk there), so the cast
+                # copy and the fp32 update delta cost nothing on
+                # off-steps — the off-step no-fold contract covers the
+                # observation inputs too
+                return {"amp/cast": self.policy.cast_params(old_params),
+                        "amp/grads": obs_grads,
+                        "amp/update": _update_delta()}
+
+            ns = numerics_observe(ns, ncfg, _trees,
+                                  weights={"amp/update": old_params})
+            ret = ret + (ns,)
+        if dynamics is None:
+            return ret
+        from apex_tpu.monitor.dynamics import dynamics_observe
+        if len(dynamics) == 3:
+            ds, dcfg, probe = dynamics
+        else:
+            ds, dcfg = dynamics
+            probe = None
+
+        def _dyn_trees():
+            # same thunk discipline: the update delta (and a thunked
+            # probe's collectives) trace inside the fold's cond branch
+            return {"dynamics/update": _update_delta()}
+
+        ds = dynamics_observe(ds, dcfg, _dyn_trees, probe=probe,
+                              grads={"dynamics/update": obs_grads},
+                              weights={"dynamics/update": old_params})
+        return ret + (ds,)
 
     # -- memory accounting ---------------------------------------------------
 

@@ -39,7 +39,19 @@ Plus the runtime performance observatory (docs/monitoring.md#goodput):
   a format table pricing fp32/bf16/fp16/fp8 exponent coverage against
   the measured histograms, and :func:`precision_report` /
   :func:`placement_advisor` — the fp8 candidate generator ROADMAP
-  items 2 and 5 consult (``scripts/numerics_audit.py``).
+  items 2 and 5 consult (``scripts/numerics_audit.py``);
+- :mod:`~apex_tpu.monitor.dynamics` — the training-dynamics
+  observatory (docs/dynamics.md): gradient noise scale /
+  critical-batch-size estimation (:class:`DynamicsState` carried
+  through the step like NumericsState, fed by
+  :func:`apex_tpu.parallel.distributed.dynamics_probe`'s registered
+  scalar collectives), replica-gradient cosine/Adasum-projection
+  geometry, and per-site effective-LR trajectories;
+- :mod:`~apex_tpu.monitor.convergence` — the noise-calibrated A/B
+  trajectory comparator (:func:`calibrate_band` /
+  :func:`convergence_report`): "run B matches run A within seed
+  noise", the done-bar instrument for ROADMAP items 4 and 5
+  (``scripts/dynamics_audit.py --cpu8``).
 """
 
 from apex_tpu.monitor.check import module_count_and_host_ops
@@ -68,6 +80,13 @@ from apex_tpu.monitor.numerics import (FORMAT_LADDER, FORMAT_TABLE,
                                        numerics_init, numerics_observe,
                                        placement_advisor,
                                        precision_report, site_names)
+from apex_tpu.monitor.convergence import (Band, ConvergenceVerdict,
+                                          calibrate_band,
+                                          convergence_report)
+from apex_tpu.monitor.dynamics import (DynamicsConfig, DynamicsProbe,
+                                       DynamicsReport, DynamicsState,
+                                       dynamics_init, dynamics_observe,
+                                       dynamics_report)
 from apex_tpu.monitor.sinks import CSVSink, JSONLSink, Sink, StdoutSink
 
 __all__ = [
@@ -77,6 +96,9 @@ __all__ = [
     "FORMAT_TABLE", "FORMAT_LADDER", "NumericsConfig", "NumericsState",
     "NumericsReport", "SiteVerdict", "numerics_init", "numerics_observe",
     "precision_report", "placement_advisor", "site_names",
+    "DynamicsConfig", "DynamicsState", "DynamicsProbe", "DynamicsReport",
+    "dynamics_init", "dynamics_observe", "dynamics_report",
+    "Band", "ConvergenceVerdict", "calibrate_band", "convergence_report",
     "Sink", "StdoutSink", "JSONLSink", "CSVSink",
     "COLLECTIVE_OPCODES", "collective_bytes", "collective_bytes_from_text",
     "collective_bytes_by_dtype", "collective_bytes_by_hop",

@@ -1,0 +1,602 @@
+"""Training-dynamics telemetry: gradient noise scale + replica geometry.
+
+ROADMAP items 4 and 5 both end in a judgment the repo could not make:
+"O4/fp8 converges within tolerance of O2" and "Adasum raises the
+effective-batch LR ceiling" are *dynamics* claims — fp8 kernels and
+projection-combined gradients change the arithmetic on purpose, so the
+bitwise-vs-oracle proof style of every prior arc does not apply. This
+module is the measurement instrument those arcs cash in, the PR-10
+pattern (land the observatory, then spend it) applied to training
+dynamics:
+
+- **the fold** (:func:`dynamics_observe`): every ``check_every`` steps
+  the jitted step folds (a) the **gradient noise scale** inputs — the
+  mean per-replica squared grad norm vs the pooled mean's squared norm,
+  which DDP's sync already has in hand
+  (:func:`apex_tpu.parallel.distributed.dynamics_probe` psums one
+  scalar alongside the existing gradient psum, under the registered
+  ``ddp/dynamics_gns`` scope) — into rolling EMAs the host turns into
+  the unbiased ``B_simple`` estimator and a critical-batch-size
+  estimate; (b) **replica-gradient geometry** — per-replica cosine
+  against the pooled mean and the Adasum projection coefficient
+  ``g_i·g_j/|g_i|²`` (arXiv 2006.02924's combiner quantity), from one
+  tiny all-gather of scalar pairs under ``ddp/dynamics_geom``; (c)
+  per-site **effective-LR** (``‖update‖/‖grad‖``) and
+  update-to-weight trajectories, the numerics fold's companion
+  mechanism extended to the update/grad pair. Off-steps take the empty
+  ``lax.cond`` branch — no fold, no extra dispatch (the
+  ``dynamics/no-extra-dispatch`` compile-check case pins the
+  host-polling half bit-identical). The result is a
+  :class:`DynamicsState` pytree carried next to GuardState /
+  NumericsState / IntegrityState: checkpointable, donate-able,
+  scan-carryable; surfaced through ``Amp.step(dynamics=(ds, dcfg))``
+  composing with the ``guard=`` / ``numerics=`` hooks;
+- **the verdict** (:func:`dynamics_report`): the host joins the EMAs
+  into GNS / B_crit (McCandlish et al., "An Empirical Model of
+  Large-Batch Training", arXiv 1812.06162 — provenance and the
+  estimator algebra in docs/dynamics.md#gns), the cosine/projection
+  spectrum, and median/MAD per-site effective-LR outliers, each row
+  carrying an apexlint-style ``dynamics|kind|site`` fingerprint;
+- **the comparator** (:mod:`apex_tpu.monitor.convergence`): the
+  noise-calibrated A/B trajectory harness — the perf_sentinel
+  robust statistics applied to convergence — lives next door.
+
+Events ride the **13th** MetricsLogger channel
+(``MetricsLogger(dynamics_sink=…)``; ``kind="dynamics_check" | "gns" |
+"convergence_verdict"``; ``check_metrics_schema.py --kind dynamics``
+validates). The asserted CI audit is ``scripts/dynamics_audit.py
+--cpu8``. Cadence is the knob (docs/dynamics.md#cadence): the GNS
+estimator is a ratio of *noisy* EMAs, so a coarser ``check_every``
+trades estimator variance for fold cost, not correctness.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, \
+    Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = [
+    "DynamicsConfig", "DynamicsState", "DynamicsProbe",
+    "DynamicsReport", "site_names", "dynamics_init",
+    "dynamics_observe", "dynamics_report", "check_events",
+    "stats_to_json", "stats_from_json",
+]
+
+#: sentinel for "no probe folded yet" in the world field
+_NO_WORLD = -1.0
+#: sentinel for spectrum rows with no data (cosine lives in [-1, 1])
+_NO_COS = -2.0
+#: sentinel for per-site gauges with no data yet
+_NO_DATA = -1.0
+
+
+# site_names is the SAME identity scheme as the numerics observatory —
+# one naming convention across observatories, so a dynamics site and a
+# numerics site over the same leaf share their suffix.
+from apex_tpu.monitor.numerics import site_names  # noqa: E402
+
+
+class DynamicsConfig(NamedTuple):
+    """Static observatory configuration (hashable; safe to close over
+    in jit)."""
+
+    check_every: int = 1   #: fold cadence in steps; 1 = every step
+    ema: float = 0.9       #: EMA decay (first check seeds the window)
+    local_batch: int = 1   #: per-replica batch size b — the GNS
+                           #: estimator's small-batch operand; the big
+                           #: batch is ``world * local_batch``
+
+
+class DynamicsProbe(NamedTuple):
+    """The traced per-step scalars :func:`dynamics_observe` folds for
+    GNS + geometry — produced inside the DDP sync scope by
+    :func:`apex_tpu.parallel.distributed.dynamics_probe` (which owns
+    the two registered collectives); every field is replicated across
+    the axis after the probe."""
+
+    local_sq_mean: jax.Array  # f32 mean over replicas of |g_local|²
+    pooled_sq: jax.Array      # f32 |pooled mean gradient|²
+    local_sqs: jax.Array      # f32[world] per-replica |g_local|²
+    dots: jax.Array           # f32[world] per-replica g_i · g̅
+    world: jax.Array          # f32 replica count (static axis size)
+
+
+class DynamicsState(NamedTuple):
+    """The in-graph training-dynamics monitor: scalar + ``[world]`` +
+    ``[n_sites]`` device arrays carried through the jitted step next to
+    GuardState / NumericsState — checkpointable, donate-able,
+    ``lax.scan``-carryable. Site names are static strings and live with
+    the host (:func:`site_names`); row ``i`` of the per-site arrays is
+    site ``i`` in that tuple's order."""
+
+    step: jax.Array            # i32 observed (attempted) steps
+    check_count: jax.Array     # i32 cumulative folds executed
+    last_check_step: jax.Array  # i32 step of the last executed fold
+    world: jax.Array           # f32 replica count; -1 until a probe folds
+    local_sq: jax.Array        # f32 last-check mean per-replica |g|²
+    local_sq_ema: jax.Array    # f32 EMA of local_sq
+    pooled_sq: jax.Array       # f32 last-check |pooled mean|²
+    pooled_sq_ema: jax.Array   # f32 EMA of pooled_sq
+    cos: jax.Array             # f32[W] last-check per-replica cosine
+                               #   vs the pooled mean; -2 = no data
+    proj: jax.Array            # f32[W] last-check Adasum projection
+                               #   coefficient dot_i/|g_i|²; 0 = no data
+    cos_min_ema: jax.Array     # f32 EMA of min-over-replicas cosine
+    cos_mean_ema: jax.Array    # f32 EMA of mean-over-replicas cosine
+    eff_lr: jax.Array          # f32[S] last-check ‖update‖/‖grad‖;
+                               #   -1 = site has no grad companion
+    eff_lr_ema: jax.Array      # f32[S] EMA of eff_lr
+    uw_ratio: jax.Array        # f32[S] EMA ‖update‖/‖weight‖;
+                               #   -1 = site has no weight companion
+
+
+def dynamics_init(cfg: DynamicsConfig = DynamicsConfig(), *,
+                  sites: Sequence[str],
+                  world: int = 1) -> DynamicsState:
+    """Fresh dynamics state for a static site tuple (from
+    :func:`site_names`) and a static replica count ``world`` (the dp
+    axis size — sizes the geometry spectrum rows; 1 is fine for
+    single-replica runs, which simply never fold a probe). Thread
+    through the step like GuardState."""
+    if int(cfg.check_every) < 1:
+        raise ValueError(f"DynamicsConfig.check_every must be >= 1, "
+                         f"got {cfg.check_every}")
+    if not 0.0 < float(cfg.ema) < 1.0:
+        raise ValueError(f"DynamicsConfig.ema must be in (0, 1), "
+                         f"got {cfg.ema}")
+    if int(cfg.local_batch) < 1:
+        raise ValueError(f"DynamicsConfig.local_batch must be >= 1, "
+                         f"got {cfg.local_batch}")
+    s = len(tuple(sites))
+    if s < 1:
+        raise ValueError("dynamics_init needs at least one site")
+    w = int(world)
+    if w < 1:
+        raise ValueError(f"dynamics_init world must be >= 1, got {world}")
+    z = jnp.int32(0)
+    f0 = jnp.float32(0)
+    return DynamicsState(
+        step=z, check_count=z, last_check_step=jnp.int32(-1),
+        world=jnp.float32(_NO_WORLD),
+        local_sq=f0, local_sq_ema=f0,
+        pooled_sq=f0, pooled_sq_ema=f0,
+        cos=jnp.full((w,), _NO_COS, jnp.float32),
+        proj=jnp.zeros((w,), jnp.float32),
+        cos_min_ema=jnp.float32(_NO_COS),
+        cos_mean_ema=jnp.float32(_NO_COS),
+        eff_lr=jnp.full((s,), _NO_DATA, jnp.float32),
+        eff_lr_ema=jnp.full((s,), _NO_DATA, jnp.float32),
+        uw_ratio=jnp.full((s,), _NO_DATA, jnp.float32))
+
+
+def _norm(tree) -> jax.Array:
+    """fp32 L2 norm of a single leaf."""
+    return jnp.sqrt(jnp.sum(jnp.square(
+        jnp.asarray(tree).astype(jnp.float32))))
+
+
+def dynamics_observe(ds: DynamicsState, cfg: DynamicsConfig,
+                     trees, *,
+                     probe=None,
+                     grads: Optional[Dict[str, Any]] = None,
+                     weights: Optional[Dict[str, Any]] = None
+                     ) -> DynamicsState:
+    """Observe one step: fold GNS/geometry/effective-LR statistics
+    every ``cfg.check_every`` steps, advance counters.
+
+    ``trees`` carries the SAME (prefix → pytree) structure the state's
+    sites were built from (:func:`site_names` — sorted prefixes,
+    flatten order): the per-site *update* tensors. Like the numerics
+    fold it may be a zero-arg callable returning that dict, in which
+    case derived tensors (the update delta) are built inside the fold's
+    ``lax.cond`` branch and cost nothing on off-steps (the
+    :meth:`Amp.step <apex_tpu.amp.Amp.step>` hook uses this).
+
+    ``grads`` optionally maps a prefix to the matching *gradient*
+    pytree; those sites fold the effective learning rate
+    ``‖update‖₂ / ‖grad‖₂`` (per-coordinate step size the optimizer
+    actually took — the Adam-style gauge a raw LR cannot show).
+    ``weights`` maps a prefix to the weight pytree for the
+    update-to-weight ratio, exactly the numerics companion mechanism.
+
+    ``probe`` is a :class:`DynamicsProbe` (or a zero-arg callable
+    returning one — the collectives then trace inside the cond branch,
+    which is safe because the cadence predicate is replicated) from
+    :func:`apex_tpu.parallel.distributed.dynamics_probe`. ``None``
+    (single-replica runs) leaves the GNS/geometry fields at their
+    sentinels.
+
+    Off-steps take the empty ``lax.cond`` branch: no fold, no extra
+    work (``check_every=1`` skips the cond entirely). Observation is
+    read-only — the trajectory with it enabled is bit-identical to the
+    trajectory without (the O0–O3 parity sweep in
+    tests/test_dynamics.py asserts it per opt level).
+    """
+    grads = grads or {}
+    weights = weights or {}
+    s_total = int(ds.eff_lr.shape[0])
+    w_total = int(ds.cos.shape[0])
+
+    def _fold(st: DynamicsState) -> DynamicsState:
+        tr = trees() if callable(trees) else trees
+        for name, companion in (("grads", grads), ("weights", weights)):
+            for k in companion:
+                if k not in tr:
+                    raise ValueError(f"{name} prefix {k!r} has no "
+                                     f"matching tree in trees="
+                                     f"{sorted(tr)}")
+        effs: List[jax.Array] = []
+        uws: List[jax.Array] = []
+        for prefix in sorted(tr):
+            leaves = jax.tree_util.tree_leaves(tr[prefix])
+            gleaves = (jax.tree_util.tree_leaves(grads[prefix])
+                       if prefix in grads else [None] * len(leaves))
+            wleaves = (jax.tree_util.tree_leaves(weights[prefix])
+                       if prefix in weights else [None] * len(leaves))
+            if len(gleaves) != len(leaves) or len(wleaves) != len(leaves):
+                raise ValueError(
+                    f"companion trees for {prefix!r} have "
+                    f"{len(gleaves)}/{len(wleaves)} leaves, "
+                    f"trees[{prefix!r}] has {len(leaves)}")
+            for leaf, g, w in zip(leaves, gleaves, wleaves):
+                un = _norm(leaf)
+                if g is None:
+                    effs.append(jnp.float32(_NO_DATA))
+                else:
+                    effs.append(un / jnp.maximum(_norm(g), 1e-30))
+                if w is None:
+                    uws.append(jnp.float32(_NO_DATA))
+                else:
+                    uws.append(un / jnp.maximum(_norm(w), 1e-30))
+        if len(effs) != s_total:
+            raise ValueError(
+                f"dynamics_observe saw {len(effs)} sites, state has "
+                f"{s_total} — trees must match dynamics_init's sites")
+        eff = jnp.stack(effs)
+        uw = jnp.stack(uws)
+        d = jnp.float32(cfg.ema)
+        first = st.check_count == 0
+        ema = lambda prev, cur: jnp.where(  # noqa: E731 — N-use local
+            first, cur, d * prev + (1 - d) * cur)
+        # a -1 slot means "no companion": it never mixes into the EMA
+        had_eff = st.eff_lr_ema >= 0
+        new_eff_ema = jnp.where(
+            eff < 0, st.eff_lr_ema,
+            jnp.where(had_eff, d * st.eff_lr_ema + (1 - d) * eff, eff))
+        had_uw = st.uw_ratio >= 0
+        new_uw = jnp.where(
+            uw < 0, st.uw_ratio,
+            jnp.where(had_uw, d * st.uw_ratio + (1 - d) * uw, uw))
+        st = st._replace(
+            eff_lr=eff, eff_lr_ema=new_eff_ema, uw_ratio=new_uw,
+            check_count=st.check_count + 1, last_check_step=st.step)
+        pr = probe() if callable(probe) else probe
+        if pr is None:
+            return st
+        if int(pr.local_sqs.shape[0]) != w_total:
+            raise ValueError(
+                f"dynamics_observe probe has world="
+                f"{pr.local_sqs.shape[0]}, state was initialized with "
+                f"world={w_total} — pass the dp axis size to "
+                f"dynamics_init")
+        # the probe EMAs seed on the first PROBE fold, which may come
+        # later than the first site fold (world < 0 marks "never")
+        pfirst = st.world < 0
+        pema = lambda prev, cur: jnp.where(  # noqa: E731 — 4-use local
+            pfirst, cur, d * prev + (1 - d) * cur)
+        lsq = pr.local_sq_mean.astype(jnp.float32)
+        psq = pr.pooled_sq.astype(jnp.float32)
+        # geometry: cos_i = dot_i / (|g_i| |g̅|); proj_i = dot_i/|g_i|²
+        # (the Adasum combiner coefficient, arXiv 2006.02924 eq. 2)
+        lsqs = pr.local_sqs.astype(jnp.float32)
+        dots = pr.dots.astype(jnp.float32)
+        denom = jnp.sqrt(jnp.maximum(lsqs * psq, 1e-30))
+        cos = dots / denom
+        proj = dots / jnp.maximum(lsqs, 1e-30)
+        return st._replace(
+            world=pr.world.astype(jnp.float32),
+            local_sq=lsq, local_sq_ema=pema(st.local_sq_ema, lsq),
+            pooled_sq=psq, pooled_sq_ema=pema(st.pooled_sq_ema, psq),
+            cos=cos, proj=proj,
+            cos_min_ema=pema(st.cos_min_ema, jnp.min(cos)),
+            cos_mean_ema=pema(st.cos_mean_ema, jnp.mean(cos)))
+
+    if int(cfg.check_every) <= 1:
+        new = _fold(ds)
+    else:
+        new = lax.cond((ds.step % cfg.check_every) == 0, _fold,
+                       lambda st: st, ds)
+    return new._replace(step=ds.step + 1)
+
+
+# -- the host half: GNS estimator + report ------------------------------------
+
+def _gns_estimate(local_sq: float, pooled_sq: float, world: float,
+                  local_batch: int) -> Dict[str, Optional[float]]:
+    """The unbiased small/big-batch pair estimator (McCandlish et al.,
+    arXiv 1812.06162 appendix A): with per-replica batch ``b`` and big
+    batch ``B = world·b``,
+
+      ``|G|²̂  = (B·|G_B|² − b·|G_b|²) / (B − b)``   (true grad norm²)
+      ``S`̂    = (|G_b|² − |G_B|²) / (1/b − 1/B)``   (per-example noise)
+      ``B_simple = S`̂ / |G|²̂  ≈ B_crit``
+
+    where ``|G_b|²`` is the mean per-replica squared norm and
+    ``|G_B|²`` the pooled mean's squared norm. Returns None fields when
+    the estimate is undefined (world ≤ 1, no probe, or a noise-free
+    trajectory driving the estimator non-positive)."""
+    out: Dict[str, Optional[float]] = {
+        "g2_est": None, "s_est": None, "gns": None, "b_crit": None}
+    if world is None or world <= 1 or local_batch < 1:
+        return out
+    b = float(local_batch)
+    B = float(world) * b
+    g2 = (B * pooled_sq - b * local_sq) / (B - b)
+    s = (local_sq - pooled_sq) / (1.0 / b - 1.0 / B)
+    out["g2_est"] = g2
+    out["s_est"] = s
+    if g2 > 0 and s > 0:
+        gns = s / g2
+        out["gns"] = gns
+        out["b_crit"] = gns  # B_simple ≈ B_crit (1812.06162 §2.2)
+    return out
+
+
+@dataclasses.dataclass
+class DynamicsReport:
+    """One observed run's training-dynamics verdict: the GNS estimate,
+    the replica-geometry spectrum, and the per-site effective-LR rows
+    with robust outlier flags."""
+
+    step: int
+    check_count: int
+    world: Optional[float]          # None until a probe folded
+    local_batch: int
+    gns: Optional[float]            # B_simple; None when undefined
+    b_crit: Optional[float]         # critical-batch-size estimate
+    g2_est: Optional[float]
+    s_est: Optional[float]
+    cos_spectrum: List[float]       # per-replica cosine vs pooled mean
+    proj_spectrum: List[float]      # per-replica Adasum projection
+    cos_min: Optional[float]
+    cos_mean: Optional[float]
+    cos_min_ema: Optional[float]
+    sites: List[str]
+    eff_lr: List[Optional[float]]   # EMA rows; None = no companion
+    uw_ratio: List[Optional[float]]
+    outlier_z: float
+    #: sites whose effective-LR EMA sits > outlier_z robust sigmas from
+    #: the median (perf_sentinel's med/MAD statistics) — a layer whose
+    #: optimizer step size ran away from the pack
+    eff_lr_outliers: List[Dict[str, Any]] = dataclasses.field(
+        default_factory=list)
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable ``dynamics|gns|global`` key — the waiver/pin
+        identity, apexlint-fingerprint style (never includes measured
+        numbers)."""
+        return "dynamics|gns|global"
+
+    def table(self, top: int = 8) -> str:
+        lines = [f"dynamics — step {self.step}, "
+                 f"{self.check_count} checks, world="
+                 f"{self.world if self.world is not None else '?'}",
+                 f"  gns(B_simple)={_fmt(self.gns)} "
+                 f"b_crit={_fmt(self.b_crit)} "
+                 f"cos_min={_fmt(self.cos_min)} "
+                 f"cos_mean={_fmt(self.cos_mean)}",
+                 f"{'site':<44} {'eff_lr':>10} {'uw':>10}"]
+        rows = sorted(range(len(self.sites)),
+                      key=lambda i: -(self.eff_lr[i] or 0.0))
+        for i in rows[:top]:
+            lines.append(f"{self.sites[i][:44]:<44} "
+                         f"{_fmt(self.eff_lr[i]):>10} "
+                         f"{_fmt(self.uw_ratio[i]):>10}")
+        for o in self.eff_lr_outliers:
+            lines.append(f"  OUTLIER {o['site']}: eff_lr="
+                         f"{_fmt(o['eff_lr'])} ({o['sigmas']:.1f}σ)")
+        return "\n".join(lines)
+
+    def to_events(self, rank: int = 0) -> List[Dict]:
+        """The ``kind="gns"`` event row (``check_metrics_schema.py
+        --kind dynamics`` validates) — the per-site emission is
+        :func:`check_events`."""
+        return [{
+            "kind": "gns", "rank": rank, "step": self.step,
+            "check_count": self.check_count,
+            "gns": _finite_or_none(self.gns),
+            "b_crit": _finite_or_none(self.b_crit),
+            "local_sq": None, "pooled_sq": None,
+            "world": self.world, "local_batch": self.local_batch,
+            "cos_min": self.cos_min, "cos_mean": self.cos_mean,
+            "fingerprint": self.fingerprint,
+        }]
+
+
+def _fmt(v) -> str:
+    return "-" if v is None else f"{v:.4g}"
+
+
+def _finite_or_none(v):
+    return v if v is not None and math.isfinite(v) else None
+
+
+def _fetch_stats(ds: DynamicsState, sites: Sequence[str]) -> Dict:
+    import numpy as np
+    host = jax.device_get(ds)
+    if len(sites) != host.eff_lr.shape[0]:
+        raise ValueError(f"{len(sites)} sites for a state with "
+                         f"{host.eff_lr.shape[0]} rows")
+    return {"sites": tuple(sites),
+            "step": int(host.step), "check_count": int(host.check_count),
+            "last_check_step": int(host.last_check_step),
+            "world": float(host.world),
+            "local_sq": float(host.local_sq),
+            "local_sq_ema": float(host.local_sq_ema),
+            "pooled_sq": float(host.pooled_sq),
+            "pooled_sq_ema": float(host.pooled_sq_ema),
+            "cos": np.asarray(host.cos),
+            "proj": np.asarray(host.proj),
+            "cos_min_ema": float(host.cos_min_ema),
+            "cos_mean_ema": float(host.cos_mean_ema),
+            "eff_lr": np.asarray(host.eff_lr),
+            "eff_lr_ema": np.asarray(host.eff_lr_ema),
+            "uw_ratio": np.asarray(host.uw_ratio)}
+
+
+def dynamics_report(ds_or_stats, sites: Optional[Sequence[str]] = None,
+                    *, local_batch: Optional[int] = None,
+                    outlier_z: float = 3.5) -> DynamicsReport:
+    """Join the folded statistics into the host-side verdict.
+
+    ``ds_or_stats`` is a :class:`DynamicsState` (with ``sites`` — ONE
+    host fetch, amortized like a metrics flush) or a stats dict from
+    :func:`stats_to_json` (the committed-fixture path). ``local_batch``
+    overrides the per-replica batch the GNS algebra uses (defaults to
+    the value recorded in the stats, or 1). ``outlier_z`` is the
+    robust-sigma threshold for effective-LR outlier rows (med/MAD, the
+    perf_sentinel statistics)."""
+    import numpy as np
+    if isinstance(ds_or_stats, DynamicsState):
+        if sites is None:
+            raise ValueError("dynamics_report(DynamicsState) needs "
+                             "the matching sites tuple")
+        stats = _fetch_stats(ds_or_stats, sites)
+    else:
+        stats = dict(ds_or_stats)
+        sites = tuple(stats["sites"])
+    b = int(local_batch if local_batch is not None
+            else stats.get("local_batch", 1))
+    world = stats["world"]
+    probed = world is not None and world > 0
+    est = _gns_estimate(stats["local_sq_ema"], stats["pooled_sq_ema"],
+                        world if probed else None, b)
+    cos = np.asarray(stats["cos"], dtype=np.float64)
+    proj = np.asarray(stats["proj"], dtype=np.float64)
+    if probed:
+        w = int(world)
+        cos_spec = [float(v) for v in cos[:w]]
+        proj_spec = [float(v) for v in proj[:w]]
+        cos_min = float(np.min(cos[:w]))
+        cos_mean = float(np.mean(cos[:w]))
+        cme = float(stats["cos_min_ema"])
+    else:
+        cos_spec, proj_spec = [], []
+        cos_min = cos_mean = cme = None
+    eff = np.asarray(stats["eff_lr_ema"], dtype=np.float64)
+    uw = np.asarray(stats["uw_ratio"], dtype=np.float64)
+    eff_rows = [None if v < 0 else float(v) for v in eff]
+    uw_rows = [None if v < 0 else float(v) for v in uw]
+    outliers: List[Dict[str, Any]] = []
+    have = np.asarray([v for v in eff_rows if v is not None])
+    if have.size >= 3:
+        med = float(np.median(have))
+        mad = float(np.median(np.abs(have - med)))
+        sigma = 1.4826 * mad
+        if sigma > 0:
+            for i, v in enumerate(eff_rows):
+                if v is None:
+                    continue
+                z = abs(v - med) / sigma
+                if z > outlier_z:
+                    outliers.append({
+                        "site": sites[i], "eff_lr": v,
+                        "sigmas": round(z, 2),
+                        "fingerprint":
+                            f"dynamics|eff_lr|{sites[i]}"})
+    return DynamicsReport(
+        step=stats["step"], check_count=stats["check_count"],
+        world=(float(world) if probed else None), local_batch=b,
+        gns=est["gns"], b_crit=est["b_crit"],
+        g2_est=est["g2_est"], s_est=est["s_est"],
+        cos_spectrum=cos_spec, proj_spectrum=proj_spec,
+        cos_min=cos_min, cos_mean=cos_mean, cos_min_ema=cme,
+        sites=list(sites), eff_lr=eff_rows, uw_ratio=uw_rows,
+        outlier_z=outlier_z, eff_lr_outliers=outliers)
+
+
+# -- events (the dynamics channel) --------------------------------------------
+
+def check_events(ds: DynamicsState, sites: Sequence[str], *,
+                 rank: int = 0,
+                 local_batch: int = 1) -> List[Dict]:
+    """One ``kind="dynamics_check"`` aggregate row (``site`` null) plus
+    one per-site row, plus the ``kind="gns"`` estimator row — the
+    host-poll emission (wire through
+    ``MetricsLogger(dynamics_sink=…)``; ``--kind dynamics``
+    validates). Fetches the state ONCE."""
+    stats = _fetch_stats(ds, sites)
+    rep = dynamics_report(stats, local_batch=local_batch)
+    eff = stats["eff_lr_ema"]
+    uw = stats["uw_ratio"]
+    events: List[Dict] = [{
+        "kind": "dynamics_check", "rank": rank, "step": stats["step"],
+        "check_count": stats["check_count"], "site": None,
+        "n_sites": len(sites),
+        "eff_lr": max((v for v in rep.eff_lr if v is not None),
+                      default=None),
+        "uw_ratio": max((v for v in rep.uw_ratio if v is not None),
+                        default=None),
+        "cos_min": rep.cos_min, "cos_mean": rep.cos_mean,
+        "world": rep.world,
+    }]
+    for i, site in enumerate(sites):
+        events.append({
+            "kind": "dynamics_check", "rank": rank,
+            "step": stats["step"],
+            "check_count": stats["check_count"], "site": site,
+            "n_sites": len(sites),
+            "eff_lr": None if eff[i] < 0 else float(eff[i]),
+            "uw_ratio": None if uw[i] < 0 else float(uw[i]),
+            "cos_min": None, "cos_mean": None, "world": None,
+        })
+    gns_row = rep.to_events(rank=rank)[0]
+    gns_row["local_sq"] = _finite_or_none(stats["local_sq_ema"])
+    gns_row["pooled_sq"] = _finite_or_none(stats["pooled_sq_ema"])
+    events.append(gns_row)
+    return events
+
+
+# -- fixture round-trip --------------------------------------------------------
+
+def stats_to_json(ds: DynamicsState, sites: Sequence[str], *,
+                  local_batch: int = 1) -> str:
+    """Serialize one fetched measurement (the committed-fixture
+    format: CI pins :func:`dynamics_report` verdicts on a committed
+    measurement with no device in sight)."""
+    st = _fetch_stats(ds, sites)
+    return json.dumps({
+        "version": 1, "sites": list(st["sites"]),
+        "step": st["step"], "check_count": st["check_count"],
+        "last_check_step": st["last_check_step"],
+        "world": st["world"], "local_batch": int(local_batch),
+        "local_sq": st["local_sq"], "local_sq_ema": st["local_sq_ema"],
+        "pooled_sq": st["pooled_sq"],
+        "pooled_sq_ema": st["pooled_sq_ema"],
+        "cos": [float(v) for v in st["cos"]],
+        "proj": [float(v) for v in st["proj"]],
+        "cos_min_ema": st["cos_min_ema"],
+        "cos_mean_ema": st["cos_mean_ema"],
+        "eff_lr": [float(v) for v in st["eff_lr"]],
+        "eff_lr_ema": [float(v) for v in st["eff_lr_ema"]],
+        "uw_ratio": [float(v) for v in st["uw_ratio"]],
+    }, indent=1)
+
+
+def stats_from_json(text: str) -> Dict:
+    """Inverse of :func:`stats_to_json` — feed the result straight to
+    :func:`dynamics_report`."""
+    import numpy as np
+    data = json.loads(text)
+    out = dict(data)
+    for k in ("cos", "proj", "eff_lr", "eff_lr_ema", "uw_ratio"):
+        out[k] = np.asarray(data[k], dtype=np.float64)
+    return out

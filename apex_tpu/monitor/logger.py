@@ -140,6 +140,13 @@ CHANNELS: Tuple[ChannelSpec, ...] = (
                 why_unbuffered="per-axis attribution rows are rare AOT "
                 "audits (shard_report / mesh_explain pre-flights), and "
                 "an unmeasured link's predicted_s is null by contract"),
+    ChannelSpec("dynamics", ("dynamics_check", "gns",
+                             "convergence_verdict"), "record_dynamics",
+                True,
+                why_unbuffered="dynamics checks ride the amortized "
+                "host-poll cadence already, a convergence flag may "
+                "immediately precede the abort it argues for, and an "
+                "undefined GNS estimate is null by contract"),
 )
 
 def _null_nonfinite(rec: Dict, nested: bool) -> None:

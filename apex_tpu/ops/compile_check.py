@@ -1567,6 +1567,108 @@ def _():
             f"(donate={donate})")
 
 
+@case("dynamics/no-extra-dispatch")
+def _():
+    """The training-dynamics observatory's observability contract:
+    (1) the fold — GNS/geometry probe collectives included (the
+    ``ddp/dynamics_gns`` psum and ``ddp/dynamics_geom`` all-gather ride
+    inside the step's shard_map next to the gradient pmean) — compiles
+    to ONE executable with no host traffic, module-count parity with
+    the unobserved twin (off-steps take the empty ``lax.cond`` branch);
+    (2) the HOST side — polling DynamicsState into ``check_events`` /
+    ``dynamics_report`` through a ``dynamics_sink`` every step — leaves
+    the compiled HLO BIT-IDENTICAL, donated and undonated. Same
+    guarantee the monitor/guard/integrity/numerics cases pin for their
+    layers."""
+    import io
+
+    from jax.sharding import PartitionSpec as P
+
+    from apex_tpu import monitor
+    from apex_tpu.monitor import dynamics as _dx
+    from apex_tpu.monitor.check import module_count_and_host_ops
+    from apex_tpu.parallel import distributed as _dist
+
+    devs = jax.devices()
+    world = len(devs)
+    mesh = jax.sharding.Mesh(np.array(devs), ("data",))
+    local_batch = 8
+    x = _rand((local_batch * world, 32), 0)
+    y = _rand((local_batch * world, 8), 1)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+    dcfg = _dx.DynamicsConfig(check_every=4,        # steps 1-3 are OFF
+                              local_batch=local_batch)
+    sites = _dx.site_names({"dynamics/update": params})
+
+    def body(p, ds, x, y, observed):
+        def inner(p, ds, x, y):
+            def loss_fn(p):
+                return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y))
+            g_local = jax.grad(loss_fn)(p)
+            g = jax.tree_util.tree_map(
+                lambda a: jax.lax.pmean(a, "data"), g_local)
+            new_p = jax.tree_util.tree_map(
+                lambda a, b: a - 0.1 * b, p, g)
+            if observed:
+                ds = _dx.dynamics_observe(
+                    ds, dcfg,
+                    lambda: {"dynamics/update": jax.tree_util.tree_map(
+                        lambda n, o: n - o, new_p, p)},
+                    probe=lambda: _dist.dynamics_probe(g_local, g,
+                                                       "data"),
+                    grads={"dynamics/update": g},
+                    weights={"dynamics/update": p})
+            return new_p, ds, jnp.float32(0)
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data")),
+            out_specs=(P(), P(), P()),
+            check_vma=False)(p, ds, x, y)
+
+    def build(observed, donate):
+        fn = functools.partial(body, observed=observed)
+        kw = {"donate_argnums": (0, 1)} if donate else {}
+        return jax.jit(fn, **kw)
+
+    ds0 = _dx.dynamics_init(dcfg, sites=sites, world=world)
+
+    # half 1: one executable, no host ops (module-count parity with
+    # the unobserved twin)
+    n_o, host_o = module_count_and_host_ops(build(True, False),
+                                            params, ds0, x, y)
+    n_p, _ = module_count_and_host_ops(build(False, False),
+                                       params, ds0, x, y)
+    assert n_o == n_p, (n_o, n_p)
+    assert not host_o, \
+        f"dynamics-observed step compiled host traffic: {host_o}"
+
+    # half 2: host polling every step (three of four being off-steps)
+    # leaves the program bit-identical, donated and undonated
+    for donate in (False, True):
+        jitted = build(True, donate)
+        before = jitted.lower(params, ds0, x, y).compile().as_text()
+        logger = monitor.MetricsLogger(
+            sinks=[], dynamics_sink=monitor.JSONLSink(io.StringIO()))
+        # fresh unaliased buffers: freshly-init'd states share cached
+        # zero-scalar constants a donating jit would refuse to donate
+        # twice
+        p, ds = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), (params, ds0))
+        for _ in range(4):
+            p, ds, _loss = jitted(p, ds, x, y)
+            for ev in _dx.check_events(ds, sites,
+                                       local_batch=local_batch):
+                logger.record_dynamics(ev)
+            _dx.dynamics_report(ds, sites, local_batch=local_batch)
+        logger.close()
+        assert int(jax.device_get(ds.check_count)) == 1
+        after = jitted.lower(params, ds0, x, y).compile().as_text()
+        assert after == before, (
+            f"dynamics observation changed the compiled program "
+            f"(donate={donate})")
+
+
 def _pod_budget():
     """Import scripts.pod_comm_budget (the shared HLO audit helpers)
     regardless of cwd — the module lives next to the package root."""

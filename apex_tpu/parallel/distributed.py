@@ -153,6 +153,67 @@ def flat_tree_all_reduce(grads, axis_name: str = DATA_AXIS, *,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def dynamics_probe(local_grads, synced_grads,
+                   axis_name: str = DATA_AXIS):
+    """The training-dynamics observatory's per-step gradient scalars
+    (:class:`apex_tpu.monitor.dynamics.DynamicsProbe`): call between
+    ``sync`` and ``apply_gradients`` with the replica-LOCAL gradient
+    tree and the synced (averaged) tree — both already in hand there —
+    and feed the result to the ``Amp.step(dynamics=…)`` hook or
+    :func:`~apex_tpu.monitor.dynamics.dynamics_observe` directly.
+
+    Wire cost is two scalar-class collectives riding the existing sync
+    dispatch, each under its own registered scope
+    (:mod:`apex_tpu.parallel.registry` — APX102/APX202 and the per-axis
+    byte split resolve them):
+
+    - ``ddp/dynamics_gns``: ONE scalar psum of the per-replica squared
+      grad norm → the mean ``|G_local|²`` the gradient-noise-scale
+      estimator pairs against the pooled ``|G_big|²`` (computed
+      locally — the synced tree is replicated after the sync);
+    - ``ddp/dynamics_geom``: one all-gather of the per-replica
+      ``[|g_i|², g_i·g̅]`` scalar pair → the cosine spectrum and the
+      Adasum projection coefficients (arXiv 2006.02924), ``2·world``
+      floats on the wire.
+
+    ``synced_grads`` must be the *averaged* sync output (the
+    ``gradient_average=True`` default): the GNS algebra reads it as the
+    pooled-mean gradient. Non-floating leaves are ignored, mirroring
+    ``sync``. Works under any mapping that binds ``axis_name``
+    (shard_map/pmap); the probe itself adds no host ops.
+    """
+    from apex_tpu.monitor.dynamics import DynamicsProbe
+    from apex_tpu.trace.spans import span as _span
+
+    def _sq(tree):
+        acc = jnp.float32(0)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if _is_float(leaf):
+                acc = acc + jnp.sum(
+                    jnp.square(jnp.asarray(leaf).astype(jnp.float32)))
+        return acc
+
+    local_sq = _sq(local_grads)
+    pooled_sq = _sq(synced_grads)
+    dot = jnp.float32(0)
+    for g, s in zip(jax.tree_util.tree_leaves(local_grads),
+                    jax.tree_util.tree_leaves(synced_grads)):
+        if _is_float(g) and _is_float(s):
+            dot = dot + jnp.sum(
+                jnp.asarray(g).astype(jnp.float32)
+                * jnp.asarray(s).astype(jnp.float32))
+    world = jax.lax.axis_size(axis_name)
+    with _span("ddp/dynamics_gns", kind="collective"):
+        local_sq_mean = jax.lax.pmean(local_sq, axis_name)
+    with _span("ddp/dynamics_geom", kind="collective"):
+        pairs = jax.lax.all_gather(jnp.stack([local_sq, dot]),
+                                   axis_name)
+    return DynamicsProbe(local_sq_mean=local_sq_mean,
+                         pooled_sq=pooled_sq,
+                         local_sqs=pairs[:, 0], dots=pairs[:, 1],
+                         world=jnp.float32(world))
+
+
 class Reducer:
     """Manual-trigger parameter/gradient averaging
     (`apex/parallel/distributed.py:89-126`): construction-time broadcast is

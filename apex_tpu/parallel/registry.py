@@ -68,6 +68,19 @@ COLLECTIVE_SCOPES: Tuple[CollectiveScope, ...] = (
     CollectiveScope(r"(^|/)bucket\d+/dcn", DATA_INTER_AXIS, "ddp",
                     "hierarchical sync cross-slice hop (one-member-"
                     "per-slice reduce over DCN)"),
+    # dynamics sub-spans BEFORE the parent sync row for the same
+    # first-match reason: a probe called inside the sync scope nests as
+    # ``ddp/sync_gradients/…/dynamics_gns`` and the parent pattern
+    # would swallow it
+    CollectiveScope(r"ddp/dynamics_gns", DATA_AXIS, "ddp",
+                    "gradient-noise-scale probe: one scalar psum of "
+                    "the per-replica squared grad norm "
+                    "(apex_tpu.monitor.dynamics)"),
+    CollectiveScope(r"ddp/dynamics_geom", DATA_AXIS, "ddp",
+                    "replica-gradient geometry probe: all-gather of "
+                    "the per-replica [|g_i|^2, g_i.gbar] scalar pair "
+                    "(cosine spectrum + Adasum projection "
+                    "coefficients)"),
     CollectiveScope(r"ddp/sync_gradients", DATA_AXIS, "ddp",
                     "gradient all-reduce across the data axis"),
     CollectiveScope(r"(^|/)bucket\d+", DATA_AXIS, "ddp",

@@ -85,13 +85,26 @@ sharded/replicated bytes, wire bytes, and α–β-predicted comm seconds
 ``"unknown"`` overflow row), byte fields are non-negative ints, and
 ``predicted_s`` is null on unmeasured links.
 
+``--kind dynamics`` — the training-dynamics-observatory channel
+(``apex_tpu/monitor/dynamics.py``, ``convergence.py``): a
+``dynamics_check`` is one host poll of the in-graph per-site
+statistics — ``site`` is null on the aggregate row only (which
+carries the eff-LR/uw maxima plus the replica-geometry scalars;
+cosines ∈ [-1, 1]); a ``gns`` row is the gradient-noise-scale
+estimate (``gns``/``b_crit`` positive or null — null whenever the
+estimator is undefined: no probe, world ≤ 1, or a noise-free
+trajectory); a ``convergence_verdict`` is one A/B comparator answer
+(verdict in {pass, flag}; a flag names its first_flag_step, a pass
+nulls it; n_flagged ≤ n_steps; a positive band_threshold; the stable
+``dynamics|convergence|loss`` fingerprint).
+
 Pure stdlib on purpose: CI and log-shipping hosts can run it without
 jax. Exit status 0 = valid, 1 = violations (printed one per line),
 2 = usage/IO error.
 
 Usage: python scripts/check_metrics_schema.py
            [--kind metrics|trace|memory|lint|ckpt|guard|goodput|roofline
-                   |cluster|integrity|numerics|podview|sharding]
+                   |cluster|integrity|numerics|podview|sharding|dynamics]
            FILE
 """
 
@@ -949,6 +962,80 @@ def _sharding_special(i, rec, kind, state, errors):
                               f"'unknown')")
 
 
+# --- dynamics channel schema --------------------------------------------------
+
+DYNAMICS_KINDS = ("dynamics_check", "gns", "convergence_verdict")
+#: comparator verdict enum (apex_tpu/monitor/convergence.py)
+DYNAMICS_VERDICTS = ("pass", "flag")
+#: cosine-valued fields — must sit in [-1, 1] when present
+DYNAMICS_COSINES = ("cos_min", "cos_mean")
+DYNAMICS_REQUIRED = {
+    "dynamics_check": ("step", "check_count", "n_sites"),
+    "gns": ("step", "check_count", "local_batch", "fingerprint"),
+    "convergence_verdict": ("verdict", "n_flagged", "n_steps",
+                            "band_threshold", "band_z", "fingerprint"),
+}
+DYNAMICS_NULLABLE = {
+    # site is null on the AGGREGATE dynamics_check row only (which
+    # carries the maxima + the geometry scalars); the per-site rows
+    # null the geometry instead, and the gauges are null until their
+    # companion (grad / weight / probe) has folded
+    "dynamics_check": ("site", "eff_lr", "uw_ratio", "cos_min",
+                       "cos_mean", "world"),
+    # the GNS estimate is null by contract until a probe folded with
+    # world > 1, and whenever the estimator algebra is undefined (a
+    # noise-free trajectory drives it non-positive)
+    "gns": ("gns", "b_crit", "local_sq", "pooled_sq", "world",
+            "cos_min", "cos_mean"),
+    # step mirrors first_flag_step: both null on a pass verdict
+    "convergence_verdict": ("step", "first_flag_step", "max_gap"),
+}
+
+
+def _dynamics_special(i, rec, kind, state, errors):
+    for ck in DYNAMICS_COSINES:
+        v = rec.get(ck)
+        if ck not in rec or v is None:
+            continue
+        if not _is_number(v) or not -1.0 <= v <= 1.0:
+            errors.append(f"line {i}: {ck!r} must be a cosine in "
+                          f"[-1, 1], got {v!r}")
+    site = rec.get("site")
+    if site is not None and "site" in rec and not isinstance(site, str):
+        errors.append(f"line {i}: 'site' must be a string, got "
+                      f"{site!r}")
+    if "fingerprint" in rec and not isinstance(
+            rec.get("fingerprint"), str):
+        errors.append(f"line {i}: 'fingerprint' must be a string")
+    if kind == "gns":
+        for pk in ("gns", "b_crit"):
+            v = rec.get(pk)
+            if pk in rec and v is not None and (
+                    not _is_number(v) or v <= 0):
+                errors.append(f"line {i}: {pk!r} must be a positive "
+                              f"number or null, got {v!r}")
+    if kind == "convergence_verdict":
+        bt = rec.get("band_threshold")
+        if "band_threshold" in rec and (
+                not _is_number(bt) or bt <= 0):
+            errors.append(f"line {i}: 'band_threshold' must be a "
+                          f"positive number, got {bt!r}")
+        ver = rec.get("verdict")
+        ffs = rec.get("first_flag_step")
+        if ver == "pass" and ffs is not None:
+            errors.append(f"line {i}: verdict 'pass' with "
+                          f"first_flag_step={ffs!r} (must be null)")
+        if ver == "flag" and "first_flag_step" in rec and ffs is None:
+            errors.append(f"line {i}: verdict 'flag' needs a "
+                          f"first_flag_step")
+        nf, ns = rec.get("n_flagged"), rec.get("n_steps")
+        if (isinstance(nf, int) and isinstance(ns, int)
+                and not isinstance(nf, bool)
+                and not isinstance(ns, bool) and nf > ns):
+            errors.append(f"line {i}: n_flagged {nf} exceeds "
+                          f"n_steps {ns}")
+
+
 # --- the channel registry -----------------------------------------------------
 
 SCHEMAS: Dict[str, ChannelSchema] = {
@@ -1031,6 +1118,15 @@ SCHEMAS: Dict[str, ChannelSchema] = {
                   "hbm_replicated_bytes", "wire_bytes"),
         nonneg=("predicted_s", "wall_time"),
         special=_sharding_special),
+    "dynamics": ChannelSchema(
+        DYNAMICS_KINDS, DYNAMICS_REQUIRED, DYNAMICS_NULLABLE,
+        counters=("rank", "step", "check_count", "n_sites",
+                  "local_batch", "n_flagged", "n_steps",
+                  "first_flag_step"),
+        nonneg=("eff_lr", "uw_ratio", "world", "local_sq",
+                "pooled_sq", "max_gap", "band_z", "wall_time"),
+        enums={"verdict": DYNAMICS_VERDICTS},
+        special=_dynamics_special),
 }
 
 
@@ -1079,6 +1175,7 @@ check_integrity_lines = _make_checker(SCHEMAS["integrity"])
 check_numerics_lines = _make_checker(SCHEMAS["numerics"])
 check_podview_lines = _make_checker(SCHEMAS["podview"])
 check_sharding_lines = _make_checker(SCHEMAS["sharding"])
+check_dynamics_lines = _make_checker(SCHEMAS["dynamics"])
 
 CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "memory": check_memory_lines, "lint": check_lint_lines,
@@ -1089,7 +1186,8 @@ CHECKERS = {"metrics": check_lines, "trace": check_trace_lines,
             "integrity": check_integrity_lines,
             "numerics": check_numerics_lines,
             "podview": check_podview_lines,
-            "sharding": check_sharding_lines}
+            "sharding": check_sharding_lines,
+            "dynamics": check_dynamics_lines}
 
 
 def main(argv=None) -> int:

@@ -294,6 +294,23 @@ EOF
     # stream passes --kind numerics with all three kinds present
     JAX_PLATFORMS=cpu python scripts/numerics_audit.py --cpu8
 
+    echo "== smoke: training-dynamics observatory audit (--cpu8)"
+    # asserts: (a) the GNS/B_crit estimator recovers a KNOWN injected
+    # gradient noise scale within 25% through the real pipeline
+    # (8-replica shard_map, the registered ddp/dynamics_* collectives,
+    # the EMA fold), with the G2/S intermediates matching their
+    # analytic values, (b) bit-replicated gradients measure cosine and
+    # Adasum projection = 1 while a seeded-decorrelation twin drops to
+    # the analytic ~1/sqrt(world) cosine regime, (c) the
+    # noise-calibrated convergence comparator flags a too-high-LR
+    # trajectory at the seeded divergence step under a band calibrated
+    # from paired-seed runs AND stays quiet on a paired-seed twin,
+    # (d) Amp.step(dynamics=...) leaves losses and params bitwise
+    # identical observed-vs-not at O0-O3, (e) the stream passes
+    # --kind dynamics with all three kinds and the
+    # dynamics/no-extra-dispatch compile-check case is green
+    JAX_PLATFORMS=cpu python scripts/dynamics_audit.py --cpu8
+
     echo "== smoke: roofline observatory audit (--cpu8)"
     # asserts: (a) the per-op roofline join over the committed
     # BERT-layer fixture closes over the trace's module device time

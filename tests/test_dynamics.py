@@ -1,0 +1,567 @@
+"""Training-dynamics observatory tests (docs/dynamics.md).
+
+Covers: the in-graph fold (cadence, EMA seeding, sentinel semantics,
+companion mechanics, shape validation), the GNS estimator algebra on a
+hand-computed case, the replica-geometry join, the host report
+(nulls-by-contract, med/MAD effective-LR outliers, fixture
+round-trip), the convergence band comparator, the 13th metrics
+channel + schema negative twins, the `Amp.step(dynamics=)` hook with
+the O0-O3 bitwise observation-parity sweep, the `ddp/dynamics_*`
+registry pins, and the sentinel's new direction-aware columns."""
+
+import io
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp, monitor, parallel
+from apex_tpu.monitor import convergence as cv
+from apex_tpu.monitor import dynamics as dx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _observe_once(trees, grads=None, weights=None, cfg=None, world=1):
+    cfg = cfg or dx.DynamicsConfig()
+    sites = dx.site_names(trees)
+    ds = dx.dynamics_init(cfg, sites=sites, world=world)
+    ds = dx.dynamics_observe(ds, cfg, trees, grads=grads,
+                             weights=weights)
+    return ds, sites
+
+
+def _probe(local_sq_mean, pooled_sq, local_sqs, dots):
+    arr = jnp.asarray(local_sqs, jnp.float32)
+    return dx.DynamicsProbe(
+        local_sq_mean=jnp.float32(local_sq_mean),
+        pooled_sq=jnp.float32(pooled_sq),
+        local_sqs=arr,
+        dots=jnp.asarray(dots, jnp.float32),
+        world=jnp.float32(arr.shape[0]))
+
+
+# --- the fold -----------------------------------------------------------------
+
+class TestDynamicsFold:
+    def test_cadence(self):
+        cfg = dx.DynamicsConfig(check_every=2)
+        trees = {"t": jnp.ones((4,), jnp.float32)}
+        ds = dx.dynamics_init(cfg, sites=dx.site_names(trees))
+        for _ in range(4):
+            ds = dx.dynamics_observe(ds, cfg, trees)
+        assert int(ds.step) == 4
+        assert int(ds.check_count) == 2          # steps 0 and 2
+        assert int(ds.last_check_step) == 2
+
+    def test_eff_lr_ema_seeded_by_first_check(self):
+        cfg = dx.DynamicsConfig(ema=0.5)
+        g = {"t": jnp.full((4,), 1.0, jnp.float32)}
+        ds, _ = _observe_once({"t": jnp.full((4,), 8.0, jnp.float32)},
+                              grads=g, cfg=cfg)
+        assert float(ds.eff_lr_ema[0]) == pytest.approx(8.0)
+        ds = dx.dynamics_observe(
+            ds, cfg, {"t": jnp.full((4,), 4.0, jnp.float32)}, grads=g)
+        assert float(ds.eff_lr_ema[0]) == pytest.approx(6.0)
+
+    def test_no_companion_sentinels(self):
+        ds, _ = _observe_once({"t": jnp.ones((4,), jnp.float32)})
+        assert float(ds.eff_lr[0]) == -1.0
+        assert float(ds.eff_lr_ema[0]) == -1.0
+        assert float(ds.uw_ratio[0]) == -1.0
+        assert float(ds.world) == -1.0           # no probe folded
+        assert float(ds.cos_min_ema) == -2.0
+
+    def test_companion_ratios(self):
+        upd = {"t": jnp.full((4,), 0.01, jnp.float32)}
+        ds, _ = _observe_once(
+            upd, grads={"t": jnp.full((4,), 1.0, jnp.float32)},
+            weights={"t": jnp.full((4,), 2.0, jnp.float32)})
+        assert float(ds.eff_lr[0]) == pytest.approx(0.01)
+        assert float(ds.uw_ratio[0]) == pytest.approx(0.005)
+
+    def test_mismatched_trees_refused(self):
+        cfg = dx.DynamicsConfig()
+        ds = dx.dynamics_init(cfg, sites=("a", "b"))
+        with pytest.raises(ValueError):
+            dx.dynamics_observe(ds, cfg, {"a": jnp.zeros(2)})
+        with pytest.raises(ValueError):
+            dx.dynamics_observe(
+                ds, cfg, {"a": jnp.zeros(2)},
+                grads={"nope": jnp.zeros(2)})
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            dx.dynamics_init(dx.DynamicsConfig(check_every=0),
+                             sites=("t",))
+        with pytest.raises(ValueError):
+            dx.dynamics_init(dx.DynamicsConfig(ema=1.0), sites=("t",))
+        with pytest.raises(ValueError):
+            dx.dynamics_init(dx.DynamicsConfig(local_batch=0),
+                             sites=("t",))
+        with pytest.raises(ValueError):
+            dx.dynamics_init(sites=())
+        with pytest.raises(ValueError):
+            dx.dynamics_init(sites=("t",), world=0)
+
+    def test_probe_world_mismatch_refused(self):
+        cfg = dx.DynamicsConfig()
+        ds = dx.dynamics_init(cfg, sites=("t",), world=2)
+        with pytest.raises(ValueError):
+            dx.dynamics_observe(
+                ds, cfg, {"t": jnp.zeros(2)},
+                probe=_probe(1.0, 1.0, [1.0] * 4, [1.0] * 4))
+
+    def test_probe_fold_geometry(self):
+        cfg = dx.DynamicsConfig()
+        ds = dx.dynamics_init(cfg, sites=("t",), world=4)
+        ds = dx.dynamics_observe(
+            ds, cfg, {"t": jnp.zeros(2)},
+            probe=_probe(1.0, 1.0, [1.0, 1.0, 4.0, 1.0],
+                         [1.0, 0.5, 1.0, 1.0]))
+        assert float(ds.world) == 4.0
+        cos = np.asarray(ds.cos)
+        # cos_i = dot_i / sqrt(|g_i|^2 * |g_bar|^2)
+        assert cos[0] == pytest.approx(1.0)
+        assert cos[1] == pytest.approx(0.5)
+        assert cos[2] == pytest.approx(0.5)      # 1.0 / sqrt(4)
+        proj = np.asarray(ds.proj)               # dot_i / |g_i|^2
+        assert proj[2] == pytest.approx(0.25)
+        assert float(ds.cos_min_ema) == pytest.approx(0.5)  # seeded
+
+    def test_probe_thunk_and_trees_thunk(self):
+        cfg = dx.DynamicsConfig()
+        ds = dx.dynamics_init(cfg, sites=("t",), world=2)
+        ds = dx.dynamics_observe(
+            ds, cfg, lambda: {"t": jnp.ones(2)},
+            probe=lambda: _probe(2.0, 1.0, [2.0, 2.0], [1.0, 1.0]))
+        assert int(ds.check_count) == 1
+        assert float(ds.local_sq) == pytest.approx(2.0)
+
+    def test_scan_carryable(self):
+        cfg = dx.DynamicsConfig(check_every=2)
+        trees = {"t": jnp.ones((4,), jnp.float32)}
+        ds0 = dx.dynamics_init(cfg, sites=dx.site_names(trees))
+
+        def body(ds, _):
+            return dx.dynamics_observe(ds, cfg, trees), ()
+
+        ds, _ = jax.lax.scan(body, ds0, None, length=6)
+        assert int(ds.check_count) == 3
+
+
+# --- the GNS estimator --------------------------------------------------------
+
+class TestGnsEstimator:
+    def test_hand_computed_case(self):
+        # b=4, world=4 -> B=16; |G_b|^2=2, |G_B|^2=1:
+        #   g2 = (16*1 - 4*2)/12 = 2/3;  s = (2-1)/(1/4-1/16) = 16/3
+        #   gns = s/g2 = 8
+        est = dx._gns_estimate(2.0, 1.0, 4.0, 4)
+        assert est["g2_est"] == pytest.approx(2.0 / 3.0)
+        assert est["s_est"] == pytest.approx(16.0 / 3.0)
+        assert est["gns"] == pytest.approx(8.0)
+        assert est["b_crit"] == pytest.approx(8.0)
+
+    def test_undefined_without_world(self):
+        assert dx._gns_estimate(2.0, 1.0, None, 4)["gns"] is None
+        assert dx._gns_estimate(2.0, 1.0, 1.0, 4)["gns"] is None
+
+    def test_noise_free_is_null_not_fake(self):
+        # replicated gradients: local == pooled -> S estimate 0
+        est = dx._gns_estimate(1.0, 1.0, 4.0, 4)
+        assert est["gns"] is None and est["b_crit"] is None
+
+
+# --- the host report ----------------------------------------------------------
+
+class TestDynamicsReport:
+    def test_nulls_before_any_probe(self):
+        ds, sites = _observe_once({"t": jnp.ones((4,), jnp.float32)})
+        rep = dx.dynamics_report(ds, sites)
+        assert rep.world is None and rep.gns is None
+        assert rep.cos_spectrum == [] and rep.cos_min is None
+        assert rep.eff_lr == [None]
+        assert rep.fingerprint == "dynamics|gns|global"
+
+    def test_gns_through_state(self):
+        cfg = dx.DynamicsConfig(local_batch=4)
+        ds = dx.dynamics_init(cfg, sites=("t",), world=4)
+        ds = dx.dynamics_observe(
+            ds, cfg, {"t": jnp.zeros(2)},
+            probe=_probe(2.0, 1.0, [2.0] * 4, [1.0] * 4))
+        rep = dx.dynamics_report(ds, ("t",), local_batch=4)
+        assert rep.gns == pytest.approx(8.0)
+        assert rep.world == 4.0
+
+    def test_eff_lr_outliers_med_mad(self):
+        trees = {"p": {k: jnp.ones((2,), jnp.float32)
+                       for k in "abcde"}}
+        sites = dx.site_names(trees)
+        stats = {
+            "sites": sites, "step": 10, "check_count": 5,
+            "last_check_step": 8, "world": -1.0,
+            "local_sq": 0.0, "local_sq_ema": 0.0,
+            "pooled_sq": 0.0, "pooled_sq_ema": 0.0,
+            "cos": np.full(1, -2.0), "proj": np.zeros(1),
+            "cos_min_ema": -2.0, "cos_mean_ema": -2.0,
+            "eff_lr": np.zeros(5),
+            "eff_lr_ema": np.array([0.1, 0.11, 0.09, 0.1, 100.0]),
+            "uw_ratio": np.full(5, -1.0)}
+        rep = dx.dynamics_report(stats)
+        assert len(rep.eff_lr_outliers) == 1
+        out = rep.eff_lr_outliers[0]
+        assert out["eff_lr"] == pytest.approx(100.0)
+        assert out["fingerprint"].startswith("dynamics|eff_lr|p/")
+        assert "OUTLIER" in rep.table()
+
+    def test_fixture_round_trip(self):
+        cfg = dx.DynamicsConfig(local_batch=2)
+        ds = dx.dynamics_init(cfg, sites=("t",), world=2)
+        ds = dx.dynamics_observe(
+            ds, cfg, {"t": jnp.full((4,), 0.5, jnp.float32)},
+            grads={"t": jnp.ones((4,), jnp.float32)},
+            probe=_probe(2.0, 1.5, [2.0, 2.0], [1.7, 1.7]))
+        text = dx.stats_to_json(ds, ("t",), local_batch=2)
+        rep_a = dx.dynamics_report(ds, ("t",), local_batch=2)
+        rep_b = dx.dynamics_report(dx.stats_from_json(text))
+        assert rep_b.local_batch == 2       # recorded in the fixture
+        assert rep_b.gns == pytest.approx(rep_a.gns)
+        assert rep_b.cos_spectrum == pytest.approx(rep_a.cos_spectrum)
+        assert rep_b.eff_lr[0] == pytest.approx(rep_a.eff_lr[0])
+
+
+# --- the convergence comparator -----------------------------------------------
+
+class TestConvergence:
+    def test_calibration_validation(self):
+        with pytest.raises(ValueError):
+            cv.calibrate_band([[1.0, 2.0]])              # < 2 runs
+        with pytest.raises(ValueError):
+            cv.calibrate_band([[1.0], [1.0]])            # < 2 steps
+        with pytest.raises(ValueError):
+            cv.calibrate_band([[1.0, float("nan")],
+                               [1.0, 2.0]])              # nonfinite
+
+    def test_identical_runs_floor_band_pass(self):
+        run = [1.0, 0.5, 0.25, 0.125]
+        band = cv.calibrate_band([run, list(run)], floor=1e-9)
+        assert band.threshold == pytest.approx(1e-9)
+        v = cv.convergence_report(run, list(run), band=band)
+        assert v.ok and v.first_flag_step is None
+        assert v.n_flagged == 0
+
+    def test_flags_at_the_right_step(self):
+        a = [1.0, 0.9, 0.8, 0.7, 0.6]
+        b = [1.0, 0.9, 0.8, 5.0, 9.0]
+        band = cv.Band(threshold=0.5, median_gap=0.0, mad_gap=0.0,
+                       z=6.0, n_pairs=1, n_steps=5, floor=0.5)
+        v = cv.convergence_report(a, b, band=band)
+        assert not v.ok
+        assert v.first_flag_step == 3 and v.n_flagged == 2
+        assert v.max_gap == pytest.approx(8.4)
+        assert v.max_gap_step == 4
+
+    def test_grace_exempts_warmup(self):
+        a = [9.0, 1.0, 1.0]
+        b = [1.0, 1.0, 1.0]
+        band = cv.Band(threshold=0.5, median_gap=0.0, mad_gap=0.0,
+                       z=6.0, n_pairs=1, n_steps=3, floor=0.5)
+        assert not cv.convergence_report(a, b, band=band).ok
+        assert cv.convergence_report(a, b, band=band, grace=1).ok
+
+    def test_nonfinite_compared_loss_always_flags(self):
+        a = [1.0, 1.0]
+        b = [1.0, float("inf")]
+        band = cv.Band(threshold=1e9, median_gap=0.0, mad_gap=0.0,
+                       z=6.0, n_pairs=1, n_steps=2, floor=1e9)
+        v = cv.convergence_report(a, b, band=band)
+        assert not v.ok and v.first_flag_step == 1
+        assert v.to_event()["max_gap"] is None   # inf nulled on wire
+
+    def test_inline_calibration_path(self):
+        a = [1.0, 0.5, 0.25]
+        v = cv.convergence_report(a, list(a),
+                                  calibration=[a, [1.01, 0.52, 0.26]])
+        assert v.ok
+        assert v.band.n_pairs == 1
+
+    def test_event_shape(self):
+        a = [1.0, 0.5]
+        v = cv.convergence_report(a, list(a), calibration=[a, a])
+        ev = v.to_event()
+        assert ev["kind"] == "convergence_verdict"
+        assert ev["verdict"] == "pass"
+        assert ev["fingerprint"] == "dynamics|convergence|loss"
+        assert v.fingerprint == "dynamics|convergence|loss"
+
+
+# --- the dynamics channel + schema --------------------------------------------
+
+def _lines(events):
+    return [json.dumps(e) for e in events]
+
+
+_DC_AGG = {"kind": "dynamics_check", "rank": 0, "step": 4,
+           "check_count": 2, "site": None, "n_sites": 2,
+           "eff_lr": 0.01, "uw_ratio": 0.001, "cos_min": 0.98,
+           "cos_mean": 0.99, "world": 8.0}
+_DC_SITE = {"kind": "dynamics_check", "rank": 0, "step": 4,
+            "check_count": 2, "site": "dynamics/update/['w']",
+            "n_sites": 2, "eff_lr": 0.01, "uw_ratio": None,
+            "cos_min": None, "cos_mean": None, "world": None}
+_GNS = {"kind": "gns", "rank": 0, "step": 4, "check_count": 2,
+        "gns": 35.4, "b_crit": 35.4, "local_sq": 102.4,
+        "pooled_sq": 21.8, "world": 8.0, "local_batch": 4,
+        "cos_min": 0.98, "cos_mean": 0.99,
+        "fingerprint": "dynamics|gns|global"}
+_CV = {"kind": "convergence_verdict", "rank": 0, "step": 20,
+       "verdict": "flag", "first_flag_step": 20, "n_flagged": 12,
+       "n_steps": 60, "max_gap": 0.4, "band_threshold": 0.005,
+       "band_z": 8.0, "fingerprint": "dynamics|convergence|loss"}
+
+
+class TestDynamicsSchema:
+    def _check(self, lines):
+        from scripts.check_metrics_schema import check_dynamics_lines
+        return check_dynamics_lines(lines)
+
+    def test_valid_stream(self):
+        assert self._check(_lines([_DC_AGG, _DC_SITE, _GNS,
+                                   _CV])) == []
+
+    def test_null_gns_by_contract(self):
+        ev = dict(_GNS, gns=None, b_crit=None, world=None,
+                  cos_min=None, cos_mean=None)
+        assert self._check(_lines([ev])) == []
+
+    def test_pass_verdict_null_flag_step(self):
+        ev = dict(_CV, verdict="pass", step=None,
+                  first_flag_step=None, n_flagged=0)
+        assert self._check(_lines([ev])) == []
+
+    # negative twins ----------------------------------------------------------
+
+    def test_unknown_kind_rejected(self):
+        errs = self._check(_lines([dict(_DC_AGG,
+                                        kind="dynamics_meow")]))
+        assert errs and "kind" in errs[0]
+
+    def test_cosine_out_of_range_rejected(self):
+        assert self._check(_lines([dict(_DC_AGG, cos_min=1.5)]))
+        assert self._check(_lines([dict(_GNS, cos_mean=-1.5)]))
+
+    def test_nonpositive_gns_rejected(self):
+        assert self._check(_lines([dict(_GNS, gns=-1.0)]))
+        assert self._check(_lines([dict(_GNS, b_crit=0.0)]))
+
+    def test_verdict_enum_rejected(self):
+        assert self._check(_lines([dict(_CV, verdict="maybe")]))
+
+    def test_pass_with_flag_step_rejected(self):
+        ev = dict(_CV, verdict="pass", n_flagged=0)
+        assert self._check(_lines([ev]))         # first_flag_step set
+
+    def test_flag_without_flag_step_rejected(self):
+        assert self._check(_lines([dict(_CV, first_flag_step=None)]))
+
+    def test_missing_fingerprint_rejected(self):
+        ev = dict(_GNS)
+        del ev["fingerprint"]
+        assert any("fingerprint" in e
+                   for e in self._check(_lines([ev])))
+
+    def test_overflagged_rejected(self):
+        assert self._check(_lines([dict(_CV, n_flagged=61)]))
+
+    # the wired channel -------------------------------------------------------
+
+    def test_channel_emission_validates(self):
+        buf = io.StringIO()
+        logger = monitor.MetricsLogger(
+            sinks=[], dynamics_sink=monitor.JSONLSink(buf))
+        cfg = dx.DynamicsConfig(local_batch=4)
+        ds = dx.dynamics_init(cfg, sites=("t",), world=4)
+        ds = dx.dynamics_observe(
+            ds, cfg, {"t": jnp.full((4,), 0.5, jnp.float32)},
+            grads={"t": jnp.ones((4,), jnp.float32)},
+            probe=_probe(2.0, 1.0, [2.0] * 4, [1.4] * 4))
+        for ev in dx.check_events(ds, ("t",), local_batch=4):
+            logger.record_dynamics(ev)
+        v = cv.convergence_report([1.0, 0.5], [1.0, 0.5],
+                                  calibration=[[1.0, 0.5], [1.0, 0.5]])
+        logger.record_dynamics(v.to_event())
+        logger.close()
+        lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+        assert self._check(lines) == []
+        kinds = {json.loads(l)["kind"] for l in lines}
+        assert kinds == {"dynamics_check", "gns",
+                         "convergence_verdict"}
+
+
+# --- the amp hook + opt-level parity sweep ------------------------------------
+
+class TestAmpDynamicsHook:
+    def _run(self, opt_level, observe, steps=6):
+        import optax
+        rng = np.random.RandomState(0)
+        params = {"w": jnp.asarray(rng.randn(16, 4).astype("float32")
+                                   * 0.1),
+                  "b": jnp.zeros((4,), jnp.float32)}
+        x = jnp.asarray(rng.randn(8, 16).astype("float32"))
+        y = jnp.asarray(rng.randn(8, 4).astype("float32"))
+        amp_opt, state = amp.initialize(params, optax.sgd(0.05),
+                                        opt_level, verbosity=0)
+
+        def loss_fn(mp, x, y):
+            return jnp.mean(jnp.square(x @ mp["w"] + mp["b"] - y))
+
+        dcfg = dx.DynamicsConfig(check_every=2)
+        ds = dx.dynamics_init(
+            dcfg, sites=amp_opt.dynamics_sites(state.params))
+
+        if observe:
+            @jax.jit
+            def step(state, ds, x, y):
+                state, loss, fin, ds = amp_opt.step(
+                    state, loss_fn, x, y, dynamics=(ds, dcfg))
+                return state, ds, loss
+        else:
+            @jax.jit
+            def step(state, ds, x, y):
+                state, loss, fin = amp_opt.step(state, loss_fn, x, y)
+                return state, ds, loss
+
+        losses = []
+        for _ in range(steps):
+            state, ds, loss = step(state, ds, x, y)
+            losses.append(np.asarray(loss).tobytes())
+        return losses, jax.device_get(state.params), ds
+
+    @pytest.mark.parametrize("opt_level", ["O0", "O1", "O2", "O3"])
+    def test_trajectory_bit_identical_observed_vs_not(self, opt_level):
+        l_obs, p_obs, ds = self._run(opt_level, observe=True)
+        l_ref, p_ref, _ = self._run(opt_level, observe=False)
+        assert l_obs == l_ref
+        for k in p_ref:
+            assert np.array_equal(np.asarray(p_obs[k]),
+                                  np.asarray(p_ref[k]))
+        assert int(ds.check_count) == 3          # steps 0, 2, 4
+
+    def test_observed_state_folds_companions(self):
+        _, _, ds = self._run("O2", observe=True)
+        rep = dx.dynamics_report(
+            ds, ("dynamics/update/['b']", "dynamics/update/['w']"))
+        assert all(v is not None and v > 0 for v in rep.eff_lr)
+        assert all(v is not None and v > 0 for v in rep.uw_ratio)
+
+    def test_dynamics_sites_naming(self):
+        import optax
+        params = {"w": jnp.ones((4, 2), jnp.float32),
+                  "b": jnp.zeros((2,), jnp.float32)}
+        amp_opt, _ = amp.initialize(params, optax.sgd(0.1), "O1",
+                                    verbosity=0)
+        assert amp_opt.dynamics_sites(params) == (
+            "dynamics/update/['b']", "dynamics/update/['w']")
+
+    def test_step_returns_grow_with_hooks(self):
+        import optax
+        from apex_tpu.monitor import numerics as nx
+        params = {"w": jnp.ones((4, 2), jnp.float32)}
+        amp_opt, state = amp.initialize(params, optax.sgd(0.1), "O2",
+                                        verbosity=0)
+        dcfg = dx.DynamicsConfig()
+        ds = dx.dynamics_init(dcfg,
+                              sites=amp_opt.dynamics_sites(params))
+
+        def lf(mp):
+            return jnp.mean(jnp.square(mp["w"]))
+
+        ret = amp_opt.step(state, lf, dynamics=(ds, dcfg))
+        assert len(ret) == 4 and isinstance(ret[3], dx.DynamicsState)
+        ncfg = nx.NumericsConfig()
+        ns = nx.numerics_init(ncfg,
+                              sites=amp_opt.numerics_sites(params))
+        ret = amp_opt.step(state, lf, numerics=(ns, ncfg),
+                           dynamics=(ds, dcfg))
+        # growth order: ... numerics, then dynamics LAST
+        assert len(ret) == 5
+        assert isinstance(ret[3], nx.NumericsState)
+        assert isinstance(ret[4], dx.DynamicsState)
+
+
+# --- the registry rows --------------------------------------------------------
+
+class TestRegistryPins:
+    def test_axis_attribution(self):
+        assert parallel.scope_axis("ddp/dynamics_gns") == \
+            parallel.DATA_AXIS
+        assert parallel.scope_axis("ddp/dynamics_geom") == \
+            parallel.DATA_AXIS
+
+    def test_subsystem_and_flat_patterns(self):
+        from apex_tpu.parallel.distributed import \
+            KNOWN_COLLECTIVE_SCOPES
+        for scope in ("ddp/dynamics_gns", "ddp/dynamics_geom"):
+            entry = parallel.scope_entry(scope)
+            assert entry is not None and entry.subsystem == "ddp"
+            assert any(__import__("re").search(p, scope)
+                       for p in KNOWN_COLLECTIVE_SCOPES)
+
+    def test_probe_emits_registered_scopes(self):
+        # the probe's spans carry exactly the registered names — a
+        # rename on either side would orphan the axis attribution
+        import inspect
+        from apex_tpu.parallel import distributed as dist
+        src = inspect.getsource(dist.dynamics_probe)
+        assert '"ddp/dynamics_gns"' in src
+        assert '"ddp/dynamics_geom"' in src
+
+
+# --- compile-check + sentinel columns -----------------------------------------
+
+class TestCompileCheck:
+    def test_dynamics_case_runs_green(self):
+        from apex_tpu.ops import compile_check as cc
+        assert cc.run(pattern="dynamics/no-extra-dispatch")
+
+
+class TestSentinelColumns:
+    def _baseline(self):
+        with open(os.path.join(REPO, "scripts",
+                               "perf_baseline.json")) as f:
+            return json.load(f)
+
+    def test_direction_aware_rows_declared(self):
+        rows = {m["name"]: m for m in self._baseline()["metrics"]}
+        assert rows["gns"]["direction"] == "lower"
+        assert rows["gns"]["path"] == ["extra", "gns"]
+        # a cosine DROP is the regression, so 'higher' is better
+        assert rows["grad_cosine_min"]["direction"] == "higher"
+        assert rows["grad_cosine_min"]["path"] == \
+            ["extra", "grad_cosine_min"]
+
+    def test_old_rounds_skip_with_note_not_join_failure(self, tmp_path):
+        from apex_tpu.prof import sentinel as sn
+        specs = sn.metric_specs_from_baseline(self._baseline())
+        names = {s.name for s in specs}
+        assert {"gns", "grad_cosine_min"} <= names
+        # an old committed round predating the columns: extraction
+        # simply omits them — no error, no fake zero
+        old = {"metric": "resnet", "value": 100.0,
+               "extra": {"batch": 32, "mfu": 0.3}}
+        assert "gns" not in sn.extract_metrics(old, specs)
+        p = tmp_path / "BENCH_r01.json"
+        p.write_text(json.dumps(old))
+        rows = sn.load_rows([str(p)], specs)
+        assert rows[0]["row"] is not None        # joined, not failed
+        assert "gns" not in rows[0]["metrics"]
+        # a new row judged against a column-less history: the verdict
+        # is an insufficient-history note, never a flag
+        spec = next(s for s in specs if s.name == "gns")
+        v = sn.check_row([], 40.0, spec)
+        assert not v.regressed and "insufficient history" in v.note

@@ -718,13 +718,13 @@ class TestNumericsSchema:
 class TestChannelRegistry:
     """The MetricsLogger registry refactor: every channel is one
     declarative row; numerics is the 10th, podview the 11th,
-    sharding the 12th."""
+    sharding the 12th, dynamics the 13th."""
 
-    def test_twelve_channels_sharding_last(self):
+    def test_thirteen_channels_dynamics_last(self):
         from apex_tpu import monitor
         names = [c.name for c in monitor.CHANNELS]
-        assert len(names) == 12 and names[-1] == "sharding"
-        assert names[-2] == "podview"
+        assert len(names) == 13 and names[-1] == "dynamics"
+        assert names[-2] == "sharding"
 
     def test_registry_kinds_match_schema_registry(self):
         from apex_tpu import monitor
