@@ -25,7 +25,18 @@ case pins that an observed step stays bit-identical):
   boundary that wanted a hierarchical schedule (APX203, judged against
   a declarative :mod:`mesh model <apex_tpu.lint.mesh_model>`), and
   nondeterministic draws that break guard's bitwise-rewind oracle
-  (APX204 — this one needs no mesh and runs in every ``lint_step``).
+  (APX204 — this one needs no mesh and runs in every ``lint_step``);
+- the **precision pass** (:mod:`apex_tpu.lint.precision_pass`) runs a
+  dtype-provenance abstract interpretation over the *same single
+  trace* the jaxpr pass reads: unscaled narrow casts (APX301),
+  double rounding (APX302), loss-scale taint leaking into committed
+  outputs (APX303), half-precision update arithmetic with no f32
+  master under an O2/O3 policy (APX304), half-accumulating
+  dots/reductions (APX305), and — given a committed
+  ``precision_report`` fixture — collective wire dtypes narrower than
+  the measured per-site verdicts (APX306, the static×measured join).
+  :func:`precision_preflight` inverts the join into the ranked
+  "statically castable ∩ measured-safe" site list that gates fp8/O4.
 
 Typical use — lint the step exactly as you run it (pass your jitted
 function so its ``donate_argnums`` are what gets audited)::
@@ -57,24 +68,36 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 from apex_tpu.lint.findings import (Finding, Report, Rule, RULES,
-                                    SEVERITIES, load_baseline,
+                                    SEVERITIES, DTYPE_NAMES,
+                                    PROVENANCES, load_baseline,
                                     save_baseline)
 from apex_tpu.lint.hlo_pass import lint_hlo_text
 from apex_tpu.lint.jaxpr_pass import lint_jaxpr
 from apex_tpu.lint.mesh_model import (MeshAxis, MeshModel,
                                       parse_mesh_spec)
+from apex_tpu.lint.precision_pass import (PrecisionAnalysis,
+                                          PreflightResult,
+                                          analyze_jaxpr as
+                                          precision_analysis,
+                                          precision_findings,
+                                          precision_preflight,
+                                          wire_dtype_findings)
 from apex_tpu.lint.spmd_pass import (congruence_findings,
                                      extract_collective_schedule,
                                      lint_spmd_text,
                                      nondeterminism_jaxpr_findings)
 
 __all__ = ["Finding", "Report", "Rule", "RULES", "SEVERITIES",
+           "DTYPE_NAMES", "PROVENANCES",
            "lint_step", "lint_jaxpr", "lint_hlo_text", "lint_hlo_file",
            "load_baseline", "save_baseline",
            "MeshAxis", "MeshModel", "parse_mesh_spec",
            "lint_spmd_text", "congruence_findings",
            "extract_collective_schedule",
-           "nondeterminism_jaxpr_findings"]
+           "nondeterminism_jaxpr_findings",
+           "PrecisionAnalysis", "PreflightResult",
+           "precision_analysis", "precision_findings",
+           "precision_preflight", "wire_dtype_findings"]
 
 #: jaxpr-pass rule slugs (trace-only); nondeterminism's jaxpr-side
 #: detectors ride the same single trace
@@ -85,6 +108,13 @@ _HLO_RULES = frozenset({"donation-miss", "implicit-resharding",
                         "host-transfer", "tile-padding"})
 _SPMD_HLO_RULES = frozenset({"spmd-divergence", "implicit-full-gather",
                              "dcn-flat-collective"})
+#: precision-pass rule slugs; the first five are trace-only and ride
+#: the shared jaxpr, APX306 additionally needs the compiled HLO's
+#: collective schedule plus a measured precision_report (precision=)
+_PRECISION_RULES = frozenset({"unscaled-narrow-cast", "double-rounding",
+                              "scale-leak", "master-weight-violation",
+                              "half-accumulation"})
+_WIRE_RULE = "wire-dtype-unsafe"
 
 
 def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
@@ -92,7 +122,7 @@ def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
               min_donation_bytes: int = 4096,
               rules: Optional[Sequence[str]] = None,
               mesh_model: Optional[MeshModel] = None,
-              per_rank_hlo=None,
+              per_rank_hlo=None, precision=None, jaxpr=None,
               fn_name: Optional[str] = None, **kwargs) -> Report:
     """Lint one training step with all passes. Strictly AOT.
 
@@ -114,23 +144,46 @@ def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
     DCN-crossing flat collectives (APX203). ``per_rank_hlo`` (a
     ``{rank: hlo_text}`` dict) feeds per-rank-compiled programs to the
     congruence walk instead of the single SPMD module.
+
+    ``precision`` controls the precision pass: the default ``None``
+    runs the trace-side rules (APX301–305) on the shared jaxpr;
+    ``False`` disables the pass; a measured ``precision_report`` — a
+    ``NumericsReport``, or the stats dict / ``stats_to_json`` fixture
+    it is built from — additionally activates APX306, joining the
+    compiled module's collective wire dtypes against the per-site
+    verdicts. ``jaxpr=`` accepts an already-made trace so external
+    callers (bench, the CLI's preflight) share it; all jaxpr-side
+    passes here always share ONE trace either way.
     """
     import jax
 
     findings = []
-    jaxpr = None
-    if fn is not None and (rules is None or _JAXPR_RULES & set(rules)):
-        # skip the (potentially expensive) trace entirely when the
-        # caller selected HLO-pass rules only — with compiled= that
-        # makes lint_step compile-free AND trace-free
+    rule_set = None if rules is None else set(rules)
+    want_jaxpr_pass = rule_set is None or bool(_JAXPR_RULES & rule_set)
+    want_precision = (precision is not False
+                      and (rule_set is None
+                           or bool(_PRECISION_RULES & rule_set)
+                           or _WIRE_RULE in rule_set))
+    if (jaxpr is None and fn is not None
+            and (want_jaxpr_pass or want_precision)):
+        # ONE trace shared by the jaxpr pass, APX204's detectors, and
+        # the precision pass — and skipped entirely when the caller
+        # selected HLO-pass rules only (with compiled= that makes
+        # lint_step compile-free AND trace-free)
         jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    if jaxpr is not None and want_jaxpr_pass:
         findings += lint_jaxpr(jaxpr, policy=policy)
-        if rules is None or "nondeterminism" in set(rules):
+        if rule_set is None or "nondeterminism" in rule_set:
             findings += nondeterminism_jaxpr_findings(jaxpr)
+    if jaxpr is not None and want_precision:
+        findings += precision_findings(jaxpr, policy=policy)
+    want_wire = (want_precision and precision is not None
+                 and precision is not False
+                 and (rule_set is None or _WIRE_RULE in rule_set))
     want_spmd = (mesh_model is not None or per_rank_hlo is not None
                  ) and (rules is None or _SPMD_HLO_RULES & set(rules))
     if hlo_text is None and (rules is None or _HLO_RULES & set(rules)
-                             or want_spmd):
+                             or want_spmd or want_wire):
         # same economy as the trace skip above: no XLA compile when the
         # caller selected jaxpr-pass rules only
         if compiled is not None:
@@ -147,6 +200,10 @@ def lint_step(fn, *args, policy=None, compiled=None, hlo_text=None,
             per_rank_hlo if per_rank_hlo is not None else hlo_text,
             mesh_model=mesh_model, known_scopes=known_scopes,
             rules=rules))
+    if want_wire and hlo_text:
+        findings += wire_dtype_findings(
+            extract_collective_schedule(hlo_text), precision,
+            extra_scopes=known_scopes)
     if rules is not None:
         findings = [f for f in findings if f.rule in set(rules)]
     if fn_name is None and fn is not None:

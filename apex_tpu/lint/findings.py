@@ -26,10 +26,21 @@ import json
 from typing import Dict, Iterable, List, Optional, Sequence
 
 __all__ = ["Finding", "Report", "Rule", "RULES", "SEVERITIES",
+           "DTYPE_NAMES", "PROVENANCES",
            "load_baseline", "save_baseline"]
 
 #: severity names, most severe first (index = sort key)
 SEVERITIES = ("error", "warning", "info")
+
+#: dtype evidence vocabulary for the precision pass (APX3xx) —
+#: the numerics FORMAT_LADDER names plus fp64 (f64-creep territory,
+#: but a cast chain can still pass through it)
+DTYPE_NAMES = ("fp8_e4m3", "fp8_e5m2", "fp16", "bf16", "fp32", "fp64")
+
+#: the scale-provenance lattice the precision pass propagates
+#: (docs/linting.md#apx3xx)
+PROVENANCES = ("unscaled", "loss-scaled", "site-scaled",
+               "unscaled-after-narrow")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -117,6 +128,48 @@ RULES: Dict[str, Rule] = {r.slug: r for r in (
          "thread PRNG state through the carried step state; keep host "
          "callback results off the commit path; scatter with "
          "unique_indices=True where the indices allow"),
+    # precision pass (dtype-provenance dataflow over the same trace)
+    Rule("APX301", "unscaled-narrow-cast", "error",
+         "a convert_element_type narrows to fp8/fp16 without a "
+         "dominating scale multiply — small magnitudes flush to zero "
+         "and large ones saturate; the cast O4 must never emit",
+         "multiply by a per-site scale (ScaleHistory / "
+         "precision_report's recommended_scale) immediately before "
+         "the cast, or widen the target dtype"),
+    Rule("APX302", "double-rounding", "warning",
+         "chained narrowing casts (f32 -> bf16 -> fp8) round the "
+         "mantissa twice — one scaled cast from the wide value loses "
+         "strictly less",
+         "cast once from the widest live value (keep the f32 source "
+         "and emit a single scaled narrow cast)"),
+    Rule("APX303", "scale-leak", "error",
+         "loss-scaled gradient taint reaches a committed (non-scalar) "
+         "output without an unscale on every path — the update is "
+         "silently multiplied by the loss scale",
+         "unscale_grads before the optimizer / param-delta add "
+         "(amp.Amp.backward does this; divide by the scale on every "
+         "path that commits)"),
+    Rule("APX304", "master-weight-violation", "error",
+         "update arithmetic runs entirely in the half dtype on a "
+         "half-precision carried param under a master-weights policy "
+         "— small updates are lost to rounding against the f32 master "
+         "contract",
+         "keep the committed params in f32 (amp.Amp.init builds the "
+         "masters; apply updates to the f32 copy and re-cast)"),
+    Rule("APX305", "half-accumulation", "warning",
+         "a dot/conv or sum/psum accumulates in fp16/fp8 (bf16 for "
+         "reductions) without a widened accumulator — long "
+         "accumulation chains lose low-order bits",
+         "pass preferred_element_type=jnp.float32 to the dot/conv, or "
+         "upcast the reduction operand to f32"),
+    Rule("APX306", "wire-dtype-unsafe", "error",
+         "a collective's wire dtype is narrower than the measured "
+         "per-site precision_report verdict for its subsystem — the "
+         "reduction quantizes below the measured safe format",
+         "widen the collective dtype, or apply the verdict's "
+         "recommended scale before the reduction (EQuARX-style "
+         "scaled quantization); int8 error-feedback compression is "
+         "exempt by design"),
 )}
 
 _RULES_BY_ID = {r.id: r for r in RULES.values()}
@@ -140,6 +193,12 @@ class Finding:
     axes: Optional[List[str]] = None   # mesh axes the groups span
     ranks: Optional[List[int]] = None  # the diverging rank pair
     hop: Optional[str] = None          # link class: "ici" | "dcn"
+    # precision evidence (the APX3xx pass; None elsewhere — excluded
+    # from fingerprints like the SPMD fields, so a baselined finding
+    # survives a dtype-pair drift)
+    dtype_from: Optional[str] = None   # source dtype (DTYPE_NAMES)
+    dtype_to: Optional[str] = None     # target/required dtype
+    scale_provenance: Optional[str] = None  # PROVENANCES entry
 
     def __post_init__(self):
         if self.rule not in RULES:
@@ -156,6 +215,15 @@ class Finding:
             self.axes = [str(a) for a in self.axes]
         if self.ranks is not None:
             self.ranks = [int(r) for r in self.ranks]
+        for dt in (self.dtype_from, self.dtype_to):
+            if dt is not None and dt not in DTYPE_NAMES:
+                raise ValueError(f"unknown dtype name {dt!r} "
+                                 f"(expected one of {DTYPE_NAMES})")
+        if (self.scale_provenance is not None
+                and self.scale_provenance not in PROVENANCES):
+            raise ValueError(
+                f"unknown scale provenance {self.scale_provenance!r} "
+                f"(expected one of {PROVENANCES})")
 
     @property
     def id(self) -> str:
@@ -175,7 +243,9 @@ class Finding:
                 "fix": self.fix, "op": self.op, "scope": self.scope,
                 "bytes": self.bytes, "count": self.count, "fn": fn,
                 "step": step, "axes": self.axes, "ranks": self.ranks,
-                "hop": self.hop}
+                "hop": self.hop, "dtype_from": self.dtype_from,
+                "dtype_to": self.dtype_to,
+                "scale_provenance": self.scale_provenance}
 
 
 def _fmt_bytes(n: Optional[float]) -> str:

@@ -1,0 +1,906 @@
+"""The precision half of apexlint: a dtype-provenance dataflow pass.
+
+Abstract interpretation over ``jax.make_jaxpr`` output (sharing the
+single trace :func:`apex_tpu.lint.lint_step` already makes for the
+jaxpr pass and APX204) that propagates, per jaxpr var, an abstract
+value::
+
+    (dtype, scale-provenance, rounding-depth)
+
+- **dtype** is the var's float format on the numerics ladder
+  (``fp8_e4m3 < fp8_e5m2 < fp16 < bf16 < fp32 < fp64``; None for
+  non-floats, which also drops every provenance bit — a finiteness
+  predicate is not a scaled value).
+- **scale-provenance** tracks two independent facts: whether the value
+  is *dominated by a scale multiply* (``x * s`` with a scalar ``s`` —
+  the site-scaled shape O4's scaled casts and ``ScaleHistory`` emit),
+  and which *loss-scale tokens* taint it. A token is minted at the
+  ``scale_loss`` signature — a scalar carried input multiplying a
+  computed scalar (the loss) — and is cancelled by a multiply with
+  that token's reciprocal (``g * (1/s)``, the ``unscale_grads``
+  shape) or a divide by the token's source. Taint joins as union, so
+  a select between a scaled and an unscaled path stays tainted: the
+  unscale must happen on **every** path.
+- **rounding-depth** counts chained narrowing casts (reset by
+  arithmetic — a sum of rounded values is a new quantity; preserved
+  through transposes/reshapes/converts).
+
+Rules (docs/linting.md#apx3xx):
+
+- **APX301** unscaled-narrow-cast: a ``convert_element_type`` to fp8
+  whose operand is neither site-scaled nor loss-scale-tainted (to
+  fp16: only when no loss-scaling policy protects the program —
+  warning). bf16 keeps the f32 exponent range and is exempt.
+- **APX302** double-rounding: a narrowing cast whose operand was
+  already narrowed and whose target is narrower than every format the
+  value passed through (f32→bf16→fp8); bf16→f32→bf16 round-trips are
+  exempt (nothing new is destroyed).
+- **APX303** scale-leak: loss-scale taint reaching a non-scalar
+  program output (the committed params / optimizer state). Scalar
+  outputs are exempt — the scaler's own state update legitimately
+  derives from the scale.
+- **APX304** master-weight violation: an add/sub in the half dtype
+  whose operand chain reaches a same-shaped half-dtype carried input
+  and whose result is committed, under a policy that promises f32
+  masters (``master_weights=True`` → error; O3-style pure-half
+  policies → info, the by-design advisory).
+- **APX305** half-accumulation: a dot/conv with fp16/fp8 operands and
+  no widened accumulator (``preferred_element_type``), or a
+  sum/psum/cumsum reducing half operands directly (bf16 reductions
+  are info — the MXU widens bf16 *dots* in hardware, but a plain
+  ``reduce_sum`` does accumulate in bf16).
+- **APX306** wire-dtype-unsafe (:func:`wire_dtype_findings`): the
+  static × measured join — a reduction collective's wire dtype,
+  attributed to a subsystem via :mod:`apex_tpu.parallel.registry`,
+  narrower than the matching per-site ``precision_report`` verdicts
+  in a committed fixture. Non-float wires are exempt (the int8
+  hierarchical sync carries error feedback by design).
+
+:func:`precision_preflight` joins the two worlds the other way round:
+the fixture's measured fp8-safe sites filtered by the program's static
+verdict — the ranked "statically castable ∩ measured-safe" list that
+is the fp8/O4 pre-flight (``mesh_explain``-style table via
+``PreflightResult.table()``).
+
+Everything here is strictly AOT — a pure walk over an already-made
+jaxpr (and, for APX306, already-compiled HLO text); the
+``lint/precision-no-extra-dispatch`` compile-check case pins that the
+pass leaves compiled programs bit-identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.lint.findings import Finding
+from apex_tpu.lint.jaxpr_pass import (_closed_to_jaxpr, _is_literal,
+                                      _np_dtype, _sub_jaxprs,
+                                      MATMUL_PRIMS)
+
+__all__ = ["precision_findings", "analyze_jaxpr", "PrecisionAnalysis",
+           "wire_dtype_findings", "precision_preflight",
+           "PreflightResult", "LADDER", "MANTISSA_BITS"]
+
+#: narrow → wide; numerics.FORMAT_LADDER plus fp64
+LADDER: Tuple[str, ...] = ("fp8_e4m3", "fp8_e5m2", "fp16", "bf16",
+                           "fp32", "fp64")
+_RANK = {name: i for i, name in enumerate(LADDER)}
+MANTISSA_BITS = {"fp8_e4m3": 3, "fp8_e5m2": 2, "fp16": 10, "bf16": 7,
+                 "fp32": 23, "fp64": 52}
+_FP8 = ("fp8_e4m3", "fp8_e5m2")
+
+#: numpy dtype name → ladder name (fp8 dtypes via jax.numpy; their
+#: numpy names are ml_dtypes')
+_NP_TO_LADDER = {"float8_e4m3fn": "fp8_e4m3", "float8_e4m3": "fp8_e4m3",
+                 "float8_e5m2": "fp8_e5m2", "float16": "fp16",
+                 "bfloat16": "bf16", "float32": "fp32",
+                 "float64": "fp64"}
+#: HLO dtype token → ladder name (the SPMD schedule's wire dtypes)
+_HLO_TO_LADDER = {"f8e4m3fn": "fp8_e4m3", "f8e4m3": "fp8_e4m3",
+                  "f8e5m2": "fp8_e5m2", "f16": "fp16", "bf16": "bf16",
+                  "f32": "fp32", "f64": "fp64"}
+
+#: ops that carry a value through unchanged (modulo layout): every
+#: provenance bit survives them
+_PRESERVING = frozenset({
+    "convert_element_type", "reshape", "transpose", "broadcast_in_dim",
+    "squeeze", "slice", "dynamic_slice", "rev", "copy", "neg",
+    "stop_gradient", "expand_dims", "reduce_precision", "optimization_barrier",
+})
+#: reductions that ACCUMULATE (max/min/and/or don't lose mantissa)
+_SUM_REDUCTIONS = frozenset({"reduce_sum", "cumsum", "reduce_window_sum",
+                             "psum", "add_any"})
+_REDUCTION_COLLECTIVES = ("all-reduce", "reduce-scatter")
+
+
+def _fmt_of_aval(aval) -> Optional[str]:
+    dt = _np_dtype(getattr(aval, "dtype", None))
+    if dt is None:
+        return None
+    return _NP_TO_LADDER.get(dt.name)
+
+
+def _is_scalar(aval) -> bool:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return False
+    return int(np.prod(shape, dtype=np.int64)) == 1 if shape else True
+
+
+def hlo_dtype_format(tok: str) -> Optional[str]:
+    """Ladder name of an HLO dtype token, None for non-floats."""
+    return _HLO_TO_LADDER.get(str(tok).lower())
+
+
+@dataclasses.dataclass
+class _AbsVal:
+    """Per-var abstract value: dtype + scale provenance + rounding."""
+
+    fmt: Optional[str] = None       # ladder name, None = non-float
+    taint: frozenset = frozenset()  # live loss-scale tokens
+    inv_of: frozenset = frozenset()  # tokens this value is 1/s of
+    scale_src: Optional[int] = None  # token if this IS a scale scalar
+    site_scaled: bool = False       # dominated by a scale multiply
+    depth: int = 0                  # chained-narrowing-cast count
+    min_mant: int = 52              # narrowest mantissa passed through
+    carry_shape: Optional[Tuple[int, ...]] = None  # half carried input
+    upd_candidate: bool = False     # half update add on a half carry
+
+    def drop_if_nonfloat(self) -> "_AbsVal":
+        if self.fmt is None:
+            return _AbsVal(fmt=None)
+        return self
+
+
+def _join(a: _AbsVal, b: _AbsVal) -> _AbsVal:
+    """Path join (cond branches / scan fixpoint): taint is a union —
+    an unscale must happen on every path."""
+    return _AbsVal(
+        fmt=a.fmt if a.fmt == b.fmt else (a.fmt or b.fmt),
+        taint=a.taint | b.taint,
+        inv_of=a.inv_of & b.inv_of,
+        scale_src=a.scale_src if a.scale_src == b.scale_src else None,
+        site_scaled=a.site_scaled and b.site_scaled,
+        depth=max(a.depth, b.depth),
+        min_mant=min(a.min_mant, b.min_mant),
+        carry_shape=(a.carry_shape
+                     if a.carry_shape == b.carry_shape else None),
+        upd_candidate=a.upd_candidate or b.upd_candidate)
+
+
+def _same(a: _AbsVal, b: _AbsVal) -> bool:
+    return (a.taint == b.taint and a.site_scaled == b.site_scaled
+            and a.depth == b.depth and a.min_mant == b.min_mant
+            and a.upd_candidate == b.upd_candidate)
+
+
+@dataclasses.dataclass
+class PrecisionAnalysis:
+    """Result of one precision-pass run over a jaxpr."""
+
+    findings: List[Finding]
+    n_cast_sites: int = 0        # float→float convert_element_type eqns
+    n_matmul_sites: int = 0      # dot_general / conv eqns
+    n_reduction_sites: int = 0   # accumulating reductions / psums
+
+    @property
+    def n_sites(self) -> int:
+        return (self.n_cast_sites + self.n_matmul_sites
+                + self.n_reduction_sites)
+
+
+class _Interp:
+    """The abstract interpreter. One instance per analyze_jaxpr call."""
+
+    def __init__(self, policy=None):
+        self.policy = policy
+        self.findings: List[Finding] = []
+        self._seen = set()          # (rule, id(eqn), path) dedup
+        self._next_token = 0
+        self._active = set()        # tokens minted at a scale_loss mul
+        self.n_cast_sites = 0
+        self.n_matmul_sites = 0
+        self.n_reduction_sites = 0
+        # policy facts the rules key on
+        self.uses_loss_scaling = bool(
+            getattr(policy, "uses_loss_scaling", False))
+        enabled = bool(getattr(policy, "enabled", False))
+        self.master_weights = enabled and bool(
+            getattr(policy, "master_weights", False))
+        self.pure_half = (enabled and not self.master_weights
+                          and getattr(policy, "cast_model_type", None)
+                          is not None)
+        self.apx304_active = self.master_weights or self.pure_half
+
+    # -- finding emission -----------------------------------------------------
+
+    def _emit(self, eqn, path, emit: bool, **kw) -> None:
+        if not emit:
+            return
+        key = (kw.get("rule"), id(eqn), path)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(**kw))
+
+    # -- abstract eval --------------------------------------------------------
+
+    def run(self, jaxpr, emit: bool = True) -> None:
+        jaxpr = _closed_to_jaxpr(jaxpr)
+        env: Dict = {}
+        for v in jaxpr.invars + jaxpr.constvars:
+            val = _AbsVal(fmt=_fmt_of_aval(getattr(v, "aval", None)))
+            if val.fmt is not None:
+                if _is_scalar(v.aval):
+                    # a scalar carried input is a scale *candidate*:
+                    # a token is minted only if it multiplies the loss
+                    val.scale_src = self._next_token
+                    self._next_token += 1
+                elif (self.apx304_active and val.fmt in
+                      ("fp16", "bf16") + _FP8):
+                    # half-dtype carried input: the APX304 source
+                    val.carry_shape = tuple(
+                        getattr(v.aval, "shape", ()) or ())
+            env[v] = val
+        self._eval_jaxpr(jaxpr, env, (), emit)
+        # -- APX303 / APX304: what reaches the committed outputs
+        for i, v in enumerate(jaxpr.outvars):
+            if _is_literal(v) or v not in env:
+                continue
+            val = env[v]
+            if val.fmt is None:
+                continue
+            scalar = _is_scalar(getattr(v, "aval", None))
+            if val.taint and not scalar:
+                self._emit(
+                    v, ("outputs",), emit, rule="scale-leak",
+                    message=(f"loss-scaled taint reaches committed "
+                             f"output #{i} ({val.fmt}) — no unscale "
+                             "on at least one path"),
+                    op="output", scope=f"outputs[{i}]",
+                    dtype_from=val.fmt if val.fmt in LADDER else None,
+                    scale_provenance="loss-scaled")
+            if val.upd_candidate and not scalar:
+                sev = "error" if self.master_weights else "info"
+                self._emit(
+                    v, ("outputs", "apx304"), emit,
+                    rule="master-weight-violation", severity=sev,
+                    message=(f"committed output #{i} is a {val.fmt} "
+                             "update of a same-shaped half carried "
+                             "input — no f32 master in the chain"
+                             + ("" if self.master_weights else
+                                " (pure-half policy: by design)")),
+                    op="output", scope=f"outputs[{i}]",
+                    dtype_from=val.fmt if val.fmt in LADDER else None,
+                    dtype_to="fp32",
+                    scale_provenance=None)
+
+    def _read(self, env, v) -> _AbsVal:
+        if _is_literal(v):
+            return _AbsVal(fmt=_fmt_of_aval(getattr(v, "aval", None)))
+        return env.get(v) or _AbsVal(
+            fmt=_fmt_of_aval(getattr(v, "aval", None)))
+
+    def _eval_jaxpr(self, jaxpr, env: Dict, path: Tuple[str, ...],
+                    emit: bool) -> None:
+        jaxpr = _closed_to_jaxpr(jaxpr)
+        for eqn in jaxpr.eqns:
+            self._eval_eqn(eqn, env, path, emit)
+
+    # -- sub-jaxpr plumbing ---------------------------------------------------
+
+    def _call_sub(self, sub, in_vals: Sequence[_AbsVal],
+                  path: Tuple[str, ...], emit: bool) -> List[_AbsVal]:
+        """Evaluate a sub-jaxpr with the caller's abstract values bound
+        to its invars; returns the body's outvar values."""
+        closed_consts = getattr(sub, "consts", None)
+        sub = _closed_to_jaxpr(sub)
+        env: Dict = {}
+        for cv in sub.constvars:
+            env[cv] = _AbsVal(fmt=_fmt_of_aval(getattr(cv, "aval",
+                                                       None)))
+        n = min(len(sub.invars), len(in_vals))
+        for v, val in zip(sub.invars[:n], in_vals[:n]):
+            env[v] = val
+        for v in sub.invars[n:]:
+            env[v] = _AbsVal(fmt=_fmt_of_aval(getattr(v, "aval", None)))
+        self._eval_jaxpr(sub, env, path, emit)
+        return [self._read(env, v) for v in sub.outvars]
+
+    def _sub_path(self, eqn, path):
+        name = eqn.params.get("name")
+        return path + ((str(name),) if name else (eqn.primitive.name,))
+
+    # -- the transfer function ------------------------------------------------
+
+    def _eval_eqn(self, eqn, env: Dict, path: Tuple[str, ...],
+                  emit: bool) -> None:
+        prim = eqn.primitive.name
+        ins = [self._read(env, v) for v in eqn.invars]
+
+        # ---- structured control flow / calls
+        if prim == "scan":
+            self._eval_scan(eqn, env, ins, path, emit)
+            return
+        if prim == "while":
+            self._eval_while(eqn, env, ins, path, emit)
+            return
+        if prim == "cond":
+            self._eval_cond(eqn, env, ins, path, emit)
+            return
+        subs = list(_sub_jaxprs(eqn))
+        if subs:
+            sub_path = self._sub_path(eqn, path)
+            if len(subs) == 1 and len(_closed_to_jaxpr(
+                    subs[0]).invars) == len(eqn.invars):
+                outs = self._call_sub(subs[0], ins, sub_path, emit)
+                for v, val in zip(eqn.outvars,
+                                  outs + [None] * len(eqn.outvars)):
+                    env[v] = (val or _AbsVal(fmt=_fmt_of_aval(
+                        getattr(v, "aval", None)))).drop_if_nonfloat()
+                return
+            # opaque multi-jaxpr call (custom_vjp with bwd, pallas):
+            # walk every body for rule hits; outputs get the
+            # conservative union taint so leaks flow through
+            for sub in subs:
+                body = _closed_to_jaxpr(sub)
+                benv: Dict = {}
+                bn = min(len(body.invars), len(ins))
+                for v, val in zip(body.invars[:bn], ins[:bn]):
+                    benv[v] = val
+                for v in list(body.invars[bn:]) + list(body.constvars):
+                    benv[v] = _AbsVal(fmt=_fmt_of_aval(
+                        getattr(v, "aval", None)))
+                self._eval_jaxpr(body, benv, sub_path, emit)
+            taint = frozenset().union(*(i.taint for i in ins)) \
+                if ins else frozenset()
+            for v in eqn.outvars:
+                env[v] = _AbsVal(
+                    fmt=_fmt_of_aval(getattr(v, "aval", None)),
+                    taint=taint).drop_if_nonfloat()
+            return
+
+        # ---- leaf primitives
+        out = self._leaf(eqn, prim, ins, path, emit)
+        for v in eqn.outvars:
+            o = dataclasses.replace(
+                out, fmt=_fmt_of_aval(getattr(v, "aval", None)))
+            env[v] = o.drop_if_nonfloat()
+
+    def _eval_scan(self, eqn, env, ins, path, emit):
+        sub = eqn.params.get("jaxpr")
+        if sub is None:
+            self._default_out(eqn, env, ins)
+            return
+        n_consts = int(eqn.params.get("num_consts", 0))
+        n_carry = int(eqn.params.get("num_carry", 0))
+        sub_path = self._sub_path(eqn, path)
+        carry = list(ins[n_consts:n_consts + n_carry])
+        # fixpoint over the carry: taint grows monotonically
+        for it in range(4):
+            outs = self._call_sub(
+                sub, list(ins[:n_consts]) + carry
+                + list(ins[n_consts + n_carry:]), sub_path, emit=False)
+            new_carry = [_join(c, o) for c, o in
+                         zip(carry, outs[:n_carry])]
+            if all(_same(c, n) for c, n in zip(carry, new_carry)):
+                break
+            carry = new_carry
+        outs = self._call_sub(
+            sub, list(ins[:n_consts]) + carry
+            + list(ins[n_consts + n_carry:]), sub_path, emit)
+        for v, val in zip(eqn.outvars,
+                          outs + [None] * len(eqn.outvars)):
+            env[v] = (val or _AbsVal(fmt=_fmt_of_aval(
+                getattr(v, "aval", None)))).drop_if_nonfloat()
+
+    def _eval_while(self, eqn, env, ins, path, emit):
+        cond = eqn.params.get("cond_jaxpr")
+        body = eqn.params.get("body_jaxpr")
+        cn = int(eqn.params.get("cond_nconsts", 0))
+        bn = int(eqn.params.get("body_nconsts", 0))
+        sub_path = self._sub_path(eqn, path)
+        carry = list(ins[cn + bn:])
+        body_consts = list(ins[cn:cn + bn])
+        for it in range(4):
+            if body is None:
+                break
+            outs = self._call_sub(body, body_consts + carry, sub_path,
+                                  emit=False)
+            new_carry = [_join(c, o) for c, o in zip(carry, outs)]
+            if all(_same(c, n) for c, n in zip(carry, new_carry)):
+                break
+            carry = new_carry
+        if cond is not None:
+            self._call_sub(cond, list(ins[:cn]) + carry, sub_path,
+                           emit)
+        if body is not None:
+            outs = self._call_sub(body, body_consts + carry, sub_path,
+                                  emit)
+            carry = [_join(c, o) for c, o in zip(carry, outs)]
+        for v, val in zip(eqn.outvars,
+                          carry + [None] * len(eqn.outvars)):
+            env[v] = (val or _AbsVal(fmt=_fmt_of_aval(
+                getattr(v, "aval", None)))).drop_if_nonfloat()
+
+    def _eval_cond(self, eqn, env, ins, path, emit):
+        branches = eqn.params.get("branches") or ()
+        sub_path = self._sub_path(eqn, path)
+        operands = ins[1:]
+        joined: Optional[List[_AbsVal]] = None
+        for br in branches:
+            outs = self._call_sub(br, operands, sub_path, emit)
+            joined = outs if joined is None else [
+                _join(a, b) for a, b in zip(joined, outs)]
+        joined = joined or []
+        for v, val in zip(eqn.outvars,
+                          joined + [None] * len(eqn.outvars)):
+            env[v] = (val or _AbsVal(fmt=_fmt_of_aval(
+                getattr(v, "aval", None)))).drop_if_nonfloat()
+
+    def _default_out(self, eqn, env, ins):
+        taint = frozenset().union(*(i.taint for i in ins)) \
+            if ins else frozenset()
+        for v in eqn.outvars:
+            env[v] = _AbsVal(
+                fmt=_fmt_of_aval(getattr(v, "aval", None)),
+                taint=taint).drop_if_nonfloat()
+
+    # -- leaf transfer --------------------------------------------------------
+
+    def _leaf(self, eqn, prim, ins: List[_AbsVal], path, emit) -> _AbsVal:
+        union_taint = frozenset().union(*(i.taint for i in ins)) \
+            if ins else frozenset()
+
+        if prim == "convert_element_type":
+            return self._convert(eqn, ins[0] if ins else _AbsVal(),
+                                 path, emit)
+
+        if prim in _PRESERVING:
+            src = ins[0] if ins else _AbsVal()
+            out = dataclasses.replace(src)
+            # shape-changing preservers drop the carried-input shape
+            # (scale_src survives a broadcast: a broadcast scalar
+            # scale is still the scale, pointwise)
+            out_aval = getattr(eqn.outvars[0], "aval", None) \
+                if eqn.outvars else None
+            if (src.carry_shape is not None and out_aval is not None
+                    and tuple(getattr(out_aval, "shape", ()) or ())
+                    != src.carry_shape):
+                out.carry_shape = None
+            return out
+
+        if prim in ("mul", "div"):
+            return self._mul_div(eqn, prim, ins, path, emit)
+
+        if prim in ("add", "sub"):
+            return self._add_sub(eqn, ins, path, emit)
+
+        if prim == "select_n":
+            # predicate (operand 0) is control, not a scaled value
+            cases = ins[1:] or [_AbsVal()]
+            out = cases[0]
+            for c in cases[1:]:
+                out = _join(out, c)
+            return out
+
+        if prim == "clamp":
+            # clamp(lo, x, hi): a clamped scaled value is still scaled
+            # (the scaled-cast recipe saturates before narrowing)
+            mid = ins[1] if len(ins) >= 2 else _AbsVal()
+            return dataclasses.replace(mid, scale_src=None)
+
+        if prim in ("max", "min"):
+            arr = [i for i in ins if i.fmt is not None]
+            if len(ins) == 2 and any(
+                    _is_literal(v) or _is_scalar(getattr(v, "aval",
+                                                         None))
+                    for v in eqn.invars):
+                keep = ins[0] if (_is_literal(eqn.invars[1]) or
+                                  _is_scalar(getattr(eqn.invars[1],
+                                                     "aval", None))) \
+                    else ins[1]
+                return dataclasses.replace(keep, scale_src=None)
+            out = arr[0] if arr else _AbsVal()
+            for c in arr[1:]:
+                out = _join(out, c)
+            return dataclasses.replace(out, site_scaled=False,
+                                       scale_src=None)
+
+        if prim in MATMUL_PRIMS:
+            self.n_matmul_sites += 1
+            self._check_matmul(eqn, ins, path, emit)
+            return _AbsVal(taint=union_taint)
+
+        if prim in _SUM_REDUCTIONS:
+            self.n_reduction_sites += 1
+            self._check_reduction(eqn, prim, ins, path, emit)
+            return _AbsVal(taint=union_taint)
+
+        # generic: taint flows through, domination/rounding reset
+        return _AbsVal(taint=union_taint)
+
+    def _convert(self, eqn, src: _AbsVal, path, emit) -> _AbsVal:
+        dst_fmt = _fmt_of_aval(getattr(eqn.outvars[0], "aval", None)) \
+            if eqn.outvars else None
+        out = dataclasses.replace(src)
+        if src.fmt is None or dst_fmt is None:
+            # int→float / float→int: fresh value
+            return _AbsVal(fmt=dst_fmt)
+        self.n_cast_sites += 1
+        src_m = MANTISSA_BITS.get(src.fmt, 52)
+        dst_m = MANTISSA_BITS.get(dst_fmt, 52)
+        scope = "/".join(path) or None
+        if dst_m < src_m:                      # narrowing
+            provenance = ("loss-scaled" if src.taint else
+                          "site-scaled" if src.site_scaled else
+                          "unscaled-after-narrow" if src.depth else
+                          "unscaled")
+            if src.depth >= 1 and dst_m < src.min_mant:
+                self._emit(
+                    eqn, path, emit, rule="double-rounding",
+                    message=(f"{src.fmt}→{dst_fmt} narrows a value "
+                             f"already rounded {src.depth}x (narrowest "
+                             f"format seen: {src.min_mant}-bit "
+                             "mantissa) — cast once from the wide "
+                             "source instead"),
+                    op="convert_element_type", scope=scope,
+                    dtype_from=src.fmt, dtype_to=dst_fmt,
+                    scale_provenance=provenance)
+            # fp8 needs a *per-site* scale — a global loss scale is
+            # not enough (its magnitude is tuned for fp16 grad
+            # exponents, not this site's distribution)
+            if dst_fmt in _FP8 and not src.site_scaled:
+                self._emit(
+                    eqn, path, emit, rule="unscaled-narrow-cast",
+                    message=(f"{src.fmt}→{dst_fmt} cast with no "
+                             "dominating per-site scale multiply"
+                             + (" (loss scale alone does not place "
+                                "this site's exponents)"
+                                if src.taint else "")
+                             + " — the cast O4 must never emit"),
+                    op="convert_element_type", scope=scope,
+                    dtype_from=src.fmt, dtype_to=dst_fmt,
+                    scale_provenance=provenance)
+            elif (dst_fmt == "fp16"
+                  and not (src.site_scaled or src.taint)
+                  and not self.uses_loss_scaling):
+                self._emit(
+                    eqn, path, emit, rule="unscaled-narrow-cast",
+                    severity="warning",
+                    message=(f"{src.fmt}→fp16 cast with no scale "
+                             "multiply and no loss-scaling policy — "
+                             "fp16's 5-bit exponent underflows "
+                             "unprotected gradients"),
+                    op="convert_element_type", scope=scope,
+                    dtype_from=src.fmt, dtype_to="fp16",
+                    scale_provenance=provenance)
+            out.depth = src.depth + 1
+            out.min_mant = min(src.min_mant, dst_m)
+        out.fmt = dst_fmt
+        if out.carry_shape is not None and eqn.outvars:
+            # a widened copy of a half carried input is no longer the
+            # half carry (an f32 master path exists from here on)
+            if dst_m > src_m:
+                out.carry_shape = None
+        return out
+
+    def _mul_div(self, eqn, prim, ins: List[_AbsVal], path,
+                 emit) -> _AbsVal:
+        if len(ins) != 2:
+            return _AbsVal(taint=frozenset().union(
+                *(i.taint for i in ins)) if ins else frozenset())
+        a, b = ins
+        av, bv = eqn.invars
+        a_lit = _is_literal(av)
+        b_lit = _is_literal(bv)
+        a_scalar = a_lit or _is_scalar(getattr(av, "aval", None))
+        b_scalar = b_lit or _is_scalar(getattr(bv, "aval", None))
+        taint = a.taint | b.taint
+        inv_of: frozenset = frozenset()
+        scale_src: Optional[int] = None
+        site_scaled = False
+        if prim == "mul":
+            for x, y, y_lit, y_scalar in ((a, b, b_lit, b_scalar),
+                                          (b, a, a_lit, a_scalar)):
+                if x.scale_src is None:
+                    continue
+                if (not y_lit and y_scalar and y.scale_src is None
+                        and y.fmt is not None):
+                    # token minting: the scale_loss signature — the
+                    # scale (a scalar carried input) times a
+                    # *computed* scalar (the loss). From here on the
+                    # token is live: scalars derived from the scale
+                    # taint everything they multiply (the autodiff
+                    # backward multiplies cotangents by the scale).
+                    self._active.add(x.scale_src)
+                    taint = taint | {x.scale_src}
+                elif x.scale_src in self._active:
+                    taint = taint | {x.scale_src}
+                else:
+                    # scaled copy of an un-activated scale candidate
+                    # (mul 1.0 c in a grad jaxpr) is still the scale
+                    scale_src = x.scale_src
+            # cancellation: multiply by the reciprocal of a live token
+            if a.inv_of & b.taint:
+                taint = taint - a.inv_of
+            if b.inv_of & a.taint:
+                taint = taint - b.inv_of
+            site_scaled = a_scalar or b_scalar
+        else:                                   # div
+            # numerator / scale-source: the unscale-by-division shape
+            if b.scale_src is not None and b.scale_src in a.taint:
+                taint = frozenset(t for t in taint
+                                  if t != b.scale_src)
+            # literal / scale-source: a reciprocal of the scale
+            if (b.scale_src is not None and a_scalar and not a.taint
+                    and a.scale_src is None):
+                inv_of = frozenset({b.scale_src})
+            # scale / literal is still scale-derived
+            if a.scale_src is not None and b_lit:
+                scale_src = a.scale_src
+                if a.scale_src in self._active:
+                    taint = taint | {a.scale_src}
+            site_scaled = b_scalar
+        out = _AbsVal(taint=taint, inv_of=inv_of, scale_src=scale_src,
+                      site_scaled=site_scaled)
+        # a scaled copy of a value keeps its rounding history
+        arr = a if not a_scalar else b
+        out.depth = arr.depth
+        out.min_mant = arr.min_mant
+        return out
+
+    def _add_sub(self, eqn, ins: List[_AbsVal], path, emit) -> _AbsVal:
+        taint = frozenset().union(*(i.taint for i in ins)) \
+            if ins else frozenset()
+        out = _AbsVal(taint=taint)
+        if not self.apx304_active or len(ins) != 2:
+            return out
+        a, b = ins
+        out_aval = getattr(eqn.outvars[0], "aval", None) \
+            if eqn.outvars else None
+        if out_aval is None or _is_scalar(out_aval):
+            return out
+        shape = tuple(getattr(out_aval, "shape", ()) or ())
+        halfs = {"fp16", "bf16"} | set(_FP8)
+        if (a.fmt in halfs and b.fmt in halfs
+                and (a.carry_shape == shape or b.carry_shape == shape)):
+            out.upd_candidate = True
+            out.carry_shape = shape    # chains of half update arith
+        out.upd_candidate = out.upd_candidate or a.upd_candidate \
+            or b.upd_candidate
+        return out
+
+    def _check_matmul(self, eqn, ins: List[_AbsVal], path, emit):
+        in_fmts = [_fmt_of_aval(getattr(v, "aval", None))
+                   for v in eqn.invars]
+        in_fmts = [f for f in in_fmts if f is not None]
+        out_fmt = _fmt_of_aval(getattr(eqn.outvars[0], "aval", None)) \
+            if eqn.outvars else None
+        if not in_fmts or out_fmt is None:
+            return
+        narrow = set(in_fmts) <= {"fp16"} | set(_FP8)
+        widened = _RANK.get(out_fmt, 9) > max(
+            _RANK.get(f, 0) for f in in_fmts)
+        if narrow and not widened:
+            self._emit(
+                eqn, path, emit, rule="half-accumulation",
+                message=(f"{eqn.primitive.name} with "
+                         f"{'/'.join(sorted(set(in_fmts)))} operands "
+                         f"accumulates in {out_fmt} — pass "
+                         "preferred_element_type=jnp.float32"),
+                op=eqn.primitive.name, scope="/".join(path) or None,
+                dtype_from=sorted(in_fmts, key=lambda f:
+                                  _RANK.get(f, 9))[0],
+                dtype_to=out_fmt)
+
+    def _check_reduction(self, eqn, prim, ins: List[_AbsVal], path,
+                         emit):
+        in_fmts = [_fmt_of_aval(getattr(v, "aval", None))
+                   for v in eqn.invars]
+        in_fmts = [f for f in in_fmts if f is not None]
+        if not in_fmts:
+            return
+        narrowest = sorted(in_fmts, key=lambda f: _RANK.get(f, 9))[0]
+        if narrowest in ("fp16",) + _FP8:
+            sev = "warning"
+        elif narrowest == "bf16":
+            sev = "info"        # bf16 sums do accumulate in bf16 —
+            # advisory (bf16 *dots* widen in MXU hardware and are not
+            # flagged)
+        else:
+            return
+        out_fmt = _fmt_of_aval(getattr(eqn.outvars[0], "aval", None)) \
+            if eqn.outvars else None
+        if out_fmt is not None and _RANK.get(out_fmt, 0) > \
+                _RANK.get(narrowest, 0):
+            return              # widened accumulator
+        self._emit(
+            eqn, path, emit, rule="half-accumulation", severity=sev,
+            message=(f"{prim} reduces {narrowest} operands directly — "
+                     "the accumulator keeps the narrow mantissa"),
+            op=prim, scope="/".join(path) or None,
+            dtype_from=narrowest, dtype_to=out_fmt or narrowest)
+
+
+# -- entry points -------------------------------------------------------------
+
+def analyze_jaxpr(fn_or_jaxpr, *args, policy=None,
+                  **kwargs) -> PrecisionAnalysis:
+    """Run the precision dataflow pass; returns findings + site
+    counts. ``fn_or_jaxpr`` is a callable (traced here) or an
+    already-made (Closed)Jaxpr — :func:`apex_tpu.lint.lint_step`
+    passes its single shared trace."""
+    if hasattr(fn_or_jaxpr, "eqns") or hasattr(fn_or_jaxpr, "jaxpr"):
+        jaxpr = fn_or_jaxpr
+    else:
+        import jax
+        jaxpr = jax.make_jaxpr(fn_or_jaxpr)(*args, **kwargs)
+    interp = _Interp(policy=policy)
+    interp.run(jaxpr)
+    return PrecisionAnalysis(
+        findings=_fold(interp.findings),
+        n_cast_sites=interp.n_cast_sites,
+        n_matmul_sites=interp.n_matmul_sites,
+        n_reduction_sites=interp.n_reduction_sites)
+
+
+def precision_findings(fn_or_jaxpr, *args, policy=None,
+                       **kwargs) -> List[Finding]:
+    """The findings-only view of :func:`analyze_jaxpr`."""
+    return analyze_jaxpr(fn_or_jaxpr, *args, policy=policy,
+                         **kwargs).findings
+
+
+def _fold(findings: List[Finding]) -> List[Finding]:
+    """Fold same-fingerprint findings into one with a count (the
+    fingerprint excludes dtype evidence, so the fold keeps the first
+    occurrence's pair — the baseline workflow stays one line per
+    site)."""
+    by_fp: Dict[str, Finding] = {}
+    for f in findings:
+        fp = f.fingerprint() + f"|{f.severity}"
+        if fp in by_fp:
+            by_fp[fp].count += f.count
+        else:
+            by_fp[fp] = f
+    return list(by_fp.values())
+
+
+# -- APX306: the static x measured wire-dtype join ----------------------------
+
+def _as_report(report_or_stats):
+    from apex_tpu.monitor import numerics as nx
+    if hasattr(report_or_stats, "rows"):
+        return report_or_stats
+    return nx.precision_report(report_or_stats)
+
+
+def wire_dtype_findings(schedule: Iterable, report_or_stats, *,
+                        extra_scopes: Sequence[str] = ()
+                        ) -> List[Finding]:
+    """APX306: reduction collectives whose float wire dtype is
+    narrower than the committed ``precision_report`` verdicts for
+    their subsystem (scope → subsystem via
+    :mod:`apex_tpu.parallel.registry`; rows of the matching kind, all
+    rows when none match). Non-float wires (the int8 error-feedback
+    sync) are exempt."""
+    from apex_tpu.parallel import registry
+    report = _as_report(report_or_stats)
+    if not report.rows:
+        return []
+    out: List[Finding] = []
+    for instr in schedule or ():
+        if instr.opcode not in _REDUCTION_COLLECTIVES:
+            continue
+        wire_fmts = [hlo_dtype_format(d) for d in instr.dtypes]
+        wire_fmts = [f for f in wire_fmts if f is not None]
+        if not wire_fmts:
+            continue                       # int/pred wire: exempt
+        wire = min(wire_fmts, key=lambda f: _RANK[f])
+        ent = registry.scope_entry(instr.scope,
+                                   tuple(extra_scopes)) \
+            if instr.scope else None
+        subsystem = getattr(ent, "subsystem", None)
+        rows = [r for r in report.rows if r.kind == subsystem] \
+            or list(report.rows)
+        unsafe = [r for r in rows
+                  if _RANK.get(r.required_dtype, 99) > _RANK[wire]]
+        if not unsafe:
+            continue
+        widest = max((r.required_dtype for r in unsafe),
+                     key=lambda f: _RANK.get(f, 0))
+        out.append(Finding(
+            rule="wire-dtype-unsafe",
+            message=(f"{instr.opcode} wire dtype {wire} is narrower "
+                     f"than the measured verdict for {len(unsafe)} "
+                     f"site(s) (widest required: {widest}"
+                     + (f", subsystem {subsystem}" if subsystem
+                        else "") + ")"),
+            op=instr.opcode, scope=instr.scope or instr.name,
+            bytes=instr.bytes, count=len(unsafe),
+            dtype_from=wire if wire in LADDER else None,
+            dtype_to=widest if widest in LADDER else None,
+            scale_provenance="unscaled"))
+    return out
+
+
+# -- the fp8/O4 pre-flight ----------------------------------------------------
+
+@dataclasses.dataclass
+class PreflightResult:
+    """The ranked "statically castable ∩ measured-safe" site list."""
+
+    rows: List[Dict]            # one per measured fp8-safe site
+    blocking: List[str]         # APX3xx error ids blocking the program
+    n_sites: int                # static sites the pass examined
+    n_measured_sites: int       # verdict rows in the report
+
+    @property
+    def candidates(self) -> List[Dict]:
+        return [r for r in self.rows if r["castable"]]
+
+    def table(self) -> str:
+        head = (f"precision preflight: {len(self.candidates)}/"
+                f"{len(self.rows)} measured fp8-safe site(s) "
+                f"statically castable ({self.n_sites} static sites "
+                "examined)")
+        lines = [head]
+        if self.blocking:
+            lines.append("  blocked by: " + ", ".join(self.blocking)
+                         + " — fix the static findings first")
+        if not self.rows:
+            lines.append("  no measured fp8 candidates.")
+            return "\n".join(lines)
+        lines.append(f"  {'#':>3} {'ok':<3} {'format':<9} "
+                     f"{'scale':>10}  site")
+        for i, r in enumerate(self.rows):
+            lines.append(
+                f"  {i + 1:>3} {'y' if r['castable'] else 'n':<3} "
+                f"{r['required_dtype']:<9} "
+                f"{r['recommended_scale']:>10.3g}  "
+                f"{r['site'][:60]}")
+        return "\n".join(lines)
+
+
+def precision_preflight(fn_or_jaxpr, *args, stats=None, report=None,
+                        policy=None, hlo_text=None, k=None,
+                        known_scopes: Sequence[str] = (),
+                        **kwargs) -> PreflightResult:
+    """Join the program's static precision verdict with a measured
+    ``precision_report`` (a :class:`NumericsReport`, or ``stats=`` a
+    committed stats dict / ``stats_to_json`` fixture): every measured
+    fp8-safe site, ranked narrowest-format-first, carrying whether the
+    program as compiled is statically safe to start casting
+    (no APX3xx errors — including APX306 when ``hlo_text`` supplies
+    the collective schedule)."""
+    from apex_tpu.monitor import numerics as nx
+    if report is None:
+        if stats is None:
+            raise ValueError("precision_preflight needs report= or "
+                             "stats=")
+        report = nx.precision_report(stats)
+    analysis = analyze_jaxpr(fn_or_jaxpr, *args, policy=policy,
+                             **kwargs)
+    findings = list(analysis.findings)
+    if hlo_text:
+        from apex_tpu.lint.spmd_pass import extract_collective_schedule
+        findings += wire_dtype_findings(
+            extract_collective_schedule(hlo_text), report,
+            extra_scopes=known_scopes)
+    blocking = sorted({f.id for f in findings
+                       if f.severity == "error"})
+    rows = []
+    for cand in report.fp8_candidates(k):
+        rows.append({**cand, "castable": not blocking,
+                     "blocking": blocking})
+    rows.sort(key=lambda r: (_RANK.get(r["required_dtype"], 9),
+                             r.get("predicted_underflow_frac", 0.0)
+                             + r.get("predicted_saturation_frac", 0.0),
+                             r["site"]))
+    return PreflightResult(rows=rows, blocking=blocking,
+                           n_sites=analysis.n_sites,
+                           n_measured_sites=len(report.rows))

@@ -933,6 +933,60 @@ def _():
         assert not rep.by_rule("host-transfer"), rep.table()
 
 
+@case("lint/precision-no-extra-dispatch")
+def _():
+    """The precision pass (APX3xx) is strictly AOT like its siblings:
+    running it — default trace-side rules AND the APX306 fixture join
+    (``precision=`` a measured stats dict) — leaves the step's own
+    compiled HLO BIT-IDENTICAL, donated and undonated. A scale/unscale
+    pair is built into the step so the taint machinery actually
+    executes (the pin covers the analysis, not a vacuous walk)."""
+    from apex_tpu import lint
+
+    x = _rand((16, 32), 0)
+    y = _rand((16, 8), 1)
+    scale = jnp.float32(1024.0)
+    params = {"w": _rand((32, 8), 2, scale=0.1),
+              "b": jnp.zeros((8,), jnp.float32)}
+
+    def train_step(p, x, y, s):
+        def loss_fn(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"] - y)) * s
+        g = jax.grad(loss_fn)(p)
+        inv = (1.0 / s).astype(jnp.float32)
+        g = jax.tree_util.tree_map(lambda a: a * inv, g)
+        return jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, p, g)
+
+    # a tiny synthetic measured fixture (the columnar
+    # numerics.stats_from_json layout): one well-behaved site whose
+    # exponents sit in a single mid-range binade — fp8-safe
+    import numpy as _np
+    from apex_tpu.monitor import numerics as nx
+    hist = _np.zeros((1, nx.HIST_BINS))
+    hist[0, nx.HIST_BINS // 2] = 1.0
+    stats = {"sites": ("amp/cast/['w']",),
+             "amax": [1.0], "amax_ema": [1.0],
+             "amin": [0.5], "amin_ema": [0.5],
+             "exp_hist": hist, "zero_frac": [0.0],
+             "nonfinite_frac": [0.0], "uw_ratio": [-1.0]}
+    assert nx.precision_report(stats).rows, "synthetic fixture invalid"
+
+    for donate in ((), (0,)):
+        jitted = jax.jit(train_step, donate_argnums=donate)
+        before = jitted.lower(params, x, y, scale).compile().as_text()
+        for precision in (None, stats):
+            rep = lint.lint_step(
+                jax.jit(train_step, donate_argnums=donate),
+                params, x, y, scale, precision=precision)
+            assert not [f for f in rep.findings
+                        if f.rule in ("unscaled-narrow-cast",
+                                      "scale-leak")], rep.table()
+        after = jitted.lower(params, x, y, scale).compile().as_text()
+        assert after == before, \
+            f"precision pass changed the compiled program (donate=" \
+            f"{donate})"
+
+
 @case("lint/kernel-sweep")
 def _():
     """apexlint HLO sweep over the kernel families the pinned cases

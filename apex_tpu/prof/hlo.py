@@ -26,6 +26,11 @@ _DTYPE_BYTES = {
     "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
     "s64": 8, "u64": 8, "f8e4m3": 1, "f8e5m2": 1, "bf16": 2, "f16": 2,
     "f32": 4, "f64": 8, "c64": 8, "c128": 16,
+    # XLA's saturating/fnuz fp8 spellings (what optimized HLO actually
+    # prints for float8_e4m3fn etc.) — absent entries would silently
+    # zero fp8 wire/buffer bytes in memory and collective accounting
+    "f8e4m3fn": 1, "f8e5m2fnuz": 1, "f8e4m3fnuz": 1, "f8e3m4": 1,
+    "f8e4m3b11fnuz": 1,
 }
 
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")

@@ -369,7 +369,7 @@ def _bench_dcgan(batch, iters):
 
 
 def _bert_step_builder(batch, seq, encoder=None, vocab=30000,
-                       ddp=None):
+                       ddp=None, opt_level="O1"):
     """ONE construction of the BERT-LAMB MLM step (amp O1 + FusedLAMB,
     auto_cast forward) shared by the bench row, the apexlint flagship
     (`scripts/apexlint.py --flagship bert` — the program the smoke gate
@@ -379,13 +379,15 @@ def _bert_step_builder(batch, seq, encoder=None, vocab=30000,
     ``ddp`` (a `parallel.DistributedDataParallel`) syncs the gradients
     between backward and apply — the per-shard step the apexlint
     `--mesh` cross-rank audit wraps in `shard_map`; the batch is then
-    the GLOBAL batch. Returns
+    the GLOBAL batch. ``opt_level`` is the amp opt level (O1 is the
+    measured BASELINE.md configuration; the apexlint
+    ``--opt-level`` sweep builds the others). Returns
     ``(step, state, (toks, labels), policy, enc, variables)``.
     """
     from apex_tpu import amp, models
     from apex_tpu.optim import FusedLAMB
 
-    policy = amp.Policy.from_opt_level("O1")
+    policy = amp.Policy.from_opt_level(opt_level)
     enc = encoder if encoder is not None else models.BertLarge()
     rng = np.random.RandomState(0)
     toks = jnp.asarray(rng.randint(0, vocab, (batch, seq)), jnp.int32)
@@ -1270,9 +1272,14 @@ def _memory_row(batch: int, size: int):
     rep = prof.memory_report(compiled, batch_size=batch)
     sample = prof.device_memory_sample()
     peak = sample.get("peak_bytes_in_use")
+    policy = amp.Policy.from_opt_level("O2")
+    # ONE trace shared by lint_step's jaxpr-side passes and the
+    # precision analysis below (the same economy lint_step itself
+    # applies internally)
+    step_jaxpr = jax.make_jaxpr(step)(state, batch_stats, x, y)
     lint_rep = lint.lint_step(
         step, state, batch_stats, x, y,
-        policy=amp.Policy.from_opt_level("O2"), compiled=compiled,
+        policy=policy, compiled=compiled, jaxpr=step_jaxpr,
         fn_name="resnet50_o2_step")
     # cross-rank congruence off the SAME executable (apexlint SPMD
     # pass): trivially 0 collectives on the single-chip headline, the
@@ -1288,6 +1295,24 @@ def _memory_row(batch: int, size: int):
     from apex_tpu.lint.mesh_model import parse_mesh_spec
     from apex_tpu.prof import compile_watch as _cw
     compiles_before = int(_cw.global_counters()["compiles"])
+    # precision certification off the SAME trace + executable: static
+    # APX3xx verdict, and — when the committed BERT numerics fixture is
+    # present — the preflight's measured-safe candidate count (all
+    # strictly AOT, inside the zero-extra-compiles pin)
+    pa = lint.precision_analysis(step_jaxpr, policy=policy)
+    precision_errors = sum(1 for f in pa.findings
+                           if f.severity == "error")
+    fixture = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "tests", "fixtures",
+                           "bert_numerics_stats.json")
+    preflight_candidates = None
+    if os.path.exists(fixture):
+        from apex_tpu.monitor import numerics as _nx
+        with open(fixture) as f:
+            pf = lint.precision_preflight(
+                step_jaxpr, stats=_nx.stats_from_json(f.read()),
+                policy=policy, hlo_text=compiled.as_text())
+        preflight_candidates = len(pf.candidates)
     # the headline step is single-chip: a 1-wide flat data axis — the
     # columns exist (and are sentinel-gated) from day one so the mesh
     # flagships inherit a populated schema, not a new column
@@ -1316,6 +1341,9 @@ def _memory_row(batch: int, size: int):
         "lint": lint_rep.summary(),
         "lint_spmd": {"n_collectives": len(schedule),
                       "congruence_errors": spmd_errors},
+        "lint_precision": {"n_sites": pa.n_sites,
+                           "errors": precision_errors,
+                           "preflight_candidates": preflight_candidates},
     }
 
 
@@ -1448,6 +1476,14 @@ def main():
                   # see docs/linting.md#apx2xx)
                   "lint_spmd_errors": mem.get("lint_spmd", {}).get(
                       "congruence_errors"),
+                  # precision certification off the same trace +
+                  # executable (apexlint precision pass,
+                  # docs/linting.md#apx3xx): examined cast/dot/
+                  # reduction sites, APX3xx error count, and — when
+                  # the committed numerics fixture is present — the
+                  # preflight's "statically castable ∩ measured-safe"
+                  # fp8 candidate count
+                  "lint_precision": mem.get("lint_precision"),
                   # the sharding observatory columns, off the SAME
                   # donated executable (apex_tpu.prof.shard_report +
                   # mesh_explain.price_candidate — zero extra

@@ -3,18 +3,38 @@
 
 Three ways to name the step:
 
-``--flagship resnet|bert|both|guarded|ckpt|all`` (default: both)
+``--flagship resnet|bert|both|guarded|ckpt|dynamics|all`` (default: both)
     The BASELINE.md flagship steps, built exactly as ``bench.py`` runs
     them (ResNet-50 amp O2 + FusedSGD; BERT LAMB amp O1), jitted WITH
     their donation so the donation rule audits the real program. On an
     accelerator the full-size configs are used; on CPU the structural
     downscalings (the same convention as ``pod_comm_budget --cpu8`` /
     ``memory_budget --cpu8``: ResNet at 64px/b8, a 4-layer BERT at
-    seq 128) — same step structure, CPU-compilable. ``guarded`` and
-    ``ckpt`` are the self-audit targets: the guard-instrumented
-    flagship step (``Amp.step(guard=)``) and the checkpoint snapshot
-    copy program — instrumentation that landed after the linter did
-    and must stay clean; ``all`` = all four.
+    seq 128) — same step structure, CPU-compilable. ``guarded``,
+    ``ckpt`` and ``dynamics`` are the self-audit targets: the
+    guard-instrumented flagship step (``Amp.step(guard=)``), the
+    checkpoint snapshot copy program, and the training-dynamics
+    instrumented step (``Amp.step(dynamics=)``) — instrumentation that
+    landed after the linter did and must stay clean; ``all`` = all
+    five.
+
+``--opt-level O0|O1|O2|O3|all``
+    Rebuild the resnet/bert flagships at that amp opt level (instead
+    of their measured O2/O1 configurations) and lint each — the
+    precision pass (APX3xx, docs/linting.md#apx3xx) must certify the
+    amp machinery at EVERY level; ``all`` sweeps all four.
+    ``run_tier1.sh --smoke`` runs ``--opt-level all --fail-on error``
+    as the mixed-precision certification gate. Targets without an opt
+    level (guarded/ckpt/dynamics/--import/--hlo) are built as usual.
+
+``--precision-stats FILE``
+    A committed numerics stats fixture (``stats_to_json`` output, e.g.
+    ``tests/fixtures/bert_numerics_stats.json``). Activates APX306 —
+    collective wire dtypes joined against the fixture's measured
+    per-site ``precision_report`` verdicts — and prints the
+    ``precision_preflight`` table: every measured fp8-safe site,
+    ranked, flagged castable only when the program has no static
+    APX3xx errors (the fp8/O4 pre-flight).
 
 ``--import pkg.mod:builder``
     ``builder()`` must return ``(step_fn, args)`` or
@@ -67,25 +87,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 sys.path.insert(1, os.path.dirname(os.path.abspath(__file__)))
 
 
-def _build_flagship_resnet():
-    """The headline ResNet-50 amp O2 step, donated as bench measures it."""
+def _build_flagship_resnet(opt_level="O2"):
+    """The headline ResNet-50 amp O2 step, donated as bench measures
+    it; ``opt_level`` rebuilds the same structure at another amp level
+    (the ``--opt-level`` precision-certification sweep)."""
     import jax
     import bench
     from apex_tpu import amp
     on_tpu = jax.default_backend() == "tpu"
     batch, size = (256, 224) if on_tpu else (8, 64)
     step, (state, batch_stats), (x, y) = bench._resnet_step_builder(
-        batch, size, "O2")
+        batch, size, opt_level)
     jstep = jax.jit(step, donate_argnums=(0, 1))
     return (jstep, (state, batch_stats, x, y),
-            amp.Policy.from_opt_level("O2"), "resnet50_o2_step")
+            amp.Policy.from_opt_level(opt_level),
+            f"resnet50_{opt_level.lower()}_step")
 
 
-def _build_flagship_bert():
+def _build_flagship_bert(opt_level="O1"):
     """The BERT LAMB step, built by bench's own `_bert_step_builder`
     (the lint gate audits the program the bench measures), donated. CPU
     uses a 4-layer structural downscale — XLA:CPU takes minutes just to
-    compile the 24-layer BertLarge module (see bench._bert_row)."""
+    compile the 24-layer BertLarge module (see bench._bert_row).
+    ``opt_level`` rebuilds at another amp level for the sweep."""
     import jax
     import bench
     from apex_tpu import models
@@ -98,9 +122,12 @@ def _build_flagship_bert():
                                  max_len=128)
         batch, seq = 2, 128
     step, state, (toks, labels), policy, _enc, _vars = \
-        bench._bert_step_builder(batch, seq, encoder=enc)
+        bench._bert_step_builder(batch, seq, encoder=enc,
+                                 opt_level=opt_level)
     jstep = jax.jit(step, donate_argnums=(0,))
-    return jstep, (state, toks, labels), policy, "bert_lamb_step"
+    return (jstep, (state, toks, labels), policy,
+            f"bert_lamb_{opt_level.lower()}_step"
+            if opt_level != "O1" else "bert_lamb_step")
 
 
 def _build_flagship_guarded():
@@ -150,6 +177,57 @@ def _build_flagship_guarded():
             "guarded_resnet_o2_step")
 
 
+def _build_flagship_dynamics():
+    """The training-dynamics instrumented flagship step (self-audit:
+    ``monitor/dynamics`` landed after the linter did —
+    ``Amp.step(dynamics=)`` threads the GNS/geometry probes through the
+    same resnet O2 program and must stay clean, 0 errors on the empty
+    baseline, like ``guarded``/``ckpt``). Structural downscale on
+    CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu import amp, models, ops
+    from apex_tpu.monitor import dynamics as dx
+    from apex_tpu.optim import FusedSGD
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model = models.ResNet(stage_sizes=[3, 4, 6, 3],
+                              num_classes=1000, dtype=jnp.bfloat16)
+        batch, size = 256, 224
+    else:
+        model = models.ResNet(stage_sizes=[1, 1], num_classes=10,
+                              width=16, dtype=jnp.bfloat16)
+        batch, size = 8, 32
+    policy = amp.Policy.from_opt_level("O2")
+    amp_opt = amp.Amp(policy, FusedSGD(lr=0.1, momentum=0.9))
+    x = jnp.zeros((batch, size, size, 3), jnp.float32)
+    y = jnp.zeros((batch,), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=True)
+    state = amp_opt.init(variables["params"])
+    batch_stats = variables["batch_stats"]
+    dcfg = dx.DynamicsConfig(check_every=2, local_batch=batch)
+    ds = dx.dynamics_init(dcfg,
+                          sites=amp_opt.dynamics_sites(state.params))
+
+    def step(state, ds, batch_stats, x, y):
+        def loss_fn(mp):
+            logits, mut = model.apply(
+                {"params": mp, "batch_stats": batch_stats}, x,
+                train=True, mutable=["batch_stats"])
+            loss = jnp.mean(ops.softmax_cross_entropy_loss(logits, y))
+            return loss, mut["batch_stats"]
+
+        state, (loss, new_bs), committed, ds = amp_opt.step(
+            state, loss_fn, has_aux=True, dynamics=(ds, dcfg))
+        return state, ds, new_bs, loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+    return (jstep, (state, ds, batch_stats, x, y), policy,
+            "dynamics_resnet_o2_step")
+
+
 def _build_flagship_ckpt():
     """The checkpoint snapshot's batched copy program over the flagship
     carried state (self-audit: ``ckpt/`` landed after the linter did).
@@ -183,11 +261,17 @@ def _build_flagship_ckpt():
 FLAGSHIPS = {"resnet": _build_flagship_resnet,
              "bert": _build_flagship_bert,
              "guarded": _build_flagship_guarded,
-             "ckpt": _build_flagship_ckpt}
+             "ckpt": _build_flagship_ckpt,
+             "dynamics": _build_flagship_dynamics}
+#: flagships whose builder takes an amp opt level (the --opt-level
+#: sweep subjects; the self-audit targets are fixed-config)
+OPT_LEVEL_FLAGSHIPS = frozenset({"resnet", "bert"})
+OPT_LEVELS = ("O0", "O1", "O2", "O3")
 #: --flagship group aliases ("both" predates guarded/ckpt and keeps
 #: its original meaning)
 FLAGSHIP_GROUPS = {"both": ("resnet", "bert"),
-                   "all": ("resnet", "bert", "guarded", "ckpt")}
+                   "all": ("resnet", "bert", "guarded", "ckpt",
+                           "dynamics")}
 
 
 def _mesh_comm_plan(mesh_model, grad_bytes):
@@ -346,6 +430,7 @@ def main(argv=None) -> int:
     flagship = None
     imports, hlo_files = [], []
     baseline_path = write_baseline = jsonl_path = mesh_spec = None
+    opt_level = precision_stats = None
     fail_on = "error"
     as_json = flat_sync = False
     it = iter(argv)
@@ -361,7 +446,7 @@ def main(argv=None) -> int:
             continue
         elif a not in ("--flagship", "--import", "--hlo", "--baseline",
                        "--write-baseline", "--jsonl", "--fail-on",
-                       "--mesh"):
+                       "--mesh", "--opt-level", "--precision-stats"):
             print(f"unknown arg {a!r}\n{__doc__}", file=sys.stderr)
             return 2
         val = next(it, None)
@@ -384,8 +469,16 @@ def main(argv=None) -> int:
             fail_on = val
         elif a == "--mesh":
             mesh_spec = val
+        elif a == "--opt-level":
+            opt_level = val
+        elif a == "--precision-stats":
+            precision_stats = val
     if fail_on not in ("error", "warning", "never"):
         print(f"--fail-on must be error|warning|never, got {fail_on!r}",
+              file=sys.stderr)
+        return 2
+    if opt_level is not None and opt_level not in OPT_LEVELS + ("all",):
+        print(f"--opt-level must be O0|O1|O2|O3|all, got {opt_level!r}",
               file=sys.stderr)
         return 2
     if flagship is None and not imports and not hlo_files:
@@ -436,12 +529,29 @@ def main(argv=None) -> int:
                       f"{', '.join(FLAGSHIP_GROUPS)}){extra}",
                       file=sys.stderr)
                 return 2
-            targets.append(("flagship", n))
-    targets += [("import", s) for s in imports]
-    targets += [("hlo", p) for p in hlo_files]
+            if (opt_level is not None and mesh_model is None
+                    and n in OPT_LEVEL_FLAGSHIPS):
+                levels = (OPT_LEVELS if opt_level == "all"
+                          else (opt_level,))
+                targets += [("flagship", n, lv) for lv in levels]
+            else:
+                targets.append(("flagship", n, None))
+    targets += [("import", s, None) for s in imports]
+    targets += [("hlo", p, None) for p in hlo_files]
 
     from apex_tpu import lint
     baseline = lint.load_baseline(baseline_path) if baseline_path else []
+
+    precision = None
+    if precision_stats is not None:
+        from apex_tpu.monitor import numerics as nx
+        try:
+            with open(precision_stats) as f:
+                precision = nx.precision_report(
+                    nx.stats_from_json(f.read()))
+        except (OSError, ValueError, KeyError) as e:
+            print(f"--precision-stats: {e}", file=sys.stderr)
+            return 2
 
     logger = None
     if jsonl_path:
@@ -450,7 +560,8 @@ def main(argv=None) -> int:
             sinks=[], lint_sink=monitor.JSONLSink(jsonl_path))
 
     reports, raw_findings = [], []
-    for kind, what in targets:
+    for kind, what, lv in targets:
+        preflight = None
         if kind == "hlo":
             report = lint.lint_hlo_file(what, mesh_model=mesh_model)
         else:
@@ -461,13 +572,32 @@ def main(argv=None) -> int:
                 # model still judges it below)
                 fn, args, policy, name = MESH_FLAGSHIPS[what](
                     mesh, None if flat_sync else mesh_model)
+            elif kind == "flagship":
+                builder = FLAGSHIPS[what]
+                fn, args, policy, name = (builder(opt_level=lv)
+                                          if lv is not None
+                                          else builder())
             else:
-                fn, args, policy, name = (FLAGSHIPS[what]()
-                                          if kind == "flagship"
-                                          else _import_builder(what))
-            report = lint.lint_step(fn, *args, policy=policy,
-                                    fn_name=name,
-                                    mesh_model=mesh_model)
+                fn, args, policy, name = _import_builder(what)
+            if precision is not None:
+                # ONE trace + ONE compile shared by every consumer:
+                # lint_step's passes, APX306's schedule walk, and the
+                # preflight's static verdict
+                import jax
+                from apex_tpu.prof import hlo as _hlo
+                jaxpr = jax.make_jaxpr(fn)(*args)
+                hlo_text = _hlo.compiled_hlo(fn, *args)
+                report = lint.lint_step(
+                    fn, *args, policy=policy, fn_name=name,
+                    mesh_model=mesh_model, precision=precision,
+                    jaxpr=jaxpr, hlo_text=hlo_text)
+                preflight = lint.precision_preflight(
+                    jaxpr, report=precision, policy=policy,
+                    hlo_text=hlo_text)
+            else:
+                report = lint.lint_step(fn, *args, policy=policy,
+                                        fn_name=name,
+                                        mesh_model=mesh_model)
         # the written baseline must cover EVERYTHING that fired —
         # including findings the read baseline suppresses, or a
         # --baseline X --write-baseline X refresh would drop still-live
@@ -478,9 +608,17 @@ def main(argv=None) -> int:
         if as_json:
             out = {"fn": report.fn_name}
             out.update(report.summary())
+            if preflight is not None:
+                out["preflight"] = {
+                    "n_rows": len(preflight.rows),
+                    "n_candidates": len(preflight.candidates),
+                    "blocking": preflight.blocking,
+                    "n_static_sites": preflight.n_sites}
             print(json.dumps(out))
         else:
             print(report.table())
+            if preflight is not None:
+                print(preflight.table())
         if logger is not None:
             logger.attach_lint_report(report)
     if logger is not None:

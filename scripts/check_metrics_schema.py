@@ -393,14 +393,23 @@ def _memory_special(i, rec, kind, state, errors):
 LINT_KINDS = ("lint_report", "lint_finding")
 LINT_SEVERITIES = ("error", "warning", "info")
 LINT_HOPS = ("ici", "dcn")
+#: the supported format ladder for APX3xx dtype evidence — mirrors
+#: apex_tpu.lint.findings.DTYPE_NAMES (numerics.FORMAT_LADDER + fp64)
+LINT_DTYPES = ("fp8_e4m3", "fp8_e5m2", "fp16", "bf16", "fp32", "fp64")
+#: mirrors apex_tpu.lint.findings.PROVENANCES
+LINT_PROVENANCES = ("unscaled", "loss-scaled", "site-scaled",
+                    "unscaled-after-narrow")
 LINT_REQUIRED = {
     "lint_report": ("n_findings", "by_severity"),
     "lint_finding": ("rule", "id", "severity", "message"),
 }
 LINT_NULLABLE = {
     "lint_report": ("step", "fn"),
+    # dtype_from/dtype_to/scale_provenance: APX3xx precision evidence,
+    # absent (null) on every other rule — nullable + enum-checked
     "lint_finding": ("step", "fn", "op", "scope", "bytes", "fix",
-                     "axes", "ranks", "hop"),
+                     "axes", "ranks", "hop", "dtype_from", "dtype_to",
+                     "scale_provenance"),
 }
 
 
@@ -1049,7 +1058,9 @@ SCHEMAS: Dict[str, ChannelSchema] = {
     "lint": ChannelSchema(
         LINT_KINDS, LINT_REQUIRED, LINT_NULLABLE,
         counters=("bytes", "count", "step"),
-        enums={"severity": LINT_SEVERITIES, "hop": LINT_HOPS},
+        enums={"severity": LINT_SEVERITIES, "hop": LINT_HOPS,
+               "dtype_from": LINT_DTYPES, "dtype_to": LINT_DTYPES,
+               "scale_provenance": LINT_PROVENANCES},
         special=_lint_special),
     "ckpt": ChannelSchema(
         CKPT_KINDS, CKPT_REQUIRED, CKPT_NULLABLE,

@@ -214,17 +214,33 @@ EOF
 
     echo "== smoke: apexlint flagship steps (--fail-on error)"
     # lints the flagship ResNet-O2 and BERT-LAMB steps (CPU structural
-    # downscalings) PLUS the guard-instrumented step and the ckpt
-    # snapshot copy program (the self-audit targets) against the
-    # committed baseline — which starts EMPTY, so any new
-    # error-severity finding (donation miss, host transfer, f64 creep,
-    # RNG reuse, non-replayable randomness) breaks this gate
+    # downscalings) PLUS the guard-instrumented step, the ckpt
+    # snapshot copy program and the dynamics-instrumented step (the
+    # self-audit targets) against the committed baseline — which
+    # starts EMPTY, so any new error-severity finding (donation miss,
+    # host transfer, f64 creep, RNG reuse, non-replayable randomness,
+    # unscaled narrow cast, scale leak) breaks this gate
     JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship all \
         --baseline scripts/apexlint_baseline.json --fail-on error \
         --jsonl "$tmp/lint.jsonl"
 
     echo "== smoke: lint schema validator on the apexlint event stream"
     python scripts/check_metrics_schema.py --kind lint "$tmp/lint.jsonl"
+
+    echo "== smoke: apexlint precision certification sweep (O0-O3)"
+    # the precision pass (APX3xx, docs/linting.md#apx3xx) over both
+    # flagships REBUILT at every amp opt level: the amp machinery's
+    # scale/unscale/cast structure must certify statically at each
+    # level — an unscaled narrow cast, a scale leaking past the
+    # unscale, or a master-weight violation is an error against the
+    # same empty baseline
+    JAX_PLATFORMS=cpu python scripts/apexlint.py --flagship both \
+        --opt-level all --baseline scripts/apexlint_baseline.json \
+        --fail-on error --jsonl "$tmp/lint_precision.jsonl"
+
+    echo "== smoke: lint schema validator on the precision stream"
+    python scripts/check_metrics_schema.py --kind lint \
+        "$tmp/lint_precision.jsonl"
 
     echo "== smoke: apexlint cross-rank congruence audit (cpu8, dp2x4)"
     # the SPMD pass over the DDP flagship steps compiled on the

@@ -343,6 +343,469 @@ class TestFlagshipClean:
         assert rep.errors == [], rep.table()
 
 
+# --- precision pass (APX3xx): seeded violation + negative twin per rule ------
+
+def _pp(fn, *args, policy=None):
+    """Trace + precision-analyze; returns the findings list."""
+    return lint.precision_analysis(
+        jax.make_jaxpr(fn)(*args), policy=policy).findings
+
+
+def _by(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+class TestUnscaledNarrowCast:                               # APX301
+    def test_fires_on_raw_fp8_cast(self):
+        fs = _pp(lambda x: x.astype(jnp.float8_e4m3fn),
+                 jnp.ones((16,), jnp.float32))
+        hits = _by(fs, "unscaled-narrow-cast")
+        assert len(hits) == 1 and hits[0].severity == "error"
+        assert hits[0].dtype_from == "fp32"
+        assert hits[0].dtype_to == "fp8_e4m3"
+        assert hits[0].scale_provenance == "unscaled"
+
+    def test_site_scaled_cast_is_clean(self):
+        # the O4 scaled-cast recipe: a dominating scale multiply
+        fs = _pp(lambda x, s: (x * s).astype(jnp.float8_e4m3fn),
+                 jnp.ones((16,), jnp.float32), jnp.float32(64.0))
+        assert _by(fs, "unscaled-narrow-cast") == []
+
+    def test_loss_scaled_fp8_cast_still_fires(self):
+        # a global loss scale is NOT a per-site scale: fp8 exponents
+        # need placing per site — provenance names the distinction
+        def f(params, x, s):
+            def loss_fn(p):
+                return jnp.mean((x @ p) ** 2) * s
+            return jax.grad(loss_fn)(params).astype(jnp.float8_e5m2)
+        fs = _pp(f, jnp.ones((4, 4), jnp.float32),
+                 jnp.ones((8, 4), jnp.float32), jnp.float32(1024.0))
+        hits = _by(fs, "unscaled-narrow-cast")
+        assert hits and hits[0].severity == "error"
+        assert hits[0].scale_provenance == "loss-scaled"
+
+    def test_fp16_warning_only_without_loss_scaling(self):
+        def f(x):
+            return x.astype(jnp.float16)
+        x = jnp.ones((16,), jnp.float32)
+        fs = _pp(f, x)                         # no policy: warning
+        hits = _by(fs, "unscaled-narrow-cast")
+        assert len(hits) == 1 and hits[0].severity == "warning"
+        pol = amp.Policy.from_opt_level("O3")  # loss-scaled: clean
+        assert pol.uses_loss_scaling
+        assert _by(_pp(f, x, policy=pol), "unscaled-narrow-cast") == []
+
+    def test_bf16_cast_exempt(self):
+        fs = _pp(lambda x: x.astype(jnp.bfloat16),
+                 jnp.ones((16,), jnp.float32))
+        assert _by(fs, "unscaled-narrow-cast") == []
+
+
+class TestDoubleRounding:                                   # APX302
+    def test_fires_on_chained_narrowing(self):
+        def f(x, s):
+            y = x.astype(jnp.bfloat16)         # round 1 (f32 -> bf16)
+            return (y * s.astype(jnp.bfloat16)).astype(
+                jnp.float8_e4m3fn)             # round 2, scaled
+        fs = _pp(f, jnp.ones((16,), jnp.float32), jnp.float32(8.0))
+        hits = _by(fs, "double-rounding")
+        assert len(hits) == 1 and hits[0].severity == "warning"
+        assert hits[0].dtype_from == "bf16"
+        assert hits[0].dtype_to == "fp8_e4m3"
+
+    def test_round_trip_is_clean(self):
+        # bf16 -> f32 -> bf16 destroys nothing new
+        fs = _pp(lambda x: x.astype(jnp.float32).astype(jnp.bfloat16),
+                 jnp.ones((16,), jnp.bfloat16))
+        assert _by(fs, "double-rounding") == []
+
+    def test_arithmetic_resets_depth(self):
+        # a sum of rounded values is a new quantity: one narrowing of
+        # it is a single rounding
+        def f(x, y):
+            a = x.astype(jnp.bfloat16) + y.astype(jnp.bfloat16)
+            return a.astype(jnp.float32).astype(jnp.bfloat16)
+        fs = _pp(f, jnp.ones((16,), jnp.float32),
+                 jnp.ones((16,), jnp.float32))
+        assert _by(fs, "double-rounding") == []
+
+
+def _leaky_grad_step(unscale):
+    def step(params, x, scale):
+        def loss_fn(p):
+            return jnp.mean((x @ p) ** 2) * scale   # scale_loss shape
+        g = jax.grad(loss_fn)(params)
+        if unscale:
+            inv = (1.0 / scale).astype(jnp.float32)
+            g = g.astype(jnp.float32) * inv         # unscale_grads
+        return params - 0.1 * g
+    return (step, jnp.ones((4, 4), jnp.float32),
+            jnp.ones((8, 4), jnp.float32), jnp.float32(1024.0))
+
+
+class TestScaleLeak:                                        # APX303
+    def test_fires_when_unscale_missing(self):
+        step, p, x, s = _leaky_grad_step(unscale=False)
+        hits = _by(_pp(step, p, x, s), "scale-leak")
+        assert hits and all(h.severity == "error" for h in hits)
+        assert hits[0].scale_provenance == "loss-scaled"
+
+    def test_unscaled_twin_is_clean(self):
+        step, p, x, s = _leaky_grad_step(unscale=True)
+        assert _by(_pp(step, p, x, s), "scale-leak") == []
+
+    def test_one_unscaled_path_still_fires(self):
+        # the unscale must happen on EVERY path: taint joins as union
+        def f(pred, x, s):
+            _ = jnp.sum(x) * s                      # mint the token
+            return jax.lax.cond(pred, lambda: x * s, lambda: x)
+        fs = _pp(f, jnp.asarray(True), jnp.ones((8,), jnp.float32),
+                 jnp.float32(128.0))
+        assert _by(fs, "scale-leak")
+
+    def test_scalar_outputs_exempt(self):
+        # the scaled loss / scaler-state update are scalar and benign
+        def f(x, s):
+            return jnp.sum(x) * s
+        fs = _pp(f, jnp.ones((8,), jnp.float32), jnp.float32(2.0))
+        assert _by(fs, "scale-leak") == []
+
+
+class TestMasterWeightViolation:                            # APX304
+    def _update(self):
+        def f(params, g):
+            return params - 0.1 * g
+        return (f, jnp.ones((32, 32), jnp.bfloat16),
+                jnp.ones((32, 32), jnp.bfloat16))
+
+    def test_o2_half_update_is_error(self):
+        f, p, g = self._update()
+        hits = _by(_pp(f, p, g, policy=amp.Policy.from_opt_level("O2")),
+                   "master-weight-violation")
+        assert len(hits) == 1 and hits[0].severity == "error"
+        assert hits[0].dtype_from == "bf16"
+        assert hits[0].dtype_to == "fp32"
+
+    def test_o3_half_update_is_info(self):
+        # pure-half is O3's documented design: advisory, not error
+        f, p, g = self._update()
+        hits = _by(_pp(f, p, g, policy=amp.Policy.from_opt_level("O3")),
+                   "master-weight-violation")
+        assert len(hits) == 1 and hits[0].severity == "info"
+
+    def test_no_policy_silent(self):
+        f, p, g = self._update()
+        assert _by(_pp(f, p, g), "master-weight-violation") == []
+
+    def test_master_chain_twin_is_clean(self):
+        def f(master32, g16):
+            new = master32 - 0.1 * g16.astype(jnp.float32)
+            return new.astype(jnp.bfloat16), new
+        fs = _pp(f, jnp.ones((32, 32), jnp.float32),
+                 jnp.ones((32, 32), jnp.bfloat16),
+                 policy=amp.Policy.from_opt_level("O2"))
+        assert _by(fs, "master-weight-violation") == []
+
+
+class TestHalfAccumulation:                                 # APX305
+    def test_fp16_dot_fires(self):
+        fs = _pp(lambda a, b: a @ b,
+                 jnp.ones((4, 4), jnp.float16), jnp.ones((4, 4),
+                                                         jnp.float16))
+        hits = _by(fs, "half-accumulation")
+        assert len(hits) == 1 and hits[0].severity == "warning"
+
+    def test_widened_dot_is_clean(self):
+        def f(a, b):
+            return jax.lax.dot_general(
+                a, b, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+        fs = _pp(f, jnp.ones((4, 4), jnp.float16),
+                 jnp.ones((4, 4), jnp.float16))
+        assert _by(fs, "half-accumulation") == []
+
+    def test_bf16_dot_exempt(self):
+        # the MXU widens bf16 dot accumulation in hardware
+        fs = _pp(lambda a, b: a @ b,
+                 jnp.ones((4, 4), jnp.bfloat16),
+                 jnp.ones((4, 4), jnp.bfloat16))
+        assert _by(fs, "half-accumulation") == []
+
+    def test_fp16_accumulating_sum_fires(self):
+        # cumsum keeps the operand dtype (also exercises the pjit
+        # sub-jaxpr walk: jnp.cumsum traces as a nested jaxpr);
+        # NB ``jnp.sum`` auto-widens to f32 even with ``dtype=f16``
+        fs = _pp(lambda a: jnp.cumsum(a), jnp.ones((64,), jnp.float16))
+        hits = _by(fs, "half-accumulation")
+        assert hits and hits[0].severity == "warning"
+        assert hits[0].op == "cumsum"
+
+    def test_bf16_sum_is_info(self):
+        # plain sum chains DO accumulate bf16 (unlike the MXU dot)
+        fs = _pp(lambda a: jnp.cumsum(a), jnp.ones((64,), jnp.bfloat16))
+        hits = _by(fs, "half-accumulation")
+        assert hits and hits[0].severity == "info"
+
+    def test_widened_sum_is_clean(self):
+        fs = _pp(lambda a: jnp.sum(a, dtype=jnp.float32),
+                 jnp.ones((64,), jnp.bfloat16))
+        assert _by(fs, "half-accumulation") == []
+
+
+def _fixture_report():
+    from apex_tpu.monitor import numerics as nx
+    path = os.path.join(_REPO_ROOT, "tests", "fixtures",
+                        "bert_numerics_stats.json")
+    with open(path) as f:
+        return nx.precision_report(nx.stats_from_json(f.read()))
+
+
+def _collective(dtype, scope="ddp/sync_gradients",
+                opcode="all-reduce"):
+    from apex_tpu.lint.spmd_pass import CollectiveInstr
+    return CollectiveInstr(index=0, name=f"{opcode}.1", opcode=opcode,
+                           channel_id=1, replica_groups=((0, 1),),
+                           dtypes=(dtype,), bytes=1 << 20, scope=scope,
+                           use_global_ids=False)
+
+
+class TestWireDtypeUnsafe:                                  # APX306
+    def _bf16_required(self):
+        import dataclasses as dc
+        rep = _fixture_report()
+        rows = [dc.replace(r, required_dtype="bf16")
+                for r in rep.rows[:3]]
+
+        class _R:
+            def __init__(self, rows):
+                self.rows = rows
+
+            def fp8_candidates(self, k=None):
+                return []
+        return _R(rows)
+
+    def test_fires_on_narrow_wire(self):
+        hits = lint.wire_dtype_findings(
+            [_collective("f8e4m3fn")], self._bf16_required())
+        assert len(hits) == 1 and hits[0].severity == "error"
+        assert hits[0].id == "APX306"
+        assert hits[0].dtype_from == "fp8_e4m3"
+        assert hits[0].dtype_to == "bf16"
+        assert hits[0].count == 3
+
+    def test_committed_fixture_bf16_wire_is_clean(self):
+        # the committed BERT fixture measures every site fp8-safe: a
+        # bf16 grad sync is wide enough for all of them
+        assert lint.wire_dtype_findings(
+            [_collective("bf16")], _fixture_report()) == []
+
+    def test_int8_wire_exempt(self):
+        # the hierarchical int8 EF sync carries error feedback by
+        # design — non-float wires are not precision subjects
+        assert lint.wire_dtype_findings(
+            [_collective("s8")], self._bf16_required()) == []
+
+    def test_non_reduction_collectives_exempt(self):
+        assert lint.wire_dtype_findings(
+            [_collective("f8e4m3fn", opcode="all-gather")],
+            self._bf16_required()) == []
+
+
+class TestMisScaledToyAtEveryOptLevel:
+    """Acceptance pin: a deliberately mis-scaled fp8-cast toy program
+    — scaled loss, gradient cast to fp8 with no per-site scale, no
+    unscale before commit — is caught by APX301 AND APX303 at every
+    opt level (both rules are policy-independent by design)."""
+
+    @pytest.mark.parametrize("lv", ["O0", "O1", "O2", "O3"])
+    def test_caught(self, lv):
+        def bad_step(params, x, scale):
+            def loss_fn(p):
+                return jnp.mean((x @ p) ** 2) * scale
+            g = jax.grad(loss_fn)(params)
+            g8 = g.astype(jnp.float8_e4m3fn)
+            return params - 0.1 * g8.astype(jnp.float32)
+        rep = lint.lint_step(
+            bad_step, jnp.ones((4, 4), jnp.float32),
+            jnp.ones((8, 4), jnp.float32), jnp.float32(1024.0),
+            policy=amp.Policy.from_opt_level(lv),
+            rules=("unscaled-narrow-cast", "scale-leak"))
+        assert rep.by_rule("unscaled-narrow-cast"), rep.table()
+        assert rep.by_rule("scale-leak"), rep.table()
+        assert all(f.severity == "error" for f in rep.findings)
+
+
+class TestAmpStepPrecisionClean:
+    """No-false-positive guard: the real Amp machinery (scale_loss /
+    unscale_grads / master-weight plumbing) certifies clean at every
+    opt level — the fast-scale twin of the run_tier1.sh
+    ``--opt-level all`` flagship sweep."""
+
+    @pytest.mark.parametrize("lv", ["O0", "O1", "O2", "O3"])
+    def test_toy_amp_step_has_no_precision_errors(self, lv):
+        pol = amp.Policy.from_opt_level(lv)
+        params = {"w": jnp.zeros((64, 64), jnp.float32),
+                  "b": jnp.zeros((64,), jnp.float32)}
+        amp_opt = amp.Amp(pol, FusedSGD(lr=0.1, momentum=0.9))
+        state = amp_opt.init(params)
+        x = jnp.zeros((8, 64))
+        y = jnp.zeros((8, 64))
+
+        def step(state, x, y):
+            def loss_fn(mp):
+                return jnp.mean((x @ mp["w"] + mp["b"] - y) ** 2)
+            loss, grads, state, finite = amp_opt.backward(state,
+                                                          loss_fn)
+            return amp_opt.apply_gradients(state, grads, finite), loss
+
+        fs = _pp(step, state, x, y, policy=pol)
+        errors = [f for f in fs if f.severity == "error"]
+        assert errors == [], errors
+
+
+class TestPrecisionPreflight:
+    def _clean_step(self):
+        step, p, x, s = _leaky_grad_step(unscale=True)
+        return jax.make_jaxpr(step)(p, x, s)
+
+    def test_candidate_sites_pin_against_committed_fixture(self):
+        # CI pin: the preflight's candidate-site set must equal the
+        # committed fixture's measured site set (diff == empty) on a
+        # statically-clean program — all 84 castable, ranked
+        rep = _fixture_report()
+        pf = lint.precision_preflight(self._clean_step(), report=rep)
+        assert pf.blocking == []
+        assert len(pf.rows) == len(rep.rows) == 84
+        assert {r["site"] for r in pf.candidates} \
+            == {r.site for r in rep.rows}
+        ranks = [lint.DTYPE_NAMES.index(r["required_dtype"])
+                 for r in pf.rows]
+        assert ranks == sorted(ranks)
+        assert "statically castable" in pf.table()
+
+    def test_static_errors_block_every_candidate(self):
+        bad = jax.make_jaxpr(
+            lambda x: x.astype(jnp.float8_e4m3fn))(
+                jnp.ones((8,), jnp.float32))
+        pf = lint.precision_preflight(bad, report=_fixture_report())
+        assert pf.blocking == ["APX301"]
+        assert pf.candidates == [] and len(pf.rows) == 84
+        assert "blocked by: APX301" in pf.table()
+
+    def test_hlo_join_blocks_on_wire(self):
+        # a narrow-wire APX306 error (static x measured join) blocks
+        # the preflight exactly like a trace-side error
+        import dataclasses as dc
+        rep = _fixture_report()
+        rep = dc.replace(rep, rows=[
+            dc.replace(r, required_dtype="bf16") for r in rep.rows])
+        hlo = ('HloModule m\nENTRY e {\n'
+               '  p = f8e4m3fn[8]{0} parameter(0)\n'
+               '  ROOT r = f8e4m3fn[8]{0} all-reduce(p), channel_id=1,'
+               ' replica_groups={{0,1}}, to_apply=add,'
+               ' metadata={op_name="ddp/sync_gradients"}\n}\n')
+        from apex_tpu.lint.spmd_pass import extract_collective_schedule
+        assert extract_collective_schedule(hlo)      # parser saw it
+        pf = lint.precision_preflight(self._clean_step(), report=rep,
+                                      hlo_text=hlo)
+        assert pf.blocking == ["APX306"]
+        assert pf.candidates == []
+
+
+class TestSingleSharedTrace:
+    def test_lint_step_traces_exactly_once(self, monkeypatch):
+        """The de-dup satellite: jaxpr pass, APX204 and the precision
+        pass share ONE ``jax.make_jaxpr`` trace inside ``lint_step``
+        (and zero with ``jaxpr=`` pre-made), pinned alongside the
+        CompileWatcher's zero-compile guarantee for trace-only rules."""
+        from apex_tpu.prof import compile_watch as cw
+        cw.install()
+        step, state, x, y, pol = _toy_amp_step()
+        calls = []
+        real = jax.make_jaxpr
+
+        def counted(fn, *a, **k):
+            calls.append(fn)
+            return real(fn, *a, **k)
+
+        monkeypatch.setattr(jax, "make_jaxpr", counted)
+        trace_rules = tuple(lint._JAXPR_RULES | lint._PRECISION_RULES)
+        compiles0 = cw.global_counters()["compiles"]
+        lint.lint_step(step, state, x, y, policy=pol,
+                       rules=trace_rules)
+        assert len(calls) == 1          # ONE shared trace, all passes
+        assert cw.global_counters()["compiles"] == compiles0
+        calls.clear()
+        jaxpr = real(step)(state, x, y)
+        lint.lint_step(None, policy=pol, jaxpr=jaxpr,
+                       rules=trace_rules)
+        assert calls == []              # pre-made trace: zero traces
+        assert cw.global_counters()["compiles"] == compiles0
+
+
+class TestPrecisionEvidenceContract:
+    def test_dtype_fields_validated(self):
+        with pytest.raises(ValueError):
+            F.Finding(rule="unscaled-narrow-cast", message="m",
+                      dtype_from="f32")        # HLO spelling, not ours
+        with pytest.raises(ValueError):
+            F.Finding(rule="scale-leak", message="m",
+                      scale_provenance="scaled")
+
+    def test_to_event_carries_evidence(self):
+        f = F.Finding(rule="unscaled-narrow-cast", message="m",
+                      dtype_from="fp32", dtype_to="fp8_e4m3",
+                      scale_provenance="unscaled")
+        ev = f.to_event()
+        assert ev["dtype_from"] == "fp32"
+        assert ev["dtype_to"] == "fp8_e4m3"
+        assert ev["scale_provenance"] == "unscaled"
+
+    def test_fingerprint_excludes_dtype_evidence(self):
+        a = F.Finding(rule="unscaled-narrow-cast", message="m",
+                      op="convert_element_type", scope="s",
+                      dtype_from="fp32", dtype_to="fp8_e4m3")
+        b = F.Finding(rule="unscaled-narrow-cast", message="m",
+                      op="convert_element_type", scope="s",
+                      dtype_from="bf16", dtype_to="fp8_e5m2")
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_schema_negative_twins(self, tmp_path):
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "scripts"))
+        try:
+            import check_metrics_schema as cms
+        finally:
+            sys.path.pop(0)
+        good = {"kind": "lint_finding", "rule": "unscaled-narrow-cast",
+                "id": "APX301", "severity": "error", "message": "m",
+                "dtype_from": "fp32", "dtype_to": "fp8_e4m3",
+                "scale_provenance": "unscaled", "scope": None}
+        assert cms.check_lint_lines([json.dumps(good)]) == []
+        for field, bad_val in (("dtype_from", "f32"),
+                               ("dtype_to", "float8"),
+                               ("scale_provenance", "scaled")):
+            bad = dict(good)
+            bad[field] = bad_val
+            errs = cms.check_lint_lines([json.dumps(bad)])
+            assert errs, f"{field}={bad_val!r} must be rejected"
+
+
+class TestDynamicsFlagshipClean:
+    @pytest.mark.slow       # ResNet structural compile like the other
+    def test_dynamics_step_lints_clean(self):        # flagship guards
+        """The PR-19 dynamics-instrumented step (``--flagship
+        dynamics``): zero error-severity findings on the empty
+        baseline, like guarded/ckpt — the observatory's self-audit."""
+        sys.path.insert(0, os.path.join(_REPO_ROOT, "scripts"))
+        try:
+            import apexlint
+        finally:
+            sys.path.pop(0)
+        fn, args, policy, name = apexlint._build_flagship_dynamics()
+        rep = lint.lint_step(fn, *args, policy=policy, fn_name=name)
+        assert rep.errors == [], rep.table()
+
+
 # --- Report / baseline / JSONL plumbing --------------------------------------
 
 class TestReportPlumbing:
@@ -364,7 +827,9 @@ class TestReportPlumbing:
         assert {r.id for r in F.RULES.values()} == {
             "APX001", "APX002", "APX003", "APX004",
             "APX101", "APX102", "APX103", "APX104",
-            "APX201", "APX202", "APX203", "APX204"}
+            "APX201", "APX202", "APX203", "APX204",
+            "APX301", "APX302", "APX303", "APX304",
+            "APX305", "APX306"}
         for r in F.RULES.values():
             assert r.severity in F.SEVERITIES and r.fix and r.title
 
@@ -432,6 +897,11 @@ class TestCompileCheckCases:
 
     def test_no_extra_dispatch_case(self):
         self._case("lint/no-extra-dispatch")()
+
+    def test_precision_no_extra_dispatch_case(self):
+        # precision pass + preflight leave the step's HLO bit-identical
+        # (donated and undonated, with and without the measured join)
+        self._case("lint/precision-no-extra-dispatch")()
 
     @pytest.mark.slow       # compiles 5 kernel families (~20s); also
     def test_kernel_sweep_case(self):            # runs on-device via
